@@ -1,0 +1,53 @@
+"""scconsensus_tpu — TPU-native consensus clustering for single-cell RNA-seq.
+
+A brand-new JAX / XLA / Pallas framework with the capabilities of the R package
+``scConsensus`` (reference: ``bbbranjan/scConsensus``): consensus labeling of two
+clusterings via a contingency-table merge grammar, all-pairs differential-expression
+testing (Wilcoxon rank-sum, edgeR-style negative-binomial exact test, bimod LRT,
+ROC/AUC, t-test), DE-gene-union re-embedding (randomized-SVD PCA), Ward.D2
+hierarchical clustering, dynamic-tree-cut refinement, silhouette scoring, and
+heatmap reports.
+
+Architecture (idiomatic JAX, not a port):
+  * ``consensus/`` — contingency table + automated label-merge grammar
+    (host, O(N); reference: R/plotContingencyTable.R).
+  * ``ops/``       — batched statistical/linear-algebra kernels (device):
+    rank/Wilcoxon, NB dispersion + exact test, PCA, distance, silhouette,
+    Ward linkage, dynamic tree cut, BH.
+  * ``de/``        — the all-pairs DE engine: cluster pairs flattened to a padded
+    batch axis, gates as masks (replaces the reference's doParallel fan-out).
+  * ``models/``    — user-facing pipelines mirroring the reference entry points.
+  * ``parallel/``  — device-mesh sharding (pjit/shard_map, ICI/DCN collectives).
+  * ``report/``    — matplotlib contingency / DE heatmaps.
+  * ``utils/``     — config, artifact store (checkpoint/resume), tracing, synthetic data.
+  * ``native/``    — C++ runtime pieces (Ward NN-chain linkage) via ctypes.
+"""
+
+__version__ = "0.1.0"
+
+from scconsensus_tpu.consensus import contingency_table, plot_contingency_table
+from scconsensus_tpu.config import ReclusterConfig, CompatFlags
+
+
+def __getattr__(name):
+    # Lazy: pulling in the pipelines imports jax; keep bare-package import light.
+    if name in ("recluster_de_consensus", "recluster_de_consensus_fast", "ReclusterResult"):
+        try:
+            from scconsensus_tpu import models
+        except ImportError as e:  # pragma: no cover
+            raise NotImplementedError(
+                f"{name} requires scconsensus_tpu.models, which failed to import: {e}"
+            ) from e
+        return getattr(models, name)
+    raise AttributeError(name)
+
+__all__ = [
+    "contingency_table",
+    "plot_contingency_table",
+    "recluster_de_consensus",
+    "recluster_de_consensus_fast",
+    "ReclusterConfig",
+    "CompatFlags",
+    "ReclusterResult",
+    "__version__",
+]

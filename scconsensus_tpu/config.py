@@ -1,0 +1,109 @@
+"""Typed configuration for the refinement pipeline.
+
+The reference scatters tunables across two function signatures with silently
+divergent defaults (R/reclusterDEConsensus.R:20-29 vs
+R/reclusterDEConsensusFast.R:22-33; SURVEY.md §5.6). Here there is ONE config
+type with per-path presets, serializable next to artifacts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class CompatFlags:
+    """Reference-quirk switches (SURVEY.md §2d). ``True`` reproduces the
+    reference's literal arithmetic; ``False`` applies the documented fix."""
+
+    # §2d-1: reference edgeR path drops fold-changes (stored to a dead
+    # variable), poisoning the DE mask with NA. Fixed mode uses edgeR's logFC
+    # converted from log2 to natural log before thresholding.
+    edger_drop_logfc: bool = False
+    # §2d-3: slow path compares mean-of-logs against log(count-space threshold)
+    # (R/reclusterDEConsensus.R:109-113). Fixed mode compares in one space.
+    mean_gate_mixed_spaces: bool = True
+    # §2d-4: BH with n = total gene count (slow path) vs n = surviving features
+    # (fast path). True keeps each path's literal correction.
+    bh_reference_n: bool = True
+    # §2d-6: return the per-deepSplit silhouette (reference computes & drops it).
+    return_silhouette: bool = True
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class ReclusterConfig:
+    """Configuration of the DE → embed → recluster refinement pipeline.
+
+    Field provenance (reference defaults):
+      slow path R/reclusterDEConsensus.R:20-29, fast path
+      R/reclusterDEConsensusFast.R:22-33.
+    """
+
+    # --- DE testing ---
+    method: str = "wilcox"  # wilcox | edger | bimod | roc | t
+    q_val_thrs: float = 0.1
+    # Natural-log fold-change threshold. The slow path passes a *ratio*
+    # (`fcThrs`, thresholded as log(fcThrs)); we store the log-space value.
+    log_fc_thrs: float = 0.5
+    mean_scaling_factor: float = 5.0  # slow-path mean-expression gate scale
+    mean_exprs_thrs: float = 0.0  # fast-path gate (Seurat MeanExprsThrs)
+    min_pct: float = 20.0  # fast path: min % of cells expressing (minPerCent)
+    min_diff_pct: float = -float("inf")
+    min_cells_group: int = 3
+    pseudocount: float = 1.0
+    max_cells_per_ident: Optional[int] = None  # subsample per group (seeded)
+    random_seed: int = 1
+    only_pos: bool = False
+    n_top_de_genes: int = 30  # NumbertopDEGenes; slow path hard-codes 30
+
+    # --- cluster filtering ---
+    min_cluster_size: int = 10  # strictly-greater filter (§2d-7)
+    drop_grey: bool = True  # 'grey' = unclustered (reference :48-49)
+
+    # --- embed + recluster ---
+    n_pcs: int = 15
+    distance: str = "euclidean"  # euclidean | pearson (reference's commented alt)
+    linkage: str = "ward.D2"
+    deep_split_values: Tuple[int, ...] = (1, 2, 3, 4)
+    pam_stage: bool = False
+
+    # --- scale-out ---
+    approx_threshold: int = 100_000  # above this many cells, use centroid pre-pooling
+    n_pool_centroids: int = 4096
+
+    # --- misc ---
+    compat: CompatFlags = dataclasses.field(default_factory=CompatFlags)
+    artifact_dir: Optional[str] = None  # stage-keyed checkpoint store; None = off
+    plot_name: Optional[str] = None  # DE heatmap output path; None = no plot
+    dtype: str = "float32"
+
+    @classmethod
+    def slow_path_preset(cls, q_val_thrs: float, fc_thrs: float, **kw) -> "ReclusterConfig":
+        """Reference slow-path defaults: method='Wilcoxon', meanScalingFactor=5,
+        fcThrs given as a ratio (natural-log threshold = log(fcThrs))."""
+        import math
+
+        return cls(
+            method=kw.pop("method", "wilcox"),
+            q_val_thrs=q_val_thrs,
+            log_fc_thrs=math.log(fc_thrs),
+            min_pct=kw.pop("min_pct", 0.0),
+            **kw,
+        )
+
+    @classmethod
+    def fast_path_preset(cls, **kw) -> "ReclusterConfig":
+        """Reference fast-path defaults (qValThrs=0.1, logFCThrs=0.5, minPerCent=20)."""
+        return cls(**kw)
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["min_diff_pct"] = (
+            None if self.min_diff_pct == -float("inf") else self.min_diff_pct
+        )
+        return json.dumps(d, indent=2, default=str)

@@ -1,0 +1,13 @@
+from scconsensus_tpu.consensus.contingency import (
+    contingency_table,
+    automated_consensus,
+    plot_contingency_table,
+    ContingencyResult,
+)
+
+__all__ = [
+    "contingency_table",
+    "automated_consensus",
+    "plot_contingency_table",
+    "ContingencyResult",
+]

@@ -1,0 +1,11 @@
+from scconsensus_tpu.report.heatmaps import plot_contingency_heatmap
+
+__all__ = ["plot_contingency_heatmap"]
+
+
+def __getattr__(name):
+    if name in ("cell_type_de_plot",):
+        from scconsensus_tpu.report import de_heatmap
+
+        return getattr(de_heatmap, name)
+    raise AttributeError(name)

@@ -1,0 +1,11 @@
+from scconsensus_tpu.utils.synthetic import synthetic_scrna, planted_clusters
+from scconsensus_tpu.utils.logging import get_logger, StageTimer
+from scconsensus_tpu.utils.artifacts import ArtifactStore
+
+__all__ = [
+    "synthetic_scrna",
+    "planted_clusters",
+    "get_logger",
+    "StageTimer",
+    "ArtifactStore",
+]

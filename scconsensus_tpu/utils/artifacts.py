@@ -1,0 +1,83 @@
+"""Stage-keyed artifact store with resume.
+
+The reference writes write-only ``saveRDS`` dumps with hard-coded CWD filenames
+and never reads them back (R/reclusterDEConsensus.R:200-202,231,285; SURVEY.md
+§5.4). Here each pipeline stage (consensus labels → per-pair DE tables → gene
+union → embedding → tree → cuts) is saved under a stage key and is resumable:
+re-running a pipeline with the same store skips completed stages.
+
+Format: one ``<stage>.npz`` per stage for arrays plus a ``<stage>.json``
+sidecar for scalars/metadata — portable, no pickle.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+__all__ = ["ArtifactStore"]
+
+
+class ArtifactStore:
+    def __init__(self, root: Optional[str]):
+        self.root = root
+        if root is not None:
+            os.makedirs(root, exist_ok=True)
+
+    @property
+    def enabled(self) -> bool:
+        return self.root is not None
+
+    def _paths(self, stage: str):
+        assert self.root is not None
+        return (
+            os.path.join(self.root, f"{stage}.npz"),
+            os.path.join(self.root, f"{stage}.json"),
+        )
+
+    def has(self, stage: str) -> bool:
+        """True iff the stage's array artifact exists (the resume key).
+        Meta sidecars alone do not mark a stage complete."""
+        if not self.enabled:
+            return False
+        npz, _ = self._paths(stage)
+        return os.path.exists(npz)
+
+    def save(self, stage: str, arrays: Optional[Dict[str, np.ndarray]] = None,
+             meta: Optional[Dict[str, Any]] = None) -> None:
+        if not self.enabled:
+            return
+        npz, js = self._paths(stage)
+        if arrays is not None:
+            np.savez_compressed(npz + ".tmp.npz", **{k: np.asarray(v) for k, v in arrays.items()})
+            os.replace(npz + ".tmp.npz", npz)
+        if meta is not None:
+            with open(js + ".tmp", "w") as f:
+                json.dump(meta, f, indent=2, default=str)
+            os.replace(js + ".tmp", js)
+
+    def load(self, stage: str):
+        npz, js = self._paths(stage)
+        arrays: Dict[str, np.ndarray] = {}
+        meta: Dict[str, Any] = {}
+        if os.path.exists(npz):
+            with np.load(npz, allow_pickle=False) as z:
+                arrays = {k: z[k] for k in z.files}
+        if os.path.exists(js):
+            with open(js) as f:
+                meta = json.load(f)
+        return arrays, meta
+
+    def cached(self, stage: str, fn: Callable[[], Dict[str, np.ndarray]],
+               meta_fn: Optional[Callable[[], Dict[str, Any]]] = None):
+        """Run ``fn`` (returning a dict of arrays) unless ``stage`` already has
+        a saved artifact, in which case load and return it."""
+        if self.has(stage):
+            arrays, _ = self.load(stage)
+            return arrays
+        arrays = fn()
+        self.save(stage, arrays, meta_fn() if meta_fn else None)
+        return arrays

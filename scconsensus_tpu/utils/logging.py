@@ -1,0 +1,65 @@
+"""Structured logging + per-stage timing.
+
+The reference's observability is bare ``print()`` progress lines
+(R/reclusterDEConsensus.R:172-178; SURVEY.md §5.1/§5.5). Here every pipeline
+stage emits a structured record {stage, wall_s, extra metrics} through a
+standard logger, and the collected records double as the benchmark output.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+__all__ = ["get_logger", "StageTimer"]
+
+_FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
+
+
+def get_logger(name: str = "scconsensus_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(_FORMAT))
+        logger.addHandler(h)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+class StageTimer:
+    """Collects per-stage wall-clock + metrics; optionally wraps stages in
+    ``jax.profiler.TraceAnnotation`` so stages show up in TPU traces."""
+
+    def __init__(self, logger: Optional[logging.Logger] = None, trace: bool = False):
+        self.records: List[Dict[str, Any]] = []
+        self.logger = logger or get_logger()
+        self.trace = trace
+
+    @contextmanager
+    def stage(self, name: str, **metrics: Any):
+        ann = None
+        if self.trace:
+            import jax.profiler
+
+            ann = jax.profiler.TraceAnnotation(name)
+            ann.__enter__()
+        t0 = time.perf_counter()
+        rec: Dict[str, Any] = {"stage": name, **metrics}
+        try:
+            yield rec
+        finally:
+            rec["wall_s"] = round(time.perf_counter() - t0, 4)
+            if ann is not None:
+                ann.__exit__(None, None, None)
+            self.records.append(rec)
+            self.logger.info("stage %s", json.dumps(rec, default=str))
+
+    def total_s(self) -> float:
+        return sum(r.get("wall_s", 0.0) for r in self.records)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"stages": self.records, "total_s": self.total_s()}

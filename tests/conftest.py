@@ -1,0 +1,26 @@
+"""Test harness config.
+
+Distributed-without-a-cluster (SURVEY.md §4): tests run on a virtual 8-device
+CPU mesh so shard_map/psum collectives are exercised without TPU hardware.
+Must set the XLA flags BEFORE jax is first imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
