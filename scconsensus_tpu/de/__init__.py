@@ -1,0 +1,8 @@
+from scconsensus_tpu.de.engine import (
+    PairwiseDEResult,
+    pairwise_de,
+    filter_clusters,
+    de_gene_union,
+)
+
+__all__ = ["PairwiseDEResult", "pairwise_de", "filter_clusters", "de_gene_union"]

@@ -1,0 +1,349 @@
+"""All-pairs differential-expression engine.
+
+The reference fans the outer cluster index over R worker processes with a
+triangular load imbalance (R/reclusterDEConsensusFast.R:61-65; SURVEY.md §3
+E3). Here all K(K−1)/2 pairs are flattened into one batch axis, bucketed by
+padded pair width so shapes stay static, and driven through vmapped kernels —
+the TPU equivalent of the reference's doParallel backend (SURVEY.md §2b N10).
+
+Engine flow:
+  1. cluster filter (count > min_cluster_size, drop 'grey'; reference
+     R/reclusterDEConsensus.R:39-49),
+  2. per-cluster aggregates: three matmuls against the membership one-hot,
+  3. per-pair gates from aggregates (masks, no ragged selection),
+  4. per-pair statistical test over gene chunks (device),
+  5. per-pair BH (masked or explicit-n, per path semantics),
+  6. DE call + top-N union.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scconsensus_tpu.config import ReclusterConfig
+from scconsensus_tpu.ops.gates import (
+    compute_aggregates,
+    pair_gates_fast,
+    pair_gates_slow,
+)
+from scconsensus_tpu.ops.multipletests import bh_adjust, bh_adjust_masked
+from scconsensus_tpu.ops.ranks import masked_midranks
+from scconsensus_tpu.ops.wilcoxon import (
+    EXACT_N_LIMIT,
+    wilcoxon_exact_host,
+    wilcoxon_from_ranks,
+)
+
+__all__ = ["PairwiseDEResult", "pairwise_de", "filter_clusters", "de_gene_union"]
+
+# Per-chunk element budget for the (pairs × genes × cells) test tensor.
+_CHUNK_ELEM_BUDGET = 24_000_000
+
+
+@dataclasses.dataclass
+class PairwiseDEResult:
+    """Dense all-pairs DE summary (host arrays; P = #pairs, G = #genes)."""
+
+    cluster_names: List[str]
+    pair_i: np.ndarray  # (P,) index into cluster_names
+    pair_j: np.ndarray
+    log_p: np.ndarray   # (P, G); NaN where untested/degenerate
+    log_q: np.ndarray   # (P, G); NaN where not adjusted
+    log_fc: np.ndarray  # (P, G) natural-log fold change (path convention)
+    tested: np.ndarray  # (P, G) bool: entered the statistical test
+    de_mask: np.ndarray  # (P, G) bool: final DE call
+    pct1: Optional[np.ndarray] = None  # (P, G) fast path only
+    pct2: Optional[np.ndarray] = None
+    aux: Optional[Dict[str, np.ndarray]] = None  # extra per-test stats (AUC...)
+
+    @property
+    def n_pairs(self) -> int:
+        return int(self.pair_i.shape[0])
+
+    def de_counts(self) -> np.ndarray:
+        """Per-pair DE gene counts (the reference's progress printout,
+        R/reclusterDEConsensus.R:172-178 — here a returned metric)."""
+        return self.de_mask.sum(axis=1)
+
+
+def filter_clusters(
+    labels: Sequence, min_cluster_size: int, drop_grey: bool = True
+) -> Tuple[List[str], np.ndarray]:
+    """Clusters with count > min_cluster_size (strictly greater, §2d-7),
+    'grey' substring dropped; returns (sorted names, per-cell index into
+    names, -1 for dropped cells)."""
+    lab = np.asarray(labels).astype(str)
+    names, counts = np.unique(lab, return_counts=True)
+    keep = counts > min_cluster_size
+    if drop_grey:
+        keep &= np.char.find(names, "grey") == -1
+    kept = [str(n) for n in names[keep]]
+    index = {n: i for i, n in enumerate(kept)}
+    cell_idx = np.array([index.get(v, -1) for v in lab], dtype=np.int32)
+    return kept, cell_idx
+
+
+def _all_pairs(k: int) -> Tuple[np.ndarray, np.ndarray]:
+    ii, jj = np.triu_indices(k, k=1)
+    return ii.astype(np.int32), jj.astype(np.int32)
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << (int(x) - 1).bit_length()
+
+
+@dataclasses.dataclass
+class _PairBucket:
+    rows: np.ndarray      # (B,) indices into the global pair list
+    cell_idx: np.ndarray  # (B, W) gather indices into columns of data
+    mask1: np.ndarray     # (B, W) group-1 membership among gathered cells
+    mask2: np.ndarray
+    n1: np.ndarray        # (B,)
+    n2: np.ndarray
+
+
+def _bucket_pairs(
+    cell_idx_of: List[np.ndarray], pair_i: np.ndarray, pair_j: np.ndarray
+) -> List[_PairBucket]:
+    """Group pairs by padded width so each bucket runs with one static shape."""
+    widths = {}
+    for r in range(pair_i.shape[0]):
+        w = _next_pow2(
+            cell_idx_of[pair_i[r]].size + cell_idx_of[pair_j[r]].size
+        )
+        widths.setdefault(w, []).append(r)
+    buckets = []
+    for w, rows in sorted(widths.items()):
+        B = len(rows)
+        idx = np.zeros((B, w), np.int32)
+        m1 = np.zeros((B, w), bool)
+        m2 = np.zeros((B, w), bool)
+        n1 = np.zeros(B, np.int32)
+        n2 = np.zeros(B, np.int32)
+        for b, r in enumerate(rows):
+            ci = cell_idx_of[pair_i[r]]
+            cj = cell_idx_of[pair_j[r]]
+            idx[b, : ci.size] = ci
+            idx[b, ci.size : ci.size + cj.size] = cj
+            m1[b, : ci.size] = True
+            m2[b, ci.size : ci.size + cj.size] = True
+            n1[b], n2[b] = ci.size, cj.size
+        buckets.append(_PairBucket(np.asarray(rows), idx, m1, m2, n1, n2))
+    return buckets
+
+
+@jax.jit
+def _wilcox_chunk(
+    data_chunk: jnp.ndarray,  # (Gc, N)
+    idx: jnp.ndarray,         # (B, W)
+    m1: jnp.ndarray,          # (B, W)
+    m2: jnp.ndarray,
+    n1: jnp.ndarray,          # (B,)
+    n2: jnp.ndarray,
+):
+    """Rank-sum test for one gene-chunk × pair-bucket tile.
+
+    Returns (log_p, u_stat, tie_sum) each (B, Gc)."""
+    vals = jnp.take(data_chunk, idx, axis=1)          # (Gc, B, W)
+    vals = jnp.swapaxes(vals, 0, 1)                   # (B, Gc, W)
+    pooled = (m1 | m2)[:, None, :]                    # (B, 1, W)
+    B, Gc, W = vals.shape
+    flat = vals.reshape(B * Gc, W)
+    flat_mask = jnp.broadcast_to(pooled, (B, Gc, W)).reshape(B * Gc, W)
+    ranks, tie_sum = masked_midranks(flat, flat_mask)
+    ranks = ranks.reshape(B, Gc, W)
+    tie_sum = tie_sum.reshape(B, Gc)
+    rs1 = jnp.sum(jnp.where(m1[:, None, :], ranks, 0.0), axis=-1)  # (B, Gc)
+    log_p, u = wilcoxon_from_ranks(
+        rs1, tie_sum, n1[:, None], n2[:, None]
+    )
+    return log_p, u, tie_sum
+
+
+def _run_wilcox(
+    data: np.ndarray,
+    cell_idx_of: List[np.ndarray],
+    pair_i: np.ndarray,
+    pair_j: np.ndarray,
+    exact: str = "auto",
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Rank-sum log-p for every (pair, gene). Returns (log_p (P,G), u (P,G)).
+
+    ``exact``: 'auto' applies R's exact branch on host for pairs with both
+    groups < 50 cells and tie-free genes; 'never' keeps everything on the
+    normal-approximation device path.
+    """
+    G, _ = data.shape
+    P = pair_i.shape[0]
+    log_p = np.full((P, G), np.nan, np.float32)
+    u_stat = np.full((P, G), np.nan, np.float32)
+    jdata = jnp.asarray(data)
+    for bucket in _bucket_pairs(cell_idx_of, pair_i, pair_j):
+        B, W = bucket.cell_idx.shape
+        gc = max(256, _CHUNK_ELEM_BUDGET // max(B * W, 1))
+        gc = min(_next_pow2(gc), _next_pow2(G))
+        idx = jnp.asarray(bucket.cell_idx)
+        m1 = jnp.asarray(bucket.mask1)
+        m2 = jnp.asarray(bucket.mask2)
+        n1 = jnp.asarray(bucket.n1)
+        n2 = jnp.asarray(bucket.n2)
+        for g0 in range(0, G, gc):
+            chunk = jdata[g0 : g0 + gc]
+            if chunk.shape[0] < gc:  # pad to keep the jit cache to one entry
+                chunk = jnp.pad(chunk, ((0, gc - chunk.shape[0]), (0, 0)))
+            lp, u, ties = _wilcox_chunk(chunk, idx, m1, m2, n1, n2)
+            g1 = min(g0 + gc, G)
+            lp_h = np.asarray(lp)[:, : g1 - g0]
+            u_h = np.asarray(u)[:, : g1 - g0]
+            log_p[bucket.rows, g0:g1] = lp_h
+            u_stat[bucket.rows, g0:g1] = u_h
+            if exact == "auto":
+                small = (bucket.n1 < EXACT_N_LIMIT) & (bucket.n2 < EXACT_N_LIMIT)
+                if small.any():
+                    ties_h = np.asarray(ties)[:, : g1 - g0]
+                    for b in np.nonzero(small)[0]:
+                        tiefree = ties_h[b] == 0
+                        if tiefree.any():
+                            pe = wilcoxon_exact_host(
+                                u_h[b][tiefree],
+                                int(bucket.n1[b]),
+                                int(bucket.n2[b]),
+                            )
+                            row = log_p[bucket.rows[b], g0:g1]
+                            row[tiefree] = np.log(pe).astype(np.float32)
+                            log_p[bucket.rows[b], g0:g1] = row
+    return log_p, u_stat
+
+
+def pairwise_de(
+    data: np.ndarray,
+    labels: Sequence,
+    config: ReclusterConfig,
+    timer=None,
+) -> PairwiseDEResult:
+    """Run the configured all-pairs DE test.
+
+    data: (G, N) log-normalized expression; labels: per-cell cluster names.
+    """
+    from scconsensus_tpu.utils.logging import StageTimer
+
+    timer = timer or StageTimer()
+    data = np.ascontiguousarray(data, dtype=np.float32)
+    G, N = data.shape
+
+    with timer.stage("cluster_filter"):
+        names, cell_idx = filter_clusters(
+            labels, config.min_cluster_size, config.drop_grey
+        )
+        K = len(names)
+        if K < 2:
+            raise ValueError(
+                f"need >= 2 clusters above min_cluster_size={config.min_cluster_size}, got {K}"
+            )
+        cell_idx_of = [np.nonzero(cell_idx == k)[0].astype(np.int32) for k in range(K)]
+        if config.max_cells_per_ident is not None:
+            rng = np.random.default_rng(config.random_seed)
+            cell_idx_of = [
+                rng.choice(ci, size=config.max_cells_per_ident, replace=False)
+                if ci.size > config.max_cells_per_ident
+                else ci
+                for ci in cell_idx_of
+            ]
+        pair_i, pair_j = _all_pairs(K)
+
+    with timer.stage("aggregates", n_clusters=K, n_pairs=int(pair_i.size)):
+        onehot = np.zeros((N, K), np.float32)
+        valid = cell_idx >= 0
+        onehot[np.nonzero(valid)[0], cell_idx[valid]] = 1.0
+        agg = compute_aggregates(jnp.asarray(data), jnp.asarray(onehot))
+
+    method = config.method.lower()
+    pi, pj = jnp.asarray(pair_i), jnp.asarray(pair_j)
+
+    if method in ("wilcox", "wilcoxon"):
+        slow = method == "wilcoxon"
+        with timer.stage("gates"):
+            if slow:
+                mean_gate, log_fc = pair_gates_slow(
+                    agg, pi, pj,
+                    mean_exprs_thrs=config.mean_scaling_factor
+                    * float(np.mean(np.expm1(data))),
+                    mixed_spaces=config.compat.mean_gate_mixed_spaces,
+                )
+                tested = np.ones((pair_i.size, G), bool)
+                pct1 = pct2 = None
+            else:
+                gate, log_fc, p1, p2 = pair_gates_fast(
+                    agg, pi, pj,
+                    min_pct=config.min_pct,
+                    min_diff_pct=config.min_diff_pct,
+                    log_fc_thrs=config.log_fc_thrs,
+                    mean_exprs_thrs=config.mean_exprs_thrs,
+                    pseudocount=config.pseudocount,
+                    only_pos=config.only_pos,
+                )
+                tested = np.asarray(gate)
+                pct1, pct2 = np.asarray(p1), np.asarray(p2)
+        with timer.stage("wilcox_test"):
+            log_p, _u = _run_wilcox(data, cell_idx_of, pair_i, pair_j)
+        with timer.stage("bh_adjust"):
+            if slow:
+                # BH with explicit n = G over all genes (§2d-4 slow semantics).
+                log_q = np.asarray(
+                    bh_adjust(jnp.asarray(log_p), n=jnp.asarray(float(G)))
+                    if config.compat.bh_reference_n
+                    else bh_adjust(jnp.asarray(log_p))
+                )
+            else:
+                log_q = np.asarray(
+                    bh_adjust_masked(jnp.asarray(log_p), jnp.asarray(tested))
+                )
+        log_fc = np.asarray(log_fc)
+        with timer.stage("de_call"):
+            log_thr = np.log(np.float32(config.q_val_thrs))
+            if slow:
+                de = (
+                    (log_q < log_thr)
+                    & (np.abs(log_fc) > config.log_fc_thrs)
+                    & np.asarray(mean_gate)
+                )
+                de &= ~np.isnan(log_q)
+            else:
+                de = tested & (log_q < log_thr) & ~np.isnan(log_q)
+        return PairwiseDEResult(
+            cluster_names=names,
+            pair_i=pair_i,
+            pair_j=pair_j,
+            log_p=log_p,
+            log_q=log_q,
+            log_fc=log_fc,
+            tested=tested,
+            de_mask=de,
+            pct1=pct1,
+            pct2=pct2,
+        )
+
+    raise NotImplementedError(f"DE method '{config.method}' not implemented yet")
+
+
+def de_gene_union(
+    result: PairwiseDEResult, n_top: int = 30
+) -> np.ndarray:
+    """Top-``n_top`` DE genes per pair by |logFC|, unioned
+    (R/reclusterDEConsensus.R:209-227; fast path :386-392).
+
+    Returns sorted unique gene indices."""
+    union: set = set()
+    for p in range(result.n_pairs):
+        de_idx = np.nonzero(result.de_mask[p])[0]
+        if de_idx.size == 0:
+            continue
+        fc = np.abs(result.log_fc[p, de_idx])
+        order = np.argsort(-fc, kind="stable")
+        union.update(de_idx[order[:n_top]].tolist())
+    return np.array(sorted(union), dtype=np.int64)

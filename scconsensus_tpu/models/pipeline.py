@@ -1,0 +1,281 @@
+"""End-to-end refinement pipelines (the user-facing "model" layer).
+
+Factorization of the reference's two monoliths (R/reclusterDEConsensus.R:20-299
+and R/reclusterDEConsensusFast.R:22-469, which inline DE + embed + recluster +
+report with ~70 duplicated tail lines — SURVEY.md §1) into one ``refine()``
+pipeline over real engine layers, plus two reference-shaped entry points.
+
+Stages (each timed, metric-logged, and resumable via ArtifactStore):
+  de → union → embed (PCA) → tree (Ward.D2) → cuts (dynamic tree cut ×
+  deepSplit) → silhouette → nodg → report.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from scconsensus_tpu.config import CompatFlags, ReclusterConfig
+from scconsensus_tpu.de import de_gene_union, pairwise_de
+from scconsensus_tpu.de.engine import PairwiseDEResult
+from scconsensus_tpu.ops.colors import labels_to_colors
+from scconsensus_tpu.ops.linkage import HClustTree, ward_linkage
+from scconsensus_tpu.ops.pca import pca_scores
+from scconsensus_tpu.ops.silhouette import mean_cluster_silhouette
+from scconsensus_tpu.ops.treecut import cutree_hybrid
+from scconsensus_tpu.utils.artifacts import ArtifactStore
+from scconsensus_tpu.utils.logging import StageTimer, get_logger
+
+__all__ = [
+    "ReclusterResult",
+    "refine",
+    "recluster_de_consensus",
+    "recluster_de_consensus_fast",
+]
+
+
+@dataclasses.dataclass
+class ReclusterResult:
+    """Pipeline output. Mirrors the reference's return object
+    {deGeneUnion, cellTree, dynamicColors} (R/reclusterDEConsensus.R:278-282)
+    plus everything the reference computed and dropped (silhouette, metrics)."""
+
+    de_gene_union: np.ndarray          # gene names if provided, else indices
+    de_gene_union_idx: np.ndarray      # always indices into the input rows
+    cell_tree: HClustTree
+    dynamic_colors: Dict[str, np.ndarray]   # "deepsplit: k" -> color per cell
+    dynamic_labels: Dict[str, np.ndarray]   # same keys -> integer labels (0=unassigned)
+    deep_split_info: List[Dict]        # per deepSplit: n_clusters, silhouette
+    nodg: np.ndarray                   # number of detected genes per cell
+    embedding: np.ndarray              # (N, n_pcs) PCA scores
+    de: PairwiseDEResult
+    metrics: Dict
+
+
+def refine(
+    data: np.ndarray,
+    labels: Sequence,
+    config: ReclusterConfig,
+    gene_names: Optional[Sequence[str]] = None,
+    timer: Optional[StageTimer] = None,
+) -> ReclusterResult:
+    """Full DE → embed → recluster refinement.
+
+    Args:
+      data: (G, N) log-transformed, normalized genes × cells matrix
+        (the reference's input contract, R/reclusterDEConsensus.R:5).
+      labels: per-cell consensus cluster labels (e.g. from
+        ``plot_contingency_table``).
+    """
+    logger = get_logger()
+    timer = timer or StageTimer(logger)
+    store = ArtifactStore(config.artifact_dir)
+    data = np.ascontiguousarray(data, dtype=np.float32)
+    G, N = data.shape
+    if len(labels) != N:
+        raise ValueError(f"labels length {len(labels)} != n_cells {N}")
+
+    de_res = pairwise_de(data, labels, config, timer=timer)
+
+    with timer.stage("union") as rec:
+        union = store.cached(
+            "union", lambda: {"idx": de_gene_union(de_res, config.n_top_de_genes)}
+        )["idx"]
+        rec["union_size"] = int(union.size)
+        rec["per_pair_de_counts"] = de_res.de_counts().tolist()
+    if union.size < 2:
+        raise ValueError(
+            f"DE gene union has {union.size} genes — nothing to re-embed. "
+            "Loosen q_val_thrs/log_fc_thrs or check cluster labels."
+        )
+
+    with timer.stage("embed") as rec:
+        n_pcs = min(union.size, config.n_pcs)
+        rec["n_pcs"] = n_pcs
+
+        def _embed():
+            import jax.numpy as jnp
+
+            if config.distance == "pearson":
+                # Correlation-distance variant (the reference's commented-out
+                # alternative, R/reclusterDEConsensus.R:238-239): embed cells
+                # as centered unit-norm expression vectors, where euclidean
+                # distance = sqrt(2·(1−r)) — monotone in Pearson distance —
+                # then reduce with PCA. Cluster geometry matches 1−r; absolute
+                # tree heights differ by the monotone transform.
+                cols = data[union]  # (|U|, N)
+                c = cols - cols.mean(axis=0, keepdims=True)
+                norm = np.linalg.norm(c, axis=0, keepdims=True)
+                cells = (c / np.maximum(norm, 1e-12)).T  # (N, |U|)
+            else:
+                cells = data[union].T
+            scores = pca_scores(jnp.asarray(cells), n_pcs)
+            return {"scores": np.asarray(scores)}
+
+        embedding = store.cached("embed", _embed)["scores"]
+
+    with timer.stage("tree", n_cells=N) as rec:
+        approx = N > config.approx_threshold
+        rec["approx"] = approx
+        if approx:
+            from scconsensus_tpu.ops.pooling import pooled_ward_linkage
+
+            tree, pool_assign, pool_centroids = pooled_ward_linkage(
+                embedding, n_centroids=config.n_pool_centroids,
+                seed=config.random_seed,
+            )
+        else:
+            tree = ward_linkage(embedding)
+            pool_assign, pool_centroids = None, None
+
+    dynamic_colors: Dict[str, np.ndarray] = {}
+    dynamic_labels: Dict[str, np.ndarray] = {}
+    deep_split_info: List[Dict] = []
+    with timer.stage("cuts"):
+        if pool_assign is None:
+            cut_points, cut_min_size = embedding, config.min_cluster_size
+        else:
+            # treecut operates on centroids: scale the size floor by the
+            # average pool occupancy (approximate-path semantics).
+            avg_pool = max(N / pool_centroids.shape[0], 1.0)
+            cut_points = pool_centroids
+            cut_min_size = max(2, int(round(config.min_cluster_size / avg_pool)))
+        for dsv in config.deep_split_values:
+            cut_labels = cutree_hybrid(
+                tree,
+                cut_points,
+                deep_split=int(dsv),
+                min_cluster_size=cut_min_size,
+                pam_stage=config.pam_stage,
+            )
+            if pool_assign is not None:
+                cut_labels = cut_labels[pool_assign]
+            key = f"deepsplit: {dsv}"
+            dynamic_labels[key] = cut_labels
+            dynamic_colors[key] = labels_to_colors(cut_labels)
+            info = {"deep_split": int(dsv),
+                    "n_clusters": int(len(set(cut_labels[cut_labels > 0].tolist())))}
+            deep_split_info.append(info)
+
+    if config.compat.return_silhouette:
+        with timer.stage("silhouette"):
+            for info, dsv in zip(deep_split_info, config.deep_split_values):
+                key = f"deepsplit: {dsv}"
+                lab = dynamic_labels[key]
+                si, _per = mean_cluster_silhouette(
+                    embedding, np.where(lab > 0, lab, -1)
+                )
+                info["silhouette"] = si
+
+    with timer.stage("nodg"):
+        # per-cell number of detected genes; the reference's O(N·G)
+        # interpreted loop (R/reclusterDEConsensus.R:272-275) is one reduction
+        nodg = (data > 0).sum(axis=0).astype(np.int64)
+
+    union_names = (
+        np.asarray(gene_names)[union] if gene_names is not None else union.copy()
+    )
+
+    result = ReclusterResult(
+        de_gene_union=union_names,
+        de_gene_union_idx=union,
+        cell_tree=tree,
+        dynamic_colors=dynamic_colors,
+        dynamic_labels=dynamic_labels,
+        deep_split_info=deep_split_info,
+        nodg=nodg,
+        embedding=embedding,
+        de=de_res,
+        metrics=timer.as_dict(),
+    )
+
+    if config.plot_name:
+        with timer.stage("report"):
+            from scconsensus_tpu.report.de_heatmap import cell_type_de_plot
+
+            cell_type_de_plot(
+                data_matrix=data[union],
+                nodg=nodg,
+                cell_tree=tree,
+                cluster_labels=np.asarray(labels).astype(str),
+                dynamic_colors_list=dynamic_colors,
+                gene_labels=union_names.astype(str),
+                filename=config.plot_name,
+            )
+    return result
+
+
+def recluster_de_consensus(
+    data_matrix: np.ndarray,
+    consensus_cluster_labels: Sequence,
+    method: str = "Wilcoxon",
+    mean_scaling_factor: float = 5.0,
+    q_val_thrs: float = 0.01,
+    fc_thrs: float = 2.0,
+    deep_split_values: Sequence[int] = (1, 2, 3, 4),
+    min_cluster_size: int = 10,
+    gene_names: Optional[Sequence[str]] = None,
+    plot_name: Optional[str] = None,
+    compat: Optional[CompatFlags] = None,
+    **kw,
+) -> ReclusterResult:
+    """Reference-shaped slow path (R/reclusterDEConsensus.R:20-29).
+
+    ``method``: 'Wilcoxon' or 'edgeR' (case as in the reference). ``fc_thrs``
+    is a ratio; the DE criterion uses log(fc_thrs) (natural log).
+    """
+    method_map = {"wilcoxon": "wilcoxon", "edger": "edger"}
+    m = method_map.get(method.lower())
+    if m is None:
+        raise ValueError(f"Incorrect method chosen: {method!r} (Wilcoxon|edgeR)")
+    config = ReclusterConfig(
+        method=m,
+        q_val_thrs=q_val_thrs,
+        log_fc_thrs=math.log(fc_thrs),
+        mean_scaling_factor=mean_scaling_factor,
+        deep_split_values=tuple(int(v) for v in deep_split_values),
+        min_cluster_size=min_cluster_size,
+        plot_name=plot_name,
+        compat=compat or CompatFlags(),
+        **kw,
+    )
+    return refine(data_matrix, consensus_cluster_labels, config, gene_names)
+
+
+def recluster_de_consensus_fast(
+    data_matrix: np.ndarray,
+    consensus_cluster_labels: Sequence,
+    method: str = "wilcox",
+    q_val_thrs: float = 0.1,
+    log_fc_thrs: float = 0.5,
+    deep_split_values: Sequence[int] = (1, 2, 3, 4),
+    min_cluster_size: int = 10,
+    min_per_cent: float = 20.0,
+    number_top_de_genes: int = 30,
+    gene_names: Optional[Sequence[str]] = None,
+    plot_name: Optional[str] = None,
+    compat: Optional[CompatFlags] = None,
+    **kw,
+) -> ReclusterResult:
+    """Reference-shaped fast path (R/reclusterDEConsensusFast.R:22-33).
+
+    Replaces the doParallel fan-out with the batched device engine; ``nCores``
+    has no equivalent (parallelism is the engine's property, SURVEY.md §7).
+    ``method``: wilcox | bimod | roc | t (Seurat test names).
+    """
+    config = ReclusterConfig(
+        method=method.lower(),
+        q_val_thrs=q_val_thrs,
+        log_fc_thrs=log_fc_thrs,
+        deep_split_values=tuple(int(v) for v in deep_split_values),
+        min_cluster_size=min_cluster_size,
+        min_pct=min_per_cent,
+        n_top_de_genes=number_top_de_genes,
+        plot_name=plot_name,
+        compat=compat or CompatFlags(),
+        **kw,
+    )
+    return refine(data_matrix, consensus_cluster_labels, config, gene_names)

@@ -1,0 +1,19 @@
+"""Batched statistical / linear-algebra kernels (the framework's "ops" layer).
+
+Everything here is shape-static, mask-based, and jit/vmap-friendly: ragged
+cluster sizes are handled with validity masks, never dynamic shapes, so XLA
+can tile the work onto the TPU's MXU/VPU (SURVEY.md §7 design stance).
+"""
+
+from scconsensus_tpu.ops.ranks import masked_midranks, rank_sum_groups
+from scconsensus_tpu.ops.multipletests import bh_adjust, bh_adjust_masked
+from scconsensus_tpu.ops.wilcoxon import wilcoxon_from_ranks, wilcoxon_exact_host
+
+__all__ = [
+    "masked_midranks",
+    "rank_sum_groups",
+    "bh_adjust",
+    "bh_adjust_masked",
+    "wilcoxon_from_ranks",
+    "wilcoxon_exact_host",
+]

@@ -1,0 +1,65 @@
+"""Cell–cell distances as sharded/blocked matmuls.
+
+Replaces ``stats::dist`` (euclidean, R/reclusterDEConsensus.R:236) and the
+commented-out Pearson alternative (:238-239) that BASELINE.json's north star
+names. The N×N matrix is never required in one piece: consumers (silhouette,
+tree-cut core scatter, linkage argmins) stream row-blocks, the ring pattern
+that scales across ICI for large N (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "euclidean_distance_matrix",
+    "pearson_distance_matrix",
+    "distance_row_blocks",
+]
+
+
+@jax.jit
+def _sq_dists(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(Na, Nb) squared euclidean distances — ‖a‖² + ‖b‖² − 2ab^T."""
+    a2 = jnp.sum(a * a, axis=1, keepdims=True)
+    b2 = jnp.sum(b * b, axis=1, keepdims=True)
+    sq = a2 + b2.T - 2.0 * (a @ b.T)
+    return jnp.maximum(sq, 0.0)
+
+
+def euclidean_distance_matrix(x: jnp.ndarray) -> jnp.ndarray:
+    """Full (N, N) euclidean distance matrix (use only when N² fits in HBM;
+    26k cells ≈ 2.7 GB fp32 — fine on one v5e core)."""
+    d = jnp.sqrt(_sq_dists(x, x))
+    # exact zero diagonal despite fp cancellation
+    return d * (1.0 - jnp.eye(x.shape[0], dtype=x.dtype))
+
+
+@jax.jit
+def pearson_distance_matrix(cols: jnp.ndarray) -> jnp.ndarray:
+    """1 − Pearson correlation between columns of ``cols`` (genes × cells) —
+    the reference's commented-out alternative distance
+    (R/reclusterDEConsensus.R:238-239), kept as a first-class option."""
+    x = cols - jnp.mean(cols, axis=0, keepdims=True)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=0, keepdims=True))
+    xn = x / jnp.maximum(norm, 1e-12)
+    return 1.0 - xn.T @ xn
+
+
+def distance_row_blocks(
+    x: np.ndarray, block: int = 4096
+) -> Iterator[Tuple[int, int, np.ndarray]]:
+    """Stream (start, stop, D[start:stop, :]) euclidean row-blocks of the
+    distance matrix without materializing N×N on host at once."""
+    jx = jnp.asarray(x)
+    n = x.shape[0]
+    for s in range(0, n, block):
+        e = min(s + block, n)
+        d = np.array(jnp.sqrt(_sq_dists(jx[s:e], jx)))  # writable host copy
+        d[np.arange(e - s), np.arange(s, e)] = 0.0  # exact zero self-distance
+        yield s, e, d

@@ -1,0 +1,74 @@
+"""Benjamini–Hochberg adjustment, batched and mask-aware.
+
+Matches R ``p.adjust(method="BH")`` including the explicit-``n`` form the
+reference uses (``n = nrow(cellDatai)``, R/reclusterDEConsensus.R:117-121)
+and the fast path's adjust-over-survivors form
+(R/reclusterDEConsensusFast.R:347-350) via ``bh_adjust_masked``.
+
+Computed in log-space so p-values far below float32's subnormal range keep
+their ordering on device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["bh_adjust", "bh_adjust_masked"]
+
+
+def _bh_1d(logp: jnp.ndarray, mask: jnp.ndarray, n_override: Optional[jnp.ndarray]):
+    m = logp.shape[0]
+    big = jnp.float32(jnp.inf)
+    lp = jnp.where(mask, logp, big)
+    order = jnp.argsort(lp)  # ascending p
+    lp_sorted = lp[order]
+    n_valid = jnp.sum(mask)
+    n = n_valid if n_override is None else n_override
+    rank = jnp.arange(1, m + 1, dtype=jnp.float32)
+    adj = lp_sorted + jnp.log(n.astype(jnp.float32)) - jnp.log(rank)
+    # Cumulative min from the right (over valid entries; inf padding is inert).
+    adj_rev_cummin = jax.lax.cummin(adj[::-1])[::-1]
+    adj_rev_cummin = jnp.minimum(adj_rev_cummin, 0.0)  # cap q at 1
+    out = jnp.full(m, big).at[order].set(adj_rev_cummin)
+    return jnp.where(mask, out, jnp.nan)
+
+
+def bh_adjust(logp: jnp.ndarray, n: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """BH-adjust log p-values along the last axis. ``n`` overrides the
+    multiplicity count (R's explicit-n quirk); default = #finite entries.
+    Returns log q-values."""
+    mask = jnp.isfinite(logp)
+    return _bh_vmapped(logp, mask, _broadcast_n(n, logp))
+
+
+def bh_adjust_masked(
+    logp: jnp.ndarray, mask: jnp.ndarray, n: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
+    """BH over only the ``mask``-selected entries (fast-path semantics:
+    adjust across surviving features). Masked-out entries return NaN."""
+    mask = mask & jnp.isfinite(logp)
+    return _bh_vmapped(logp, mask, _broadcast_n(n, logp))
+
+
+def _broadcast_n(n, logp):
+    if n is None:
+        return None
+    n = jnp.asarray(n)
+    if n.ndim == 0 and logp.ndim > 1:
+        n = jnp.broadcast_to(n, logp.shape[:-1])
+    return n
+
+
+def _bh_vmapped(logp, mask, n):
+    if logp.ndim == 1:
+        return _bh_1d(logp, mask, n)
+    flat_lp = logp.reshape(-1, logp.shape[-1])
+    flat_mask = mask.reshape(-1, logp.shape[-1])
+    if n is None:
+        out = jax.vmap(lambda a, b: _bh_1d(a, b, None))(flat_lp, flat_mask)
+    else:
+        out = jax.vmap(_bh_1d)(flat_lp, flat_mask, n.reshape(-1))
+    return out.reshape(logp.shape)

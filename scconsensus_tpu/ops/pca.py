@@ -1,0 +1,55 @@
+"""Truncated PCA via randomized subspace iteration — matmul-only, MXU-native.
+
+Replaces ``irlba::prcomp_irlba(x, n=min(|U|,15), center=TRUE, scale.=FALSE)``
+(R/reclusterDEConsensus.R:234, R/reclusterDEConsensusFast.R:398). Lanczos
+recurrences are latency-bound on TPU; randomized subspace iteration is pure
+matmuls and converges to the same leading subspace (power iterations with QR
+re-orthogonalization; Halko et al. 2011).
+
+Signs of components are arbitrary (as with irlba); downstream consumers
+(euclidean distance, Ward linkage) are sign-invariant.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["pca_scores"]
+
+
+@partial(jax.jit, static_argnames=("n_components", "n_oversample", "n_iter"))
+def pca_scores(
+    x: jnp.ndarray,
+    n_components: int,
+    n_oversample: int = 10,
+    n_iter: int = 4,
+    seed: int = 0,
+) -> jnp.ndarray:
+    """Principal-component scores of the rows of ``x``.
+
+    Args:
+      x: (N, F) matrix (cells × DE-gene union), centered internally per column.
+      n_components: number of PCs (reference: min(|union|, 15)).
+
+    Returns (N, n_components) scores = centered x projected onto the top PCs,
+    matching ``prcomp_irlba(...)$x`` up to column signs.
+    """
+    n, f = x.shape
+    k = min(n_components + n_oversample, f, n)
+    xc = x - jnp.mean(x, axis=0, keepdims=True)
+    omega = jax.random.normal(jax.random.PRNGKey(seed), (f, k), dtype=x.dtype)
+    y = xc @ omega                       # (N, k)
+    q, _ = jnp.linalg.qr(y)
+    for _ in range(n_iter):
+        z = xc.T @ q                     # (F, k)
+        w, _ = jnp.linalg.qr(z)
+        y = xc @ w                       # (N, k)
+        q, _ = jnp.linalg.qr(y)
+    b = q.T @ xc                         # (k, F)
+    _, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    scores = xc @ vt[:n_components].T    # (N, n_components)
+    del s
+    return scores

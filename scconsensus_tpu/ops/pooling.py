@@ -1,0 +1,78 @@
+"""Centroid pre-pooling for approximate hierarchical clustering at scale.
+
+The reference's scaling wall is the dense N×N distance + O(N²) Ward linkage
+(R/reclusterDEConsensus.R:236-246): impossible at N=1M (SURVEY.md §5.7). The
+approximate path pools cells onto m ≪ N centroids with device k-means
+(matmul-dominated Lloyd iterations — MXU work), runs exact Ward.D2 on the
+centroids, and broadcasts cut labels back through the pool assignment —
+the Secuer-style anchor strategy (PAPERS.md) realized on TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scconsensus_tpu.ops.linkage import HClustTree, ward_linkage
+
+__all__ = ["kmeans_pool", "pooled_ward_linkage"]
+
+
+@partial(jax.jit, static_argnames=("n_iter",))
+def _lloyd(points: jnp.ndarray, centroids: jnp.ndarray, n_iter: int = 10):
+    """Lloyd iterations; returns (centroids, assignment)."""
+
+    def step(cent, _):
+        d = (
+            jnp.sum(points * points, axis=1, keepdims=True)
+            - 2.0 * points @ cent.T
+            + jnp.sum(cent * cent, axis=1)[None, :]
+        )
+        assign = jnp.argmin(d, axis=1)
+        oh = jax.nn.one_hot(assign, cent.shape[0], dtype=points.dtype)
+        counts = jnp.sum(oh, axis=0)
+        sums = oh.T @ points
+        new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], cent)
+        return new, None
+
+    cent, _ = jax.lax.scan(step, centroids, None, length=n_iter)
+    d = (
+        jnp.sum(points * points, axis=1, keepdims=True)
+        - 2.0 * points @ cent.T
+        + jnp.sum(cent * cent, axis=1)[None, :]
+    )
+    return cent, jnp.argmin(d, axis=1)
+
+
+def kmeans_pool(
+    x: np.ndarray, n_centroids: int, n_iter: int = 10, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pool rows of x (N, d) onto ``n_centroids`` k-means centroids.
+    Returns (centroids (m, d), assignment (N,)); empty centroids are dropped."""
+    n = x.shape[0]
+    m = min(n_centroids, n)
+    rng = np.random.default_rng(seed)
+    init = x[rng.choice(n, size=m, replace=False)]
+    cent, assign = _lloyd(jnp.asarray(x, jnp.float32), jnp.asarray(init, jnp.float32), n_iter=n_iter)
+    cent = np.asarray(cent, np.float64)
+    assign = np.asarray(assign)
+    used = np.unique(assign)
+    remap = -np.ones(m, np.int64)
+    remap[used] = np.arange(used.size)
+    return cent[used], remap[assign]
+
+
+def pooled_ward_linkage(
+    x: np.ndarray, n_centroids: int = 4096, n_iter: int = 10, seed: int = 0
+) -> Tuple[HClustTree, np.ndarray, np.ndarray]:
+    """Ward tree over k-means centroids, weighted by pool occupancy so heights
+    approximate full-data Ward.D2. Returns (tree, assignment (N,), centroids).
+    Cut labels computed on the tree apply to cells via ``labels[assign]``."""
+    cent, assign = kmeans_pool(x, n_centroids, n_iter, seed)
+    counts = np.bincount(assign, minlength=cent.shape[0]).astype(np.float64)
+    tree = ward_linkage(cent, weights=counts)
+    return tree, assign, cent

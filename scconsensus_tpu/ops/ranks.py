@@ -1,0 +1,78 @@
+"""Batched midrank computation with tie statistics.
+
+TPU-native replacement for the reference's per-gene interpreted-R ranking
+inside ``wilcox.test`` loops (R/reclusterDEConsensus.R:90-106,
+R/reclusterDEConsensusFast.R:78-91 — ≈3.5M individual calls on 26k PBMC).
+Here one `vmap`'d sort ranks a whole (genes × cells) block at once.
+
+Ties are resolved to midranks exactly as R's ``rank()``: every member of a
+tie run gets the average of the ranks the run spans. Tie sizes also feed the
+variance correction Σ(t³−t) used by the normal-approximation Wilcoxon test.
+
+Invalid (padded) entries are sorted to the end via +inf and excluded from the
+tie statistics, so ragged cluster pairs batch with static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["masked_midranks", "rank_sum_groups"]
+
+_BIG = jnp.inf
+
+
+def _midranks_1d(values: jnp.ndarray, mask: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Midranks of the valid entries of one row.
+
+    Returns (ranks, tie_sum): ranks[i] is the 1-based midrank of values[i]
+    among valid entries (0 where invalid); tie_sum = Σ over tie runs of
+    (t³ − t), the R ``NTIES`` correction term.
+    """
+    n = values.shape[0]
+    v = jnp.where(mask, values, _BIG)
+    order = jnp.argsort(v)
+    sv = v[order]
+    pos = jnp.arange(n)
+    # First/last occurrence of each sorted value -> tie-run extent.
+    first = jnp.searchsorted(sv, sv, side="left")
+    last = jnp.searchsorted(sv, sv, side="right") - 1
+    midrank_sorted = 0.5 * (first + last).astype(jnp.float32) + 1.0
+    valid_sorted = mask[order]
+    # Σ(t³−t) = Σ_elements (t²−1), t = element's run size; padded runs excluded.
+    t = (last - first + 1).astype(jnp.float32)
+    tie_sum = jnp.sum(jnp.where(valid_sorted, t * t - 1.0, 0.0))
+    ranks = jnp.zeros(n, jnp.float32).at[order].set(
+        jnp.where(valid_sorted, midrank_sorted, 0.0)
+    )
+    return ranks, tie_sum
+
+
+# (B, n) batched over rows.
+masked_midranks = jax.vmap(_midranks_1d, in_axes=(0, 0), out_axes=(0, 0))
+
+
+def rank_sum_groups(
+    values: jnp.ndarray, group1_mask: jnp.ndarray, group2_mask: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Rank-sum of group 1 within the union of both groups, batched over rows.
+
+    Args:
+      values: (B, n) data rows (e.g. genes × pair-cells).
+      group1_mask / group2_mask: (B, n) or (n,) boolean membership; disjoint.
+
+    Returns:
+      (rank_sum_1, tie_sum): (B,) each. rank_sum_1 is Σ of midranks of group-1
+      entries among the pooled valid entries — R's ``sum(r[seq_along(x)])``.
+    """
+    if group1_mask.ndim == 1:
+        group1_mask = jnp.broadcast_to(group1_mask, values.shape)
+    if group2_mask.ndim == 1:
+        group2_mask = jnp.broadcast_to(group2_mask, values.shape)
+    pooled = group1_mask | group2_mask
+    ranks, tie_sum = masked_midranks(values, pooled)
+    rs1 = jnp.sum(jnp.where(group1_mask, ranks, 0.0), axis=-1)
+    return rs1, tie_sum

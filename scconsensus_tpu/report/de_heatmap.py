@@ -1,0 +1,141 @@
+"""DE-gene heatmap report (``cellTypeDEPlot`` equivalent).
+
+Matplotlib reproduction of R/cellTypeDEPlot.R:17-293: genes × cells expression
+heatmap of the DE-gene union with columns in dendrogram order, stacked
+annotations (per-consensus-cluster one-hot black/white bars, one color bar per
+deepSplit cut, a NODG barplot), and the reference's three ramp schemes
+(blue / green / violet). The reference's O(N·(K+D)) element-naming loop
+(:116-136) is replaced by vectorized index mapping.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["cell_type_de_plot", "COLOR_SCHEMES"]
+
+# circlize::colorRamp2 stop sets (R/cellTypeDEPlot.R:173-222).
+COLOR_SCHEMES = {
+    "blue": ["#FFFFFF", "#BDD7E7", "#6BAED6", "#3182BD", "#08519C"],
+    "green": ["#FFFFFF", "#BAE4B3", "#74C476", "#31A354", "#006D2C"],
+    "violet": ["#FFFFFF", "#CBC9E2", "#9E9AC8", "#756BB1", "#54278F"],
+}
+
+_R_COLOR_FALLBACKS = {
+    "grey60": "#999999",
+    "lightcyan1": "#E0FFFF",
+    "sienna3": "#CD6839",
+    "skyblue3": "#6CA6CD",
+    "plum1": "#FFBBFF",
+    "plum2": "#EEAEEE",
+    "orangered4": "#8B2500",
+    "mediumpurple3": "#8968CD",
+    "lightsteelblue1": "#CAE1FF",
+    "darkorange2": "#EE7600",
+    "brown4": "#8B2323",
+    "bisque4": "#8B7D6B",
+    "thistle2": "#EED2EE",
+}
+
+
+def _to_mpl_color(name: str):
+    from matplotlib.colors import to_rgba
+
+    base = name.split(".")[0]  # cycled palette suffix
+    if base in _R_COLOR_FALLBACKS:
+        return to_rgba(_R_COLOR_FALLBACKS[base])
+    try:
+        return to_rgba(base)
+    except ValueError:
+        return to_rgba("grey")
+
+
+def cell_type_de_plot(
+    data_matrix: np.ndarray,
+    nodg: np.ndarray,
+    cell_tree,
+    cluster_labels: Sequence[str],
+    dynamic_colors_list: Dict[str, np.ndarray],
+    gene_labels: Optional[Sequence[str]] = None,
+    col_scheme: str = "violet",
+    filename: str = "DE_Heatmap.png",
+    max_cells_rendered: int = 4000,
+) -> None:
+    """Render the DE heatmap report.
+
+    data_matrix: (|U|, N) expression of the DE-gene union;
+    cell_tree: HClustTree whose ``order`` sets the column order;
+    dynamic_colors_list: {"deepsplit: k": color-name per cell}.
+
+    Columns are downsampled (in dendrogram order) past ``max_cells_rendered``
+    — the reference rasterizes a 50×50-inch PDF instead (:250-258).
+    """
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+    from matplotlib.colors import LinearSegmentedColormap
+
+    if col_scheme not in COLOR_SCHEMES:
+        raise ValueError(f"col_scheme must be one of {sorted(COLOR_SCHEMES)}")
+    order = np.asarray(cell_tree.order)
+    n = order.size
+    if n > max_cells_rendered:
+        sel = order[np.linspace(0, n - 1, max_cells_rendered).astype(int)]
+    else:
+        sel = order
+    mat = np.asarray(data_matrix)[:, sel]
+    labels = np.asarray(cluster_labels).astype(str)[sel]
+    nodg_o = np.asarray(nodg)[sel]
+
+    uniq_clusters = sorted(set(labels.tolist()))
+    n_k = len(uniq_clusters)
+    n_ds = len(dynamic_colors_list)
+
+    heights = [1.2] + [0.25] * n_k + [0.4] * n_ds + [8.0]
+    fig_h = min(4 + 0.25 * n_k + 0.4 * n_ds + 0.12 * mat.shape[0], 60)
+    fig, axes = plt.subplots(
+        len(heights), 1, figsize=(16, fig_h),
+        gridspec_kw={"height_ratios": heights, "hspace": 0.05},
+    )
+
+    ax = axes[0]  # NODG barplot (reference :153-166)
+    ax.bar(np.arange(sel.size), nodg_o, width=1.0, color="#444444")
+    ax.set_xlim(-0.5, sel.size - 0.5)
+    ax.set_ylabel("NODG", fontsize=8)
+    ax.tick_params(labelbottom=False, bottom=False)
+
+    for i, cl in enumerate(uniq_clusters):  # one-hot bars (:53-95)
+        ax = axes[1 + i]
+        member = (labels == cl).astype(float)[None, :]
+        ax.imshow(member, aspect="auto", cmap="binary", vmin=0, vmax=1,
+                  interpolation="nearest")
+        ax.set_ylabel(cl, rotation=0, ha="right", va="center", fontsize=7)
+        ax.set_xticks([]); ax.set_yticks([])
+
+    for j, (key, colors) in enumerate(dynamic_colors_list.items()):  # (:144-147)
+        ax = axes[1 + n_k + j]
+        rgba = np.array([_to_mpl_color(c) for c in np.asarray(colors)[sel]])
+        ax.imshow(rgba[None, :, :], aspect="auto", interpolation="nearest")
+        ax.set_ylabel(key, rotation=0, ha="right", va="center", fontsize=7)
+        ax.set_xticks([]); ax.set_yticks([])
+
+    ax = axes[-1]  # main heatmap
+    vmax = np.percentile(mat, 99.0) if mat.size else 1.0
+    cmap = LinearSegmentedColormap.from_list(
+        f"scc_{col_scheme}", COLOR_SCHEMES[col_scheme]
+    )
+    ax.imshow(mat, aspect="auto", cmap=cmap, vmin=0, vmax=max(vmax, 1e-6),
+              interpolation="nearest")
+    ax.set_xticks([])
+    if gene_labels is not None and len(gene_labels) <= 120:
+        ax.set_yticks(range(len(gene_labels)), labels=list(gene_labels), fontsize=5)
+    else:
+        ax.set_yticks([])
+    ax.set_ylabel(f"{mat.shape[0]} DE genes", fontsize=9)
+
+    fig.suptitle("DE gene expression (columns in dendrogram order)", fontsize=12)
+    fig.savefig(filename, dpi=120, bbox_inches="tight")
+    plt.close(fig)

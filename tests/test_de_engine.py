@@ -1,0 +1,146 @@
+"""All-pairs DE engine tests: brute-force scipy cross-check + gate semantics."""
+
+import numpy as np
+import pytest
+import scipy.stats as sps
+
+from scconsensus_tpu.config import ReclusterConfig
+from scconsensus_tpu.de import de_gene_union, filter_clusters, pairwise_de
+from scconsensus_tpu.utils import synthetic_scrna
+
+
+class TestFilterClusters:
+    def test_strictly_greater_and_grey(self):
+        labels = ["a"] * 10 + ["b"] * 11 + ["grey"] * 50 + ["lightgrey2"] * 40
+        names, idx = filter_clusters(labels, min_cluster_size=10)
+        assert names == ["b"]  # 'a' has exactly 10 cells -> dropped (§2d-7)
+        assert (idx[:10] == -1).all() and (idx[10:21] == 0).all()
+        assert (idx[21:] == -1).all()  # grey-containing labels dropped
+
+    def test_keep_grey_flag(self):
+        labels = ["grey"] * 20 + ["b"] * 20
+        names, _ = filter_clusters(labels, 10, drop_grey=False)
+        assert names == ["b", "grey"]
+
+
+class TestPairwiseDEFast:
+    @pytest.fixture(scope="class")
+    def small_case(self):
+        data, truth, markers = synthetic_scrna(
+            n_genes=120, n_cells=260, n_clusters=3, n_markers_per_cluster=25,
+            marker_log_fc=2.5, seed=3,
+        )
+        labels = np.array([f"c{v}" for v in truth])
+        cfg = ReclusterConfig(method="wilcox", q_val_thrs=0.05, log_fc_thrs=0.25,
+                              min_pct=10.0)
+        res = pairwise_de(data, labels, cfg)
+        return data, labels, markers, cfg, res
+
+    def test_shapes_and_pairs(self, small_case):
+        data, labels, markers, cfg, res = small_case
+        assert res.cluster_names == ["c0", "c1", "c2"]
+        assert res.n_pairs == 3
+        assert res.log_p.shape == (3, data.shape[0])
+
+    def test_pvalues_match_scipy_per_pair(self, small_case):
+        data, labels, markers, cfg, res = small_case
+        # brute force: for tested genes, p must match R-style asymptotic MWU
+        for p in range(res.n_pairs):
+            a = res.cluster_names[res.pair_i[p]]
+            b = res.cluster_names[res.pair_j[p]]
+            ca = np.nonzero(labels == a)[0]
+            cb = np.nonzero(labels == b)[0]
+            genes = np.nonzero(res.tested[p])[0][:15]
+            for g in genes:
+                x, y = data[g, ca], data[g, cb]
+                if np.ptp(np.r_[x, y]) == 0:
+                    continue
+                ref = sps.mannwhitneyu(
+                    x.astype(np.float64), y.astype(np.float64),
+                    alternative="two-sided", method="asymptotic",
+                    use_continuity=True,
+                )
+                got = np.exp(res.log_p[p, g])
+                np.testing.assert_allclose(got, ref.pvalue, rtol=5e-3)
+
+    def test_markers_recovered(self, small_case):
+        data, labels, markers, cfg, res = small_case
+        union = de_gene_union(res, n_top=30)
+        # planted markers should dominate the DE union
+        planted = set(np.nonzero(markers.any(axis=0))[0].tolist())
+        assert len(planted & set(union.tolist())) > 0.5 * len(union)
+        # non-marker genes should rarely be DE
+        de_any = set(np.nonzero(res.de_mask.any(axis=0))[0].tolist())
+        false_pos = de_any - planted
+        assert len(false_pos) <= 0.1 * max(len(de_any), 1)
+
+    def test_gate_masks_tested(self, small_case):
+        data, labels, markers, cfg, res = small_case
+        # untested genes must have NaN q and never be DE
+        assert np.isnan(res.log_q[~res.tested]).all()
+        assert not res.de_mask[~res.tested].any()
+        # pct in [0, 100]
+        assert (res.pct1 >= 0).all() and (res.pct1 <= 100).all()
+
+
+class TestSlowPathSemantics:
+    def test_all_genes_tested_and_explicit_n(self):
+        data, truth, _ = synthetic_scrna(
+            n_genes=60, n_cells=150, n_clusters=2, n_markers_per_cluster=10, seed=5
+        )
+        labels = np.array([f"c{v}" for v in truth])
+        cfg = ReclusterConfig.slow_path_preset(q_val_thrs=0.05, fc_thrs=1.5,
+                                               method="wilcoxon")
+        res = pairwise_de(data, labels, cfg)
+        assert res.tested.all()
+        # explicit-n BH: q = BH(p, n=G) for each pair
+        finite = ~np.isnan(res.log_p[0])
+        p = np.exp(res.log_p[0][finite].astype(np.float64))
+        o = np.argsort(p)
+        n = data.shape[0]
+        ranks = np.arange(1, p.size + 1)
+        expect = np.minimum.accumulate((p[o] * n / ranks)[::-1])[::-1]
+        got = np.exp(res.log_q[0][finite][o])
+        np.testing.assert_allclose(got, np.minimum(expect, 1), rtol=1e-3)
+
+    def test_too_few_clusters_raises(self):
+        data = np.random.default_rng(0).random((10, 30)).astype(np.float32)
+        labels = ["a"] * 30
+        with pytest.raises(ValueError):
+            pairwise_de(data, labels, ReclusterConfig())
+
+
+class TestExactBranch:
+    def test_small_tie_free_pairs_use_exact(self):
+        rng = np.random.default_rng(7)
+        # 2 clusters of 15 cells, continuous data -> no ties -> exact branch
+        data = rng.normal(size=(20, 30)).astype(np.float32)
+        labels = np.array(["a"] * 15 + ["b"] * 15)
+        cfg = ReclusterConfig(method="wilcox", min_pct=-1.0, log_fc_thrs=0.0,
+                              min_cluster_size=5, mean_exprs_thrs=-1.0)
+        res = pairwise_de(data, labels, cfg)
+        for g in range(10):
+            ref = sps.mannwhitneyu(
+                data[g, :15].astype(np.float64), data[g, 15:].astype(np.float64),
+                alternative="two-sided", method="exact",
+            )
+            got = np.exp(res.log_p[0, g])
+            np.testing.assert_allclose(got, ref.pvalue, rtol=1e-5)
+
+
+def test_de_gene_union_top_n():
+    # construct a fake result with known fold changes
+    from scconsensus_tpu.de.engine import PairwiseDEResult
+
+    G = 10
+    de = np.zeros((1, G), bool)
+    de[0, :6] = True
+    fc = np.zeros((1, G), np.float32)
+    fc[0, :6] = [0.1, 0.9, 0.5, 0.8, 0.2, 0.7]
+    res = PairwiseDEResult(
+        cluster_names=["a", "b"], pair_i=np.array([0]), pair_j=np.array([1]),
+        log_p=np.zeros((1, G), np.float32), log_q=np.zeros((1, G), np.float32),
+        log_fc=fc, tested=de, de_mask=de,
+    )
+    union = de_gene_union(res, n_top=3)
+    assert set(union.tolist()) == {1, 3, 5}  # largest |fc|

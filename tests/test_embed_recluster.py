@@ -1,0 +1,215 @@
+"""Embed + recluster kernel tests: PCA vs exact SVD, distance vs scipy,
+Ward linkage vs scipy/fastcluster semantics, silhouette vs sklearn,
+hybrid tree cut behavioral fidelity."""
+
+import numpy as np
+import pytest
+import scipy.cluster.hierarchy as sch
+import scipy.spatial.distance as ssd
+from sklearn.metrics import adjusted_rand_score, silhouette_samples
+
+import jax.numpy as jnp
+
+from scconsensus_tpu.ops.colors import labels_to_colors
+from scconsensus_tpu.ops.distance import (
+    distance_row_blocks,
+    euclidean_distance_matrix,
+    pearson_distance_matrix,
+)
+from scconsensus_tpu.ops.linkage import cut_tree_k, ward_linkage
+from scconsensus_tpu.ops.pca import pca_scores
+from scconsensus_tpu.ops.silhouette import mean_cluster_silhouette, silhouette_widths
+from scconsensus_tpu.ops.treecut import core_size, cutree_hybrid
+
+
+def _blobs(rng, n_per=80, k=3, d=5, sep=6.0):
+    pts = []
+    labels = []
+    for c in range(k):
+        center = rng.normal(size=d) * sep
+        pts.append(center + rng.normal(size=(n_per, d)))
+        labels += [c] * n_per
+    return np.concatenate(pts).astype(np.float32), np.array(labels)
+
+
+class TestPCA:
+    def test_matches_exact_svd_subspace(self, rng):
+        x = rng.normal(size=(200, 50)).astype(np.float32)
+        # distinct per-direction variances so the top PCs are well separated
+        x[:, :5] += rng.normal(size=(200, 5)) * np.array([12, 9, 7, 5, 3.5])
+        k = 5
+        scores = np.asarray(pca_scores(jnp.asarray(x), k))
+        xc = x - x.mean(0)
+        u, s, vt = np.linalg.svd(xc.astype(np.float64), full_matrices=False)
+        exact = xc @ vt[:k].T
+        for j in range(k):
+            # same up to sign
+            dot = np.dot(scores[:, j], exact[:, j]) / (
+                np.linalg.norm(scores[:, j]) * np.linalg.norm(exact[:, j])
+            )
+            assert abs(dot) > 0.999, f"PC{j} misaligned: |cos|={abs(dot)}"
+        # variance captured matches
+        np.testing.assert_allclose(
+            np.var(scores, axis=0), np.var(exact, axis=0), rtol=1e-2
+        )
+
+    def test_k_exceeding_rank_clamped(self, rng):
+        x = rng.normal(size=(30, 4)).astype(np.float32)
+        scores = np.asarray(pca_scores(jnp.asarray(x), 4))
+        assert scores.shape == (30, 4)
+
+
+class TestDistance:
+    def test_euclidean_matches_scipy(self, rng):
+        x = rng.normal(size=(60, 7)).astype(np.float32)
+        d = np.asarray(euclidean_distance_matrix(jnp.asarray(x)))
+        ref = ssd.squareform(ssd.pdist(x.astype(np.float64)))
+        # fp32 ‖x‖²+‖y‖²−2xyᵀ cancels for near pairs: ~1e-2 abs accuracy.
+        # Consumers (silhouette, core scatter, PAM) are tolerant; Ward linkage
+        # uses float64 centroids and never reads this matrix.
+        np.testing.assert_allclose(d, ref, atol=2e-2)
+        assert (np.diag(d) == 0).all()
+
+    def test_row_blocks_consistent(self, rng):
+        x = rng.normal(size=(50, 5)).astype(np.float32)
+        full = np.asarray(euclidean_distance_matrix(jnp.asarray(x)))
+        got = np.zeros_like(full)
+        for s, e, blk in distance_row_blocks(x, block=16):
+            got[s:e] = blk
+        np.testing.assert_allclose(got, full, atol=1e-4)
+
+    def test_pearson_distance(self, rng):
+        cols = rng.normal(size=(40, 12)).astype(np.float32)
+        d = np.asarray(pearson_distance_matrix(jnp.asarray(cols)))
+        ref = 1 - np.corrcoef(cols.astype(np.float64).T)
+        np.testing.assert_allclose(d, ref, atol=5e-3)  # fp32 accumulation
+
+
+class TestWardLinkage:
+    @pytest.mark.parametrize("use_native", [False])
+    def test_heights_match_scipy(self, rng, use_native):
+        x, _ = _blobs(rng, n_per=40, k=3)
+        tree = ward_linkage(x, use_native=use_native)
+        z = sch.linkage(x.astype(np.float64), method="ward")
+        np.testing.assert_allclose(tree.height, z[:, 2], rtol=1e-6)
+
+    @pytest.mark.parametrize("k", [2, 3, 5])
+    def test_cut_matches_scipy(self, rng, k):
+        x, _ = _blobs(rng, n_per=30, k=3)
+        tree = ward_linkage(x, use_native=False)
+        ours = cut_tree_k(tree, k)
+        z = sch.linkage(x.astype(np.float64), method="ward")
+        ref = sch.fcluster(z, t=k, criterion="maxclust")
+        assert adjusted_rand_score(ours, ref) == pytest.approx(1.0)
+
+    def test_merge_structure_valid(self, rng):
+        x = rng.normal(size=(25, 3)).astype(np.float32)
+        tree = ward_linkage(x, use_native=False)
+        n = 25
+        seen_leaves = set()
+        for row in range(n - 1):
+            a, b = int(tree.merge[row, 0]), int(tree.merge[row, 1])
+            for c in (a, b):
+                if c < 0:
+                    assert -c - 1 not in seen_leaves
+                    seen_leaves.add(-c - 1)
+                else:
+                    assert c - 1 < row  # references an earlier merge only
+        assert seen_leaves == set(range(n))
+        assert (np.diff(tree.height) >= -1e-9).all()  # monotone
+        assert sorted(tree.order.tolist()) == list(range(n))
+
+
+class TestSilhouette:
+    def test_matches_sklearn(self, rng):
+        x, lab = _blobs(rng, n_per=50, k=3)
+        w = silhouette_widths(x, lab)
+        ref = silhouette_samples(x.astype(np.float64), lab)
+        # fp32 matmul-trick distances carry ~1e-2 abs error; silhouette is a
+        # quality diagnostic, not a decision path, so that accuracy is fine.
+        np.testing.assert_allclose(w, ref, atol=0.05)
+        assert abs(np.mean(w) - np.mean(ref)) < 0.01
+
+    def test_mean_cluster_silhouette_and_exclusion(self, rng):
+        x, lab = _blobs(rng, n_per=40, k=3)
+        lab2 = lab.copy()
+        lab2[:5] = -1  # excluded cells
+        si, per = mean_cluster_silhouette(x, lab2)
+        assert 0.3 < si <= 1.0
+        assert set(per) == {0, 1, 2}
+        w = silhouette_widths(x, lab2)
+        assert np.isnan(w[:5]).all()
+
+
+class TestColors:
+    def test_zero_is_grey_and_unique(self):
+        out = labels_to_colors([0, 1, 2, 3, 1, 0])
+        assert out[0] == "grey" and out[5] == "grey"
+        assert out[1] == "turquoise" and out[2] == "blue" and out[3] == "brown"
+
+    def test_cycling_beyond_palette(self):
+        out = labels_to_colors(list(range(0, 120)))
+        assert len(set(out.tolist())) == 120  # all unique incl. grey
+
+
+class TestCoreSize:
+    def test_formula(self):
+        assert core_size(4, 10) == 4  # smaller than base -> whole branch
+        assert core_size(100, 20) == int(11 + np.sqrt(89))
+
+
+class TestCutreeHybrid:
+    def test_recovers_planted_blobs(self, rng):
+        x, lab = _blobs(rng, n_per=70, k=4, sep=8.0)
+        tree = ward_linkage(x, use_native=False)
+        for ds in (0, 1, 2, 3):
+            got = cutree_hybrid(tree, x, deep_split=ds, min_cluster_size=10)
+            assigned = got > 0
+            assert assigned.mean() > 0.9, f"ds={ds}: too many unassigned"
+            ari = adjusted_rand_score(lab[assigned], got[assigned])
+            assert ari > 0.95, f"ds={ds}: ARI={ari}"
+        # deepSplit 4 may over-split Gaussian blobs (by design: most
+        # aggressive), but found clusters must stay homogeneous — each should
+        # live inside one planted blob, never straddle two.
+        got = cutree_hybrid(tree, x, deep_split=4, min_cluster_size=10)
+        for c in set(got[got > 0].tolist()):
+            members = lab[got == c]
+            top = np.bincount(members).max()
+            assert top / members.size > 0.9, f"cluster {c} straddles blobs"
+
+    def test_deepsplit_monotone_cluster_count(self, rng):
+        # hierarchical structure: 2 super-blobs each with 2 sub-blobs
+        sub = []
+        labels = []
+        for c in range(2):
+            center = rng.normal(size=6) * 14.0
+            for s in range(2):
+                sub.append(center + rng.normal(size=6) * 2.0 + rng.normal(size=(60, 6)))
+                labels += [2 * c + s] * 60
+        x = np.concatenate(sub).astype(np.float32)
+        tree = ward_linkage(x, use_native=False)
+        counts = []
+        for ds in (0, 2, 4):
+            got = cutree_hybrid(tree, x, deep_split=ds, min_cluster_size=15)
+            counts.append(len(set(got[got > 0].tolist())))
+        assert counts[0] <= counts[-1], f"counts not monotone-ish: {counts}"
+        assert counts[-1] >= 2
+
+    def test_min_cluster_size_respected(self, rng):
+        x, lab = _blobs(rng, n_per=50, k=3, sep=7.0)
+        got = cutree_hybrid(ward_linkage(x, use_native=False), x,
+                            deep_split=2, min_cluster_size=10)
+        sizes = np.bincount(got[got > 0])
+        assert (sizes[1:][sizes[1:] > 0] >= 10).all()
+
+    def test_pam_stage_assigns_everything(self, rng):
+        x, lab = _blobs(rng, n_per=60, k=3, sep=7.0)
+        tree = ward_linkage(x, use_native=False)
+        got = cutree_hybrid(tree, x, deep_split=1, min_cluster_size=10,
+                            pam_stage=True, max_pam_dist=np.inf)
+        assert (got > 0).all()
+
+    def test_bad_deepsplit_raises(self, rng):
+        x, _ = _blobs(rng, n_per=20, k=2)
+        with pytest.raises(ValueError):
+            cutree_hybrid(ward_linkage(x, use_native=False), x, deep_split=5)

@@ -1,0 +1,125 @@
+"""End-to-end integration: consensus → DE → embed → Ward → tree cut on planted
+synthetic data (SURVEY.md §4 'Integration': recovered-vs-planted ARI ≈ 1)."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import adjusted_rand_score
+
+import scconsensus_tpu as scc
+from scconsensus_tpu.utils import synthetic_scrna
+from scconsensus_tpu.utils.synthetic import noisy_labeling
+
+
+@pytest.fixture(scope="module")
+def planted():
+    data, truth, markers = synthetic_scrna(
+        n_genes=500, n_cells=600, n_clusters=4, n_markers_per_cluster=40,
+        marker_log_fc=2.5, seed=11,
+    )
+    return data, truth, markers
+
+
+@pytest.fixture(scope="module")
+def fast_result(planted):
+    data, truth, _ = planted
+    labels = np.array([f"c{t}" for t in truth])
+    return scc.recluster_de_consensus_fast(
+        data, labels, q_val_thrs=0.05, min_cluster_size=10,
+        deep_split_values=(1, 2, 3),
+    )
+
+
+class TestEndToEndFast:
+    def test_planted_structure_recovered(self, planted, fast_result):
+        data, truth, _ = planted
+        res = fast_result
+        best = 0.0
+        for key, lab in res.dynamic_labels.items():
+            m = lab > 0
+            if m.mean() < 0.5:
+                continue
+            best = max(best, adjusted_rand_score(truth[m], lab[m]))
+        assert best > 0.9, f"best ARI across deepSplits = {best}"
+
+    def test_union_is_marker_dominated(self, planted, fast_result):
+        _, _, markers = planted
+        union = fast_result.de_gene_union_idx
+        planted_set = set(np.nonzero(markers.any(axis=0))[0].tolist())
+        frac = len(planted_set & set(union.tolist())) / union.size
+        assert frac > 0.6
+
+    def test_result_fields(self, planted, fast_result):
+        data, truth, _ = planted
+        res = fast_result
+        assert res.cell_tree.n_leaves == data.shape[1]
+        assert set(res.dynamic_colors) == {f"deepsplit: {d}" for d in (1, 2, 3)}
+        assert res.nodg.shape == (data.shape[1],)
+        np.testing.assert_array_equal(res.nodg, (data > 0).sum(axis=0))
+        # silhouette returned (reference computed & dropped it, §2d-6)
+        for info in res.deep_split_info:
+            assert "silhouette" in info and -1 <= info["silhouette"] <= 1
+        # metrics include per-stage wall-clock
+        stages = [r["stage"] for r in res.metrics["stages"]]
+        assert "wilcox_test" in stages and "tree" in stages
+
+    def test_grey_cells_excluded_from_de(self, planted):
+        data, truth, _ = planted
+        labels = np.array([f"c{t}" for t in truth])
+        labels[:30] = "grey"
+        res = scc.recluster_de_consensus_fast(
+            data, labels, q_val_thrs=0.05, deep_split_values=(2,),
+        )
+        assert all(not c.startswith("grey") for c in res.de.cluster_names)
+
+
+class TestSlowPath:
+    def test_wilcoxon_slow_runs(self, planted):
+        data, truth, _ = planted
+        labels = np.array([f"c{t}" for t in truth])
+        res = scc.recluster_de_consensus(
+            data, labels, method="Wilcoxon", q_val_thrs=0.01, fc_thrs=1.5,
+            deep_split_values=(2,),
+        )
+        lab = res.dynamic_labels["deepsplit: 2"]
+        m = lab > 0
+        assert adjusted_rand_score(truth[m], lab[m]) > 0.8
+
+    def test_bad_method_raises(self, planted):
+        data, truth, _ = planted
+        labels = np.array([f"c{t}" for t in truth])
+        with pytest.raises(ValueError, match="Incorrect method"):
+            scc.recluster_de_consensus(data, labels, method="nope")
+
+
+class TestConsensusToRefinePipeline:
+    def test_full_workflow(self, tmp_path, planted):
+        data, truth, _ = planted
+        sup = noisy_labeling(truth, 0.03, n_out_clusters=3, seed=1, prefix="T")
+        uns = noisy_labeling(truth, 0.05, seed=2, prefix="L")
+        cons = scc.plot_contingency_table(
+            sup, uns, automate_consensus=True, min_clust_size=10,
+            filename=str(tmp_path / "ctg.png"),
+        )
+        res = scc.recluster_de_consensus_fast(
+            data, cons, q_val_thrs=0.05, deep_split_values=(1, 2),
+            plot_name=str(tmp_path / "de_heatmap.png"),
+        )
+        assert (tmp_path / "ctg.png").exists()
+        assert (tmp_path / "de_heatmap.png").exists()
+        best = max(
+            adjusted_rand_score(truth[lab > 0], lab[lab > 0])
+            for lab in res.dynamic_labels.values()
+        )
+        assert best > 0.8
+
+
+class TestArtifactResume:
+    def test_resume_skips_stages(self, tmp_path, planted):
+        data, truth, _ = planted
+        labels = np.array([f"c{t}" for t in truth])
+        cfg_kw = dict(q_val_thrs=0.05, deep_split_values=(2,),
+                      artifact_dir=str(tmp_path / "store"))
+        r1 = scc.recluster_de_consensus_fast(data, labels, **cfg_kw)
+        r2 = scc.recluster_de_consensus_fast(data, labels, **cfg_kw)
+        np.testing.assert_array_equal(r1.de_gene_union_idx, r2.de_gene_union_idx)
+        np.testing.assert_allclose(r1.embedding, r2.embedding, atol=1e-5)

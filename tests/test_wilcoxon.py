@@ -1,0 +1,162 @@
+"""Kernel-level golden tests: midranks, BH, Wilcoxon vs scipy/statsmodels-free
+references (SURVEY.md §4 'Unit (kernel-level)')."""
+
+import numpy as np
+import pytest
+import scipy.stats as sps
+
+import jax.numpy as jnp
+
+from scconsensus_tpu.ops import (
+    bh_adjust,
+    bh_adjust_masked,
+    masked_midranks,
+    rank_sum_groups,
+    wilcoxon_from_ranks,
+    wilcoxon_exact_host,
+)
+
+
+class TestMidranks:
+    def test_matches_scipy_rankdata_with_ties(self, rng):
+        x = rng.integers(0, 5, size=(7, 40)).astype(np.float32)
+        mask = np.ones_like(x, bool)
+        ranks, _ = masked_midranks(jnp.asarray(x), jnp.asarray(mask))
+        for i in range(x.shape[0]):
+            np.testing.assert_allclose(
+                np.asarray(ranks[i]), sps.rankdata(x[i]), rtol=1e-6
+            )
+
+    def test_masked_entries_excluded(self, rng):
+        x = rng.normal(size=(3, 20)).astype(np.float32)
+        mask = rng.random((3, 20)) < 0.6
+        ranks, tie_sum = masked_midranks(jnp.asarray(x), jnp.asarray(mask))
+        ranks = np.asarray(ranks)
+        for i in range(3):
+            sub = x[i][mask[i]]
+            expect = sps.rankdata(sub)
+            np.testing.assert_allclose(ranks[i][mask[i]], expect, rtol=1e-6)
+            assert (ranks[i][~mask[i]] == 0).all()
+        np.testing.assert_allclose(np.asarray(tie_sum), 0.0)  # continuous data
+
+    def test_tie_sum(self):
+        # values [1,1,2,2,2,3]: tie runs 2,3 -> (8-2)+(27-3)=30
+        x = jnp.asarray([[1.0, 1, 2, 2, 2, 3]])
+        _, tie_sum = masked_midranks(x, jnp.ones_like(x, bool))
+        assert float(tie_sum[0]) == 30.0
+
+
+class TestBH:
+    def test_matches_r_bh(self, rng):
+        # statsmodels-free check: R p.adjust BH == cummin(sorted p * n/rank).
+        p = rng.random(25)
+        logq = np.asarray(bh_adjust(jnp.log(p.astype(np.float32))))
+        q = np.exp(logq)
+        o = np.argsort(p)
+        expect = np.minimum.accumulate((p[o] * 25 / np.arange(1, 26))[::-1])[::-1]
+        expect = np.minimum(expect, 1.0)
+        np.testing.assert_allclose(q[o], expect, rtol=5e-4)
+
+    def test_explicit_n_quirk(self):
+        # Reference passes n = full gene count even when filtering changed
+        # (R/reclusterDEConsensus.R:117-121).
+        p = np.array([0.01, 0.02, 0.5], np.float32)
+        logq = np.asarray(bh_adjust(jnp.log(p), n=jnp.asarray(10.0)))
+        expect = np.minimum.accumulate((p * 10 / np.array([1, 2, 3]))[::-1])[::-1]
+        np.testing.assert_allclose(np.exp(logq), np.minimum(expect, 1), rtol=5e-4)
+
+    def test_masked(self, rng):
+        p = rng.random(30).astype(np.float32)
+        mask = rng.random(30) < 0.5
+        logq = np.asarray(bh_adjust_masked(jnp.log(p), jnp.asarray(mask)))
+        assert np.isnan(logq[~mask]).all()
+        sub = p[mask]
+        o = np.argsort(sub)
+        expect = np.minimum.accumulate((sub[o] * len(sub) / np.arange(1, len(sub) + 1))[::-1])[::-1]
+        np.testing.assert_allclose(np.exp(logq[mask][o]), np.minimum(expect, 1), rtol=5e-4)
+
+    def test_batched_rows(self, rng):
+        p = rng.random((4, 12)).astype(np.float32)
+        logq = np.asarray(bh_adjust(jnp.log(p)))
+        for i in range(4):
+            row = np.asarray(bh_adjust(jnp.log(p[i])))
+            np.testing.assert_allclose(logq[i], row, rtol=1e-6)
+
+
+class TestWilcoxonApprox:
+    @pytest.mark.parametrize("tied", [False, True])
+    def test_matches_scipy_asymptotic(self, rng, tied):
+        n1, n2 = 60, 85  # >= 50 -> R uses normal approx even without ties
+        for _ in range(5):
+            if tied:
+                x = rng.integers(0, 6, n1).astype(np.float64)
+                y = rng.integers(0, 6, n2).astype(np.float64)
+            else:
+                x = rng.normal(size=n1)
+                y = rng.normal(0.3, size=n2)
+            vals = jnp.asarray(np.concatenate([x, y])[None, :].astype(np.float32))
+            m1 = jnp.asarray(np.r_[np.ones(n1, bool), np.zeros(n2, bool)])
+            m2 = ~m1
+            rs1, ties = rank_sum_groups(vals, m1, m2)
+            logp, u = wilcoxon_from_ranks(
+                rs1, ties, jnp.asarray([n1]), jnp.asarray([n2])
+            )
+            ref = sps.mannwhitneyu(
+                x, y, alternative="two-sided", method="asymptotic", use_continuity=True
+            )
+            assert float(u[0]) == pytest.approx(ref.statistic)
+            np.testing.assert_allclose(
+                np.exp(float(logp[0])), ref.pvalue, rtol=2e-4
+            )
+
+    def test_degenerate_constant_gene_is_nan(self):
+        vals = jnp.ones((1, 10), jnp.float32)
+        m1 = jnp.asarray([True] * 5 + [False] * 5)
+        rs1, ties = rank_sum_groups(vals, m1, ~m1)
+        logp, _ = wilcoxon_from_ranks(rs1, ties, jnp.asarray([5]), jnp.asarray([5]))
+        assert np.isnan(float(logp[0]))
+
+
+class TestWilcoxonExact:
+    def test_matches_scipy_exact(self, rng):
+        for n1, n2 in [(5, 7), (10, 10), (20, 15)]:
+            x = rng.normal(size=n1)
+            y = rng.normal(size=n2)
+            ref = sps.mannwhitneyu(x, y, alternative="two-sided", method="exact")
+            u = ref.statistic
+            p = wilcoxon_exact_host(np.asarray([u]), n1, n2)
+            np.testing.assert_allclose(p[0], ref.pvalue, rtol=1e-10)
+
+    def test_symmetric_tails(self):
+        # U and its mirror n1*n2-U must give the same two-sided p.
+        for u in range(0, 26):
+            p1 = wilcoxon_exact_host(np.asarray([u]), 5, 5)
+            p2 = wilcoxon_exact_host(np.asarray([25 - u]), 5, 5)
+            np.testing.assert_allclose(p1, p2, rtol=1e-12)
+
+
+class TestProperties:
+    def test_pvalue_uniform_under_null(self, rng):
+        # SURVEY.md §4 property test: p under H0 approx uniform.
+        B, n1, n2 = 400, 40, 60
+        x = rng.normal(size=(B, n1 + n2)).astype(np.float32)
+        m1 = np.r_[np.ones(n1, bool), np.zeros(n2, bool)]
+        rs1, ties = rank_sum_groups(jnp.asarray(x), jnp.asarray(m1), jnp.asarray(~m1))
+        logp, _ = wilcoxon_from_ranks(
+            rs1, ties, jnp.full(B, n1), jnp.full(B, n2)
+        )
+        p = np.exp(np.asarray(logp))
+        ks = sps.kstest(p, "uniform")
+        assert ks.pvalue > 1e-3
+
+    def test_permutation_invariance(self, rng):
+        n1, n2 = 30, 45
+        x = rng.normal(size=(1, n1 + n2)).astype(np.float32)
+        m1 = np.r_[np.ones(n1, bool), np.zeros(n2, bool)]
+        perm = rng.permutation(n1 + n2)
+        rs_a, t_a = rank_sum_groups(jnp.asarray(x), jnp.asarray(m1), jnp.asarray(~m1))
+        rs_b, t_b = rank_sum_groups(
+            jnp.asarray(x[:, perm]), jnp.asarray(m1[perm]), jnp.asarray(~m1[perm])
+        )
+        np.testing.assert_allclose(float(rs_a[0]), float(rs_b[0]), rtol=1e-6)
+        np.testing.assert_allclose(float(t_a[0]), float(t_b[0]), rtol=1e-6)
