@@ -27,6 +27,14 @@ import pytest  # noqa: E402
 # compiles; cache them across runs.
 import jax  # noqa: E402
 
+if not os.environ.get("SCC_TEST_TPU"):
+    # The env var alone is not enough: a site-level TPU plugin may already
+    # have imported jax and force-set jax_platforms via jax.config, which
+    # wins over the env var. Re-pin to CPU before any backend initializes —
+    # otherwise the whole suite silently runs through the remote-TPU tunnel
+    # (slow, single-device, and wedges on a stale device claim).
+    jax.config.update("jax_platforms", "cpu")
+
 jax.config.update("jax_compilation_cache_dir", "/tmp/scc_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
