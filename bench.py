@@ -1,0 +1,93 @@
+"""Benchmark harness: 26k-cell end-to-end refinement (the north-star config).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: BASELINE.json north star — 26k PBMC reclusterDEConsensus end-to-end
+in < 30 s (vs_baseline = 30 / measured_seconds; > 1.0 beats the target).
+
+Synthetic NB data with planted clusters stands in for the Zenodo 26k-PBMC
+dataset (no network egress). Scale knobs via env: SCC_BENCH_CELLS,
+SCC_BENCH_GENES, SCC_BENCH_CLUSTERS, SCC_BENCH_COLD=1 to report the
+cold-compile run instead of steady state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_SECONDS = 30.0
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_once(data, labels1, labels2):
+    from scconsensus_tpu import plot_contingency_table, recluster_de_consensus_fast
+
+    t0 = time.perf_counter()
+    consensus = plot_contingency_table(
+        labels1, labels2, automate_consensus=True, filename=None
+    )
+    result = recluster_de_consensus_fast(
+        data,
+        consensus,
+        method="wilcox",
+        deep_split_values=(1, 2, 3, 4),
+    )
+    t1 = time.perf_counter()
+    return t1 - t0, result
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/scc_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    n_cells = int(os.environ.get("SCC_BENCH_CELLS", 26000))
+    n_genes = int(os.environ.get("SCC_BENCH_GENES", 15000))
+    n_clusters = int(os.environ.get("SCC_BENCH_CLUSTERS", 22))
+
+    from scconsensus_tpu.utils.synthetic import noisy_labeling, synthetic_scrna
+
+    log(f"[bench] generating synthetic data: {n_genes} genes x {n_cells} cells, "
+        f"{n_clusters} planted clusters on {jax.devices()[0].platform}")
+    data, true_labels, _ = synthetic_scrna(
+        n_genes=n_genes,
+        n_cells=n_cells,
+        n_clusters=n_clusters,
+        n_markers_per_cluster=min(40, n_genes // n_clusters),
+        seed=7,
+    )
+    labels1 = noisy_labeling(true_labels, 0.05, seed=1, prefix="sup")
+    labels2 = noisy_labeling(
+        true_labels, 0.10, n_out_clusters=max(2, n_clusters - 4), seed=2, prefix="unsup"
+    )
+
+    cold_s, _ = run_once(data, labels1, labels2)
+    log(f"[bench] cold run (includes XLA compiles): {cold_s:.2f}s")
+    if os.environ.get("SCC_BENCH_COLD"):
+        elapsed = cold_s
+    else:
+        elapsed, result = run_once(data, labels1, labels2)
+        log(f"[bench] steady-state run: {elapsed:.2f}s; union="
+            f"{result.de_gene_union_idx.size} genes; "
+            f"deep_split_info={result.deep_split_info}")
+
+    print(json.dumps({
+        "metric": (
+            f"{n_cells // 1000}k" if n_cells >= 1000 else str(n_cells)
+        ) + "-cell end-to-end consensus+recluster wall-clock",
+        "value": round(elapsed, 3),
+        "unit": "seconds",
+        "vs_baseline": round(BASELINE_SECONDS / elapsed, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

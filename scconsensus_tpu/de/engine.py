@@ -32,11 +32,10 @@ from scconsensus_tpu.ops.gates import (
     pair_gates_slow,
 )
 from scconsensus_tpu.ops.multipletests import bh_adjust, bh_adjust_masked
-from scconsensus_tpu.ops.ranks import masked_midranks
 from scconsensus_tpu.ops.wilcoxon import (
     EXACT_N_LIMIT,
     wilcoxon_exact_host,
-    wilcoxon_from_ranks,
+    wilcoxon_pairs_tile,
 )
 
 __all__ = ["PairwiseDEResult", "pairwise_de", "filter_clusters", "de_gene_union"]
@@ -137,32 +136,10 @@ def _bucket_pairs(
     return buckets
 
 
-@jax.jit
-def _wilcox_chunk(
-    data_chunk: jnp.ndarray,  # (Gc, N)
-    idx: jnp.ndarray,         # (B, W)
-    m1: jnp.ndarray,          # (B, W)
-    m2: jnp.ndarray,
-    n1: jnp.ndarray,          # (B,)
-    n2: jnp.ndarray,
-):
-    """Rank-sum test for one gene-chunk × pair-bucket tile.
-
-    Returns (log_p, u_stat, tie_sum) each (B, Gc)."""
-    vals = jnp.take(data_chunk, idx, axis=1)          # (Gc, B, W)
-    vals = jnp.swapaxes(vals, 0, 1)                   # (B, Gc, W)
-    pooled = (m1 | m2)[:, None, :]                    # (B, 1, W)
-    B, Gc, W = vals.shape
-    flat = vals.reshape(B * Gc, W)
-    flat_mask = jnp.broadcast_to(pooled, (B, Gc, W)).reshape(B * Gc, W)
-    ranks, tie_sum = masked_midranks(flat, flat_mask)
-    ranks = ranks.reshape(B, Gc, W)
-    tie_sum = tie_sum.reshape(B, Gc)
-    rs1 = jnp.sum(jnp.where(m1[:, None, :], ranks, 0.0), axis=-1)  # (B, Gc)
-    log_p, u = wilcoxon_from_ranks(
-        rs1, tie_sum, n1[:, None], n2[:, None]
-    )
-    return log_p, u, tie_sum
+# Rank-sum test for one gene-chunk × pair-bucket tile; the shared
+# implementation lives in ops.wilcoxon so the sharded and fused paths
+# cannot diverge from the serial engine.
+_wilcox_chunk = jax.jit(wilcoxon_pairs_tile)
 
 
 def _run_wilcox(

@@ -20,16 +20,23 @@ __all__ = [
     "euclidean_distance_matrix",
     "pearson_distance_matrix",
     "distance_row_blocks",
+    "distance_tile",
 ]
 
 
-@jax.jit
-def _sq_dists(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """(Na, Nb) squared euclidean distances — ‖a‖² + ‖b‖² − 2ab^T."""
+def distance_tile(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(Na, Nb) euclidean distance tile — the shared kernel behind the ring
+    engine and the fused step (one MXU matmul + elementwise)."""
+    return jnp.sqrt(_sq_dists_raw(a, b))
+
+
+def _sq_dists_raw(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     a2 = jnp.sum(a * a, axis=1, keepdims=True)
     b2 = jnp.sum(b * b, axis=1, keepdims=True)
-    sq = a2 + b2.T - 2.0 * (a @ b.T)
-    return jnp.maximum(sq, 0.0)
+    return jnp.maximum(a2 + b2.T - 2.0 * (a @ b.T), 0.0)
+
+
+_sq_dists = jax.jit(_sq_dists_raw)
 
 
 def euclidean_distance_matrix(x: jnp.ndarray) -> jnp.ndarray:

@@ -23,7 +23,12 @@ import jax.numpy as jnp
 import jax.scipy.stats as jstats
 import numpy as np
 
-__all__ = ["wilcoxon_from_ranks", "wilcoxon_exact_host", "EXACT_N_LIMIT"]
+__all__ = [
+    "wilcoxon_from_ranks",
+    "wilcoxon_pairs_tile",
+    "wilcoxon_exact_host",
+    "EXACT_N_LIMIT",
+]
 
 # R: exact branch iff n.x < 50 && n.y < 50 (and no ties).
 EXACT_N_LIMIT = 50
@@ -61,6 +66,36 @@ def wilcoxon_from_ranks(
     bad = (n1 < 1) | (n2 < 1) | (sigma <= 0.0)
     log_p = jnp.where(bad, jnp.nan, log_p)
     return log_p, u
+
+
+def wilcoxon_pairs_tile(
+    data_chunk: "jnp.ndarray",  # (Gc, N) gene-chunk of the expression matrix
+    idx: "jnp.ndarray",         # (B, W) gather indices of each pair's cells
+    m1: "jnp.ndarray",          # (B, W) group-1 membership among gathered cells
+    m2: "jnp.ndarray",
+    n1: "jnp.ndarray",          # (B,) group sizes
+    n2: "jnp.ndarray",
+):
+    """Rank-sum test for one (gene-chunk × pair-bucket) tile.
+
+    The single implementation behind the serial engine, the gene-sharded
+    path, and the fused step (no collectives inside — safe under shard_map).
+    Returns (log_p, u, tie_sum): (B, Gc), (B, Gc), (B, Gc).
+    """
+    from scconsensus_tpu.ops.ranks import masked_midranks
+
+    vals = jnp.take(data_chunk, idx, axis=1)          # (Gc, B, W)
+    vals = jnp.swapaxes(vals, 0, 1)                   # (B, Gc, W)
+    pooled = (m1 | m2)[:, None, :]                    # (B, 1, W)
+    B, Gc, W = vals.shape
+    flat = vals.reshape(B * Gc, W)
+    flat_mask = jnp.broadcast_to(pooled, (B, Gc, W)).reshape(B * Gc, W)
+    ranks, tie_sum = masked_midranks(flat, flat_mask)
+    ranks = ranks.reshape(B, Gc, W)
+    tie_sum = tie_sum.reshape(B, Gc)
+    rs1 = jnp.sum(jnp.where(m1[:, None, :], ranks, 0.0), axis=-1)  # (B, Gc)
+    log_p, u = wilcoxon_from_ranks(rs1, tie_sum, n1[:, None], n2[:, None])
+    return log_p, u, tie_sum
 
 
 @lru_cache(maxsize=512)

@@ -1,0 +1,36 @@
+"""Device-mesh parallelism: pjit/shard_map over ICI/DCN collectives.
+
+The reference's entire distributed backend is a local R socket cluster fanning
+the outer cluster-pair loop over worker processes
+(R/reclusterDEConsensusFast.R:61-65,384; SURVEY.md §2b N10, §5.8). The
+TPU-native equivalent is single-program SPMD over a `jax.sharding.Mesh`:
+
+  * cells sharded across devices for aggregate reductions (`psum` over ICI)
+    and for the N×N distance work (ring `ppermute` rotation of cell blocks —
+    the ring-attention communication pattern with "distance tile + running
+    accumulator" in place of "QKᵀ + softmax accumulator", SURVEY.md §5.7);
+  * genes sharded for the embarrassingly-parallel statistical tests (the
+    analog of the reference's per-worker gene loops);
+  * multi-host DCN reuses the same mesh axes (devices spanning hosts).
+"""
+
+from scconsensus_tpu.parallel.mesh import make_mesh, pad_axis_to_multiple
+from scconsensus_tpu.parallel.ring import (
+    ring_cluster_distance_sums,
+    sharded_silhouette_widths,
+)
+from scconsensus_tpu.parallel.sharded_de import (
+    sharded_aggregates,
+    sharded_wilcox_logp,
+)
+from scconsensus_tpu.parallel.step import distributed_refine_step
+
+__all__ = [
+    "make_mesh",
+    "pad_axis_to_multiple",
+    "ring_cluster_distance_sums",
+    "sharded_silhouette_widths",
+    "sharded_aggregates",
+    "sharded_wilcox_logp",
+    "distributed_refine_step",
+]

@@ -1,0 +1,49 @@
+"""Mesh construction and shape-padding helpers.
+
+One 1-D mesh axis (default name ``"cells"``) covers every collective in the
+package: cell-sharded reductions and ring distance rotation use it directly;
+gene-sharded test batches reuse the same devices under the alias spec. On a
+multi-host slice the same axis simply spans hosts (ICI within, DCN across);
+nothing in the call sites changes — that is the point of mesh-based SPMD.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "pad_axis_to_multiple", "CELL_AXIS"]
+
+CELL_AXIS = "cells"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_name: str = CELL_AXIS,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """1-D device mesh over the first ``n_devices`` devices (default: all)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devs)} available"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis_name,))
+
+
+def pad_axis_to_multiple(
+    x: np.ndarray, axis: int, multiple: int, fill=0
+) -> Tuple[np.ndarray, int]:
+    """Pad ``x`` along ``axis`` up to the next multiple. Returns (padded, n_pad)."""
+    n = x.shape[axis]
+    n_pad = (-n) % multiple
+    if n_pad == 0:
+        return x, 0
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, n_pad)
+    return np.pad(x, widths, constant_values=fill), n_pad

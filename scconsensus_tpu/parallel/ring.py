@@ -1,0 +1,197 @@
+"""Ring-rotation distance collectives (the ring-attention pattern for cells).
+
+The reference's scaling wall is the dense N×N distance matrix
+(R/reclusterDEConsensus.R:236; SURVEY.md §5.7). Here the matrix never exists:
+cells are sharded into blocks across the mesh, and each step of a ring loop
+computes one (local block × visiting block) distance tile, folds it into a
+running per-cluster accumulator, and `ppermute`s the visiting block to the
+next device over ICI. Communication volume per device is O(N·d) total —
+independent of N² — and compute overlaps the permute under XLA's scheduler.
+
+The accumulator here is the silhouette sufficient statistic Σ_j∈cluster d(i,j)
+(reference N8, cluster::silhouette, R/reclusterDEConsensusFast.R:433); other
+consumers (k-NN for approximate linkage) reuse the same ring with a different
+fold.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from scconsensus_tpu.parallel.mesh import CELL_AXIS, make_mesh, pad_axis_to_multiple
+
+__all__ = [
+    "ring_cluster_distance_sums",
+    "sharded_silhouette_widths",
+    "ring_knn",
+]
+
+
+def _vary(x, axis_name: str):
+    """Mark a freshly-created carry as device-varying for shard_map's
+    varying-manual-axes check (loop carries must match output types)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    if hasattr(jax.lax, "pvary"):  # pragma: no cover - older API name
+        return jax.lax.pvary(x, (axis_name,))
+    return x  # pragma: no cover - very old JAX without the check
+
+
+from scconsensus_tpu.ops.distance import distance_tile as _dist_tile
+
+
+def _ring_sums_local(x_loc, oh_loc, axis_name: str, n_shards: int):
+    """Per-device body: accumulate Σ_cluster distances from local cells to ALL
+    cells by rotating (block, onehot) around the ring ``n_shards`` times."""
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def body(_, carry):
+        y, oy, acc = carry
+        acc = acc + _dist_tile(x_loc, y) @ oy
+        y = jax.lax.ppermute(y, axis_name, perm)
+        oy = jax.lax.ppermute(oy, axis_name, perm)
+        return (y, oy, acc)
+
+    acc0 = _vary(jnp.zeros((x_loc.shape[0], oh_loc.shape[1]), x_loc.dtype), axis_name)
+    _, _, acc = jax.lax.fori_loop(0, n_shards, body, (x_loc, oh_loc, acc0))
+    return acc
+
+
+def ring_cluster_distance_sums(
+    x: np.ndarray,
+    onehot: np.ndarray,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = CELL_AXIS,
+) -> np.ndarray:
+    """(N, K) summed distance from every cell to every cluster, cell-sharded.
+
+    x: (N, d) embedding; onehot: (N, K) membership (zero rows allowed — e.g.
+    padding or unassigned cells contribute to no cluster).
+    """
+    mesh = mesh or make_mesh(axis_name=axis_name)
+    n_shards = mesh.devices.size
+    n = x.shape[0]
+    xp, _ = pad_axis_to_multiple(np.asarray(x, np.float32), 0, n_shards)
+    op, _ = pad_axis_to_multiple(np.asarray(onehot, np.float32), 0, n_shards)
+
+    fn = jax.shard_map(
+        partial(_ring_sums_local, axis_name=axis_name, n_shards=n_shards),
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name)),
+        out_specs=P(axis_name),
+    )
+    sums = jax.jit(fn)(jnp.asarray(xp), jnp.asarray(op))
+    return np.asarray(sums)[:n]
+
+
+def sharded_silhouette_widths(
+    x: np.ndarray,
+    labels: np.ndarray,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = CELL_AXIS,
+) -> np.ndarray:
+    """Per-cell silhouette widths via the ring engine; label < 0 → NaN.
+
+    Matches ops.silhouette.silhouette_widths (cluster::silhouette semantics)
+    but scales across the mesh: no device ever holds more than N/n_shards
+    rows of distance work.
+    """
+    labels = np.asarray(labels)
+    n = labels.shape[0]
+    valid = labels >= 0
+    out = np.full(n, np.nan, np.float32)
+    uniq, inv_all = np.unique(labels[valid], return_inverse=True)
+    k = uniq.size
+    if k < 2:
+        return out
+    onehot = np.zeros((n, k), np.float32)
+    onehot[np.nonzero(valid)[0], inv_all] = 1.0
+    sums = ring_cluster_distance_sums(x, onehot, mesh, axis_name)  # (N, K)
+    counts = onehot.sum(axis=0)  # (K,)
+    own = np.full(n, -1, np.int64)
+    own[valid] = inv_all
+    iv = np.nonzero(valid)[0]
+    sum_own = sums[iv, own[iv]]
+    n_own = counts[own[iv]]
+    a = sum_own / np.maximum(n_own - 1.0, 1.0)
+    mean_other = sums[iv] / np.maximum(counts[None, :], 1.0)
+    mean_other[np.arange(iv.size), own[iv]] = np.inf
+    b = mean_other.min(axis=1)
+    s = (b - a) / np.maximum(np.maximum(a, b), 1e-30)
+    s = np.where(n_own <= 1.0, 0.0, s)
+    out[iv] = s.astype(np.float32)
+    return out
+
+
+def _ring_knn_local(x_loc, idx_loc, kk: int, axis_name: str, n_shards: int):
+    """Per-device body: running k-NN (distances, global indices) over the ring."""
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    nl = x_loc.shape[0]
+    big = jnp.float32(jnp.inf)
+
+    def body(_, carry):
+        y, yidx, best_d, best_i = carry
+        d = _dist_tile(x_loc, y)  # (Nl, Nb)
+        # exclude self-pairs (same global index)
+        same = idx_loc[:, None] == yidx[None, :]
+        d = jnp.where(same, big, d)
+        cat_d = jnp.concatenate([best_d, d], axis=1)
+        cat_i = jnp.concatenate(
+            [best_i, jnp.broadcast_to(yidx[None, :], d.shape)], axis=1
+        )
+        top_d, top_pos = jax.lax.top_k(-cat_d, kk)
+        new_d = -top_d
+        new_i = jnp.take_along_axis(cat_i, top_pos, axis=1)
+        y = jax.lax.ppermute(y, axis_name, perm)
+        yidx = jax.lax.ppermute(yidx, axis_name, perm)
+        return (y, yidx, new_d, new_i)
+
+    best_d0 = _vary(jnp.full((nl, kk), big), axis_name)
+    best_i0 = _vary(jnp.full((nl, kk), -1, jnp.int32), axis_name)
+    _, _, bd, bi = jax.lax.fori_loop(
+        0, n_shards, body, (x_loc, idx_loc, best_d0, best_i0)
+    )
+    return bd, bi
+
+
+def ring_knn(
+    x: np.ndarray,
+    k: int,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = CELL_AXIS,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """k nearest neighbors of every row of x (N, d) via the ring engine.
+
+    Returns (distances (N, k), indices (N, k)); feeds the approximate-linkage
+    path at 1M-cell scale (SURVEY.md §7 stage 6). Padding rows are excluded
+    from results; self-neighbors are excluded. ``k`` must be < N (each row
+    has only N−1 real neighbors).
+    """
+    mesh = mesh or make_mesh(axis_name=axis_name)
+    n_shards = mesh.devices.size
+    n = x.shape[0]
+    if k >= n:
+        raise ValueError(f"k={k} must be < n_points={n} (self excluded)")
+    xp, n_pad = pad_axis_to_multiple(np.asarray(x, np.float32), 0, n_shards)
+    # padded rows carry index -2 (never matches a real self index) and +inf
+    # coordinates would poison tiles; instead give them huge coordinates so
+    # they are never anyone's neighbor.
+    if n_pad:
+        xp[n:] = 1e30
+    gidx = np.arange(xp.shape[0], dtype=np.int32)
+    gidx[n:] = -2
+
+    fn = jax.shard_map(
+        partial(_ring_knn_local, kk=int(k), axis_name=axis_name, n_shards=n_shards),
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name)),
+        out_specs=(P(axis_name), P(axis_name)),
+    )
+    bd, bi = jax.jit(fn)(jnp.asarray(xp), jnp.asarray(gidx))
+    return np.asarray(bd)[:n], np.asarray(bi)[:n]

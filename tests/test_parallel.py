@@ -1,0 +1,133 @@
+"""Distributed-without-a-cluster tests (SURVEY.md §4): every collective runs
+on the 8-virtual-device CPU mesh from conftest; the same code paths ride ICI
+on real hardware."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scconsensus_tpu.ops.gates import compute_aggregates
+from scconsensus_tpu.ops.silhouette import silhouette_widths
+from scconsensus_tpu.parallel import (
+    distributed_refine_step,
+    make_mesh,
+    ring_cluster_distance_sums,
+    sharded_aggregates,
+    sharded_silhouette_widths,
+    sharded_wilcox_logp,
+)
+from scconsensus_tpu.parallel.ring import ring_knn
+from scconsensus_tpu.parallel.step import build_step_inputs
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    return make_mesh(8)
+
+
+def _synthetic(rng, n=96, g=40, k=4):
+    data = np.log1p(rng.poisson(1.5, size=(g, n))).astype(np.float32)
+    labels = rng.integers(0, k, size=n)
+    onehot = np.zeros((n, k), np.float32)
+    onehot[np.arange(n), labels] = 1.0
+    return data, labels, onehot
+
+
+def test_sharded_aggregates_match_dense(rng, mesh):
+    data, _, onehot = _synthetic(rng)
+    ref = compute_aggregates(jnp.asarray(data), jnp.asarray(onehot))
+    got = sharded_aggregates(data, onehot, mesh)
+    np.testing.assert_allclose(got.sum_log, ref.sum_log, rtol=1e-5)
+    np.testing.assert_allclose(got.sum_expm1, ref.sum_expm1, rtol=1e-5)
+    np.testing.assert_allclose(got.nnz, ref.nnz, rtol=0)
+    np.testing.assert_allclose(got.counts, ref.counts, rtol=0)
+
+
+def test_sharded_aggregates_ragged_n(rng, mesh):
+    # n not divisible by 8 exercises the padding path
+    data, _, onehot = _synthetic(rng, n=101)
+    ref = compute_aggregates(jnp.asarray(data), jnp.asarray(onehot))
+    got = sharded_aggregates(data, onehot, mesh)
+    np.testing.assert_allclose(got.sum_log, ref.sum_log, rtol=1e-5)
+    np.testing.assert_allclose(got.counts, ref.counts, rtol=0)
+
+
+def test_ring_sums_match_dense(rng, mesh):
+    x = rng.normal(size=(50, 5)).astype(np.float32)
+    _, labels, onehot = _synthetic(rng, n=50)
+    d = np.sqrt(
+        np.maximum(
+            np.sum((x[:, None, :] - x[None, :, :]) ** 2, axis=-1), 0.0
+        )
+    )
+    ref = d @ onehot
+    got = ring_cluster_distance_sums(x, onehot, mesh)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_sharded_silhouette_matches_blocked(rng, mesh):
+    x = rng.normal(size=(70, 4)).astype(np.float32)
+    labels = rng.integers(0, 3, size=70)
+    labels[:5] = -1  # unassigned cells excluded
+    ref = silhouette_widths(x, labels)
+    got = sharded_silhouette_widths(x, labels, mesh)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ring_knn_matches_bruteforce(rng, mesh):
+    x = rng.normal(size=(41, 3)).astype(np.float32)
+    d = np.sqrt(np.sum((x[:, None, :] - x[None, :, :]) ** 2, axis=-1))
+    np.fill_diagonal(d, np.inf)
+    k = 5
+    ref_idx = np.argsort(d, axis=1)[:, :k]
+    ref_d = np.take_along_axis(d, ref_idx, axis=1)
+    got_d, got_i = ring_knn(x, k, mesh)
+    np.testing.assert_allclose(np.sort(got_d, axis=1), ref_d, rtol=1e-4, atol=1e-4)
+    # index sets agree wherever distances are untied
+    for i in range(41):
+        assert set(got_i[i]) == set(ref_idx[i])
+
+
+def test_sharded_wilcox_matches_serial(rng, mesh):
+    from scconsensus_tpu.de.engine import _wilcox_chunk
+
+    data, labels, _ = _synthetic(rng, n=64, g=24, k=2)
+    ci = np.nonzero(labels == 0)[0].astype(np.int32)
+    cj = np.nonzero(labels == 1)[0].astype(np.int32)
+    w = ci.size + cj.size
+    idx = np.concatenate([ci, cj])[None, :]
+    m1 = np.zeros((1, w), bool)
+    m1[0, : ci.size] = True
+    m2 = ~m1
+    n1 = np.array([ci.size], np.int32)
+    n2 = np.array([cj.size], np.int32)
+    ref, _, _ = _wilcox_chunk(
+        jnp.asarray(data), jnp.asarray(idx), jnp.asarray(m1),
+        jnp.asarray(m2), jnp.asarray(n1), jnp.asarray(n2),
+    )
+    got = sharded_wilcox_logp(data, idx, m1, m2, n1, n2, mesh)
+    np.testing.assert_allclose(got[0], np.asarray(ref)[0], rtol=1e-4, atol=1e-4)
+
+
+def test_distributed_refine_step_runs(mesh):
+    inputs = build_step_inputs(n_cells=64, n_genes=48, n_clusters=3, n_shards=8)
+    step = distributed_refine_step(mesh, n_pcs=4)
+    out = step(
+        jnp.asarray(inputs["data"]), jnp.asarray(inputs["onehot"]),
+        jnp.asarray(inputs["pair_i"]), jnp.asarray(inputs["pair_j"]),
+        jnp.asarray(inputs["idx"]), jnp.asarray(inputs["m1"]),
+        jnp.asarray(inputs["m2"]), jnp.asarray(inputs["n1"]),
+        jnp.asarray(inputs["n2"]),
+    )
+    jax.block_until_ready(out)
+    assert out["de_mask"].shape == (3, inputs["data"].shape[0])
+    assert out["scores"].shape == (inputs["data"].shape[1], 4)
+    assert out["sil_sums"].shape == (inputs["data"].shape[1], 3)
+    assert bool(jnp.all(jnp.isfinite(out["scores"])))
+    # silhouette sums from the step match the standalone ring engine
+    ref = ring_cluster_distance_sums(
+        np.asarray(out["scores"]), inputs["onehot"], mesh
+    )
+    np.testing.assert_allclose(np.asarray(out["sil_sums"]), ref, rtol=1e-3, atol=1e-3)
