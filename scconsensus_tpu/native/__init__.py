@@ -1,0 +1,100 @@
+"""Native (C++) runtime pieces, ctypes-loaded.
+
+The compute path is JAX/XLA; these are the host-side runtime kernels where
+the reference leans on native libraries (SURVEY.md §2b): currently the
+Ward.D2 NN-chain agglomeration (fastcluster's role). Built on demand with the
+in-tree compiler — no pybind11 dependency, plain C ABI + ctypes.
+
+``ward_native(points, weights)`` raises on any build/load failure; callers
+(ops/linkage.py) fall back to the numpy implementation, which is also the
+golden reference for these kernels.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ward_native", "native_available"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libscc_native.so")
+_SRC = os.path.join(_DIR, "ward.cpp")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LOAD_ERROR: Optional[Exception] = None
+
+
+def _build() -> None:
+    cmd = [
+        "g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+        "-std=c++17", _SRC, "-o", _SO,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _load() -> ctypes.CDLL:
+    global _LIB, _LOAD_ERROR
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _LOAD_ERROR is not None:
+            raise _LOAD_ERROR
+        try:
+            if (not os.path.exists(_SO)) or (
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            ):
+                _build()
+            lib = ctypes.CDLL(_SO)
+            fn = lib.scc_ward_nnchain
+            fn.restype = ctypes.c_int
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_double),
+            ]
+            _LIB = lib
+            return lib
+        except Exception as e:  # compiler missing, load failure, ...
+            _LOAD_ERROR = e
+            raise
+
+
+def native_available() -> bool:
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+def ward_native(
+    points: np.ndarray, weights: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Run the C++ NN-chain. Returns (raw_pairs (n-1, 2) slot ids, raw_h (n-1,))
+    in merge order — same raw output as the numpy chain in ops/linkage.py."""
+    lib = _load()
+    pts = np.ascontiguousarray(points, np.float64)
+    w = np.ascontiguousarray(weights, np.float64)
+    n, d = pts.shape
+    pairs = np.zeros((n - 1, 2), np.int64)
+    heights = np.zeros(n - 1, np.float64)
+    rc = lib.scc_ward_nnchain(
+        pts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        w.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(n),
+        ctypes.c_int64(d),
+        pairs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        heights.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    if rc != 0:
+        raise RuntimeError(f"scc_ward_nnchain failed with code {rc}")
+    return pairs, heights

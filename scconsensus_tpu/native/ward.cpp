@@ -1,0 +1,153 @@
+// Ward.D2 nearest-neighbor-chain agglomeration — native runtime core.
+//
+// The TPU computes the embedding; the merge loop itself is inherently
+// sequential (SURVEY.md §7 "hard parts" #1) and latency-bound, so it runs
+// on host in C++ (the role fastcluster's C++ plays for the reference,
+// R/reclusterDEConsensus.R:242-246). Clusters are (centroid, size) pairs and
+// the Ward.D2 dissimilarity is the closed-form Lance–Williams recurrence
+//     D(A,B)^2 = 2·|A||B|/(|A|+|B|) · ‖c_A − c_B‖²,
+// identical to the numpy fallback in ops/linkage.py (its golden reference).
+//
+// Layout tuned for a single-core host (the build machine exposes 1 CPU):
+// centroids are stored column-major over a swap-remove-compacted active set,
+// so the NN scan's hot loop is a contiguous, FMA-vectorizable pass over the
+// cluster axis per dimension. Ties break toward the smallest slot id,
+// reproducing the numpy argmin (first minimum in ascending slot order).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// points: (n, d) row-major; weights: (n,) cluster sizes (>=1).
+// out_pairs: (n-1, 2) merged slot ids (slots n.. are prior merges, in merge
+// order); out_heights: (n-1,) ward.D2 heights. Returns 0 on success.
+int scc_ward_nnchain(const double* points, const double* weights, int64_t n,
+                     int64_t d, int64_t* out_pairs, double* out_heights) {
+  if (n < 2 || d < 1) return 1;
+  const int64_t cap = 2 * n - 1;
+
+  // Column-major active centroids: col[i*n + t] = coordinate i of the
+  // cluster at active position t. Parallel arrays kept in sync by
+  // swap-remove; a_count shrinks monotonically from n, so n slots suffice.
+  std::vector<double> col(static_cast<size_t>(d) * n);
+  std::vector<double> csize(n);
+  std::vector<int64_t> cslot(n);
+  std::vector<int64_t> pos_of(cap, -1);  // slot -> active position
+  std::vector<double> d2(n);             // scan buffer
+
+  for (int64_t t = 0; t < n; ++t) {
+    for (int64_t i = 0; i < d; ++i) col[i * n + t] = points[t * d + i];
+    csize[t] = weights[t];
+    cslot[t] = t;
+    pos_of[t] = t;
+  }
+  int64_t a_count = n;
+
+  std::vector<int64_t> chain;
+  chain.reserve(64);
+  std::vector<double> cu(d);
+  int64_t next_slot = n;
+
+  auto swap_remove = [&](int64_t pos) {
+    const int64_t last = a_count - 1;
+    pos_of[cslot[pos]] = -1;
+    if (pos != last) {
+      for (int64_t i = 0; i < d; ++i) col[i * n + pos] = col[i * n + last];
+      csize[pos] = csize[last];
+      cslot[pos] = cslot[last];
+      pos_of[cslot[pos]] = pos;
+    }
+    --a_count;
+  };
+
+  while (a_count > 1) {
+    if (chain.empty()) {
+      // Numpy starts a fresh chain at the smallest active slot.
+      int64_t smallest = cslot[0];
+      for (int64_t t = 1; t < a_count; ++t)
+        if (cslot[t] < smallest) smallest = cslot[t];
+      chain.push_back(smallest);
+    }
+    int64_t u, v;
+    double best_d2;
+    for (;;) {
+      u = chain.back();
+      const int64_t upos = pos_of[u];
+      const double su = csize[upos];
+      for (int64_t i = 0; i < d; ++i) cu[i] = col[i * n + upos];
+
+      // Hot loop: squared distances to every active cluster, contiguous in t.
+      double* acc = d2.data();
+      {
+        const double c0 = cu[0];
+        const double* row = col.data();
+#pragma GCC ivdep
+        for (int64_t t = 0; t < a_count; ++t) {
+          const double diff = c0 - row[t];
+          acc[t] = diff * diff;
+        }
+      }
+      for (int64_t i = 1; i < d; ++i) {
+        const double ci = cu[i];
+        const double* row = col.data() + i * n;
+#pragma GCC ivdep
+        for (int64_t t = 0; t < a_count; ++t) {
+          const double diff = ci - row[t];
+          acc[t] += diff * diff;
+        }
+      }
+
+      // Argmin of the Ward statistic with smallest-slot tie-break.
+      double bd = 1e300;
+      int64_t bslot = -1;
+      for (int64_t t = 0; t < a_count; ++t) {
+        if (t == upos) continue;
+        const double sv = csize[t];
+        const double w2 = 2.0 * (su * sv / (su + sv)) * acc[t];
+        const int64_t s = cslot[t];
+        if (w2 < bd || (w2 == bd && s < bslot)) {
+          bd = w2;
+          bslot = s;
+        }
+      }
+      if (bslot < 0) return 2;
+      if (chain.size() > 1 && bslot == chain[chain.size() - 2]) {
+        best_d2 = bd;
+        v = bslot;
+        break;
+      }
+      chain.push_back(bslot);
+    }
+    chain.pop_back();  // u
+    chain.pop_back();  // v
+    const int64_t row_idx = next_slot - n;
+    out_pairs[row_idx * 2] = u;
+    out_pairs[row_idx * 2 + 1] = v;
+    out_heights[row_idx] = std::sqrt(best_d2 > 0.0 ? best_d2 : 0.0);
+
+    const int64_t up = pos_of[u], vp = pos_of[v];
+    const double su = csize[up], sv = csize[vp];
+    std::vector<double> merged(d);
+    for (int64_t i = 0; i < d; ++i)
+      merged[i] = (su * col[i * n + up] + sv * col[i * n + vp]) / (su + sv);
+    if (up > vp) {
+      swap_remove(up);
+      swap_remove(vp);
+    } else {
+      swap_remove(vp);
+      swap_remove(up);
+    }
+    for (int64_t i = 0; i < d; ++i) col[i * n + a_count] = merged[i];
+    csize[a_count] = su + sv;
+    cslot[a_count] = next_slot;
+    pos_of[next_slot] = a_count;
+    ++a_count;
+    ++next_slot;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -16,7 +16,7 @@ fold.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional, Tuple
 
 import jax
@@ -79,15 +79,23 @@ def ring_cluster_distance_sums(
     n = x.shape[0]
     xp, _ = pad_axis_to_multiple(np.asarray(x, np.float32), 0, n_shards)
     op, _ = pad_axis_to_multiple(np.asarray(onehot, np.float32), 0, n_shards)
-
-    fn = jax.shard_map(
-        partial(_ring_sums_local, axis_name=axis_name, n_shards=n_shards),
-        mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name)),
-        out_specs=P(axis_name),
-    )
-    sums = jax.jit(fn)(jnp.asarray(xp), jnp.asarray(op))
+    sums = _jitted_ring_sums(mesh, axis_name)(jnp.asarray(xp), jnp.asarray(op))
     return np.asarray(sums)[:n]
+
+
+@lru_cache(maxsize=32)
+def _jitted_ring_sums(mesh: Mesh, axis_name: str):
+    """Jitted ring-sum wrapper, cached per (mesh, axis) so repeat calls hit
+    the jit cache instead of re-tracing and re-compiling."""
+    n_shards = mesh.devices.size
+    return jax.jit(
+        jax.shard_map(
+            partial(_ring_sums_local, axis_name=axis_name, n_shards=n_shards),
+            mesh=mesh,
+            in_specs=(P(axis_name), P(axis_name)),
+            out_specs=P(axis_name),
+        )
+    )
 
 
 def sharded_silhouette_widths(
@@ -186,12 +194,20 @@ def ring_knn(
         xp[n:] = 1e30
     gidx = np.arange(xp.shape[0], dtype=np.int32)
     gidx[n:] = -2
-
-    fn = jax.shard_map(
-        partial(_ring_knn_local, kk=int(k), axis_name=axis_name, n_shards=n_shards),
-        mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name)),
-        out_specs=(P(axis_name), P(axis_name)),
+    bd, bi = _jitted_ring_knn(mesh, axis_name, int(k))(
+        jnp.asarray(xp), jnp.asarray(gidx)
     )
-    bd, bi = jax.jit(fn)(jnp.asarray(xp), jnp.asarray(gidx))
     return np.asarray(bd)[:n], np.asarray(bi)[:n]
+
+
+@lru_cache(maxsize=32)
+def _jitted_ring_knn(mesh: Mesh, axis_name: str, kk: int):
+    n_shards = mesh.devices.size
+    return jax.jit(
+        jax.shard_map(
+            partial(_ring_knn_local, kk=kk, axis_name=axis_name, n_shards=n_shards),
+            mesh=mesh,
+            in_specs=(P(axis_name), P(axis_name)),
+            out_specs=(P(axis_name), P(axis_name)),
+        )
+    )

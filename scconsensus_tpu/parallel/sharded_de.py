@@ -13,7 +13,7 @@ Two sharding roles over the same 1-D mesh:
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional, Tuple
 
 import jax
@@ -54,14 +54,23 @@ def sharded_aggregates(
     n_shards = mesh.devices.size
     dp, _ = pad_axis_to_multiple(np.asarray(data, np.float32), 1, n_shards)
     op, _ = pad_axis_to_multiple(np.asarray(onehot, np.float32), 0, n_shards)
-    fn = jax.shard_map(
-        partial(_agg_local, axis_name=axis_name),
-        mesh=mesh,
-        in_specs=(P(None, axis_name), P(axis_name)),
-        out_specs=(P(None), P(None), P(None), P(None)),
+    sum_log, sum_expm1, nnz, counts = _jitted_aggregates(mesh, axis_name)(
+        jnp.asarray(dp), jnp.asarray(op)
     )
-    sum_log, sum_expm1, nnz, counts = jax.jit(fn)(jnp.asarray(dp), jnp.asarray(op))
     return ClusterAggregates(sum_log, sum_expm1, nnz, counts)
+
+
+@lru_cache(maxsize=32)
+def _jitted_aggregates(mesh: Mesh, axis_name: str):
+    """Cached jitted wrapper — repeat calls hit the jit cache, not a rebuild."""
+    return jax.jit(
+        jax.shard_map(
+            partial(_agg_local, axis_name=axis_name),
+            mesh=mesh,
+            in_specs=(P(None, axis_name), P(axis_name)),
+            out_specs=(P(None), P(None), P(None), P(None)),
+        )
+    )
 
 
 def _wilcox_local(chunk_loc, idx, m1, m2, n1, n2):
@@ -90,13 +99,7 @@ def sharded_wilcox_logp(
     n_shards = mesh.devices.size
     G = data.shape[0]
     dp, _ = pad_axis_to_multiple(np.asarray(data, np.float32), 0, n_shards)
-    fn = jax.shard_map(
-        _wilcox_local,
-        mesh=mesh,
-        in_specs=(P(axis_name), P(None), P(None), P(None), P(None), P(None)),
-        out_specs=P(None, axis_name),
-    )
-    log_p = jax.jit(fn)(
+    log_p = _jitted_wilcox(mesh, axis_name)(
         jnp.asarray(dp),
         jnp.asarray(idx, np.int32),
         jnp.asarray(m1),
@@ -105,3 +108,15 @@ def sharded_wilcox_logp(
         jnp.asarray(n2, np.int32),
     )
     return np.asarray(log_p)[:, :G]
+
+
+@lru_cache(maxsize=32)
+def _jitted_wilcox(mesh: Mesh, axis_name: str):
+    return jax.jit(
+        jax.shard_map(
+            _wilcox_local,
+            mesh=mesh,
+            in_specs=(P(axis_name), P(None), P(None), P(None), P(None), P(None)),
+            out_specs=P(None, axis_name),
+        )
+    )

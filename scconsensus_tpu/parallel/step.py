@@ -6,27 +6,73 @@ gene-sharded Wilcoxon, BH + DE call, and the ring silhouette over the
 embedding — composed into a single jitted program over a `Mesh`. The driver's
 `dryrun_multichip` compiles and runs exactly this on an N-virtual-device mesh;
 the benchmark path runs it on real hardware.
+
+One step body serves both forms: `distributed_refine_step` passes the
+shard_map'd kernels, `fused_refine_step` the plain-jnp ones — so the
+single-device and mesh paths cannot diverge.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Optional
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from scconsensus_tpu.ops.gates import pair_gates_fast
+from scconsensus_tpu.ops.distance import distance_tile
+from scconsensus_tpu.ops.gates import ClusterAggregates, compute_aggregates, pair_gates_fast
 from scconsensus_tpu.ops.multipletests import bh_adjust_masked
 from scconsensus_tpu.ops.pca import pca_scores
+from scconsensus_tpu.ops.wilcoxon import wilcoxon_pairs_tile
 from scconsensus_tpu.parallel.mesh import CELL_AXIS
 from scconsensus_tpu.parallel.ring import _ring_sums_local
 from scconsensus_tpu.parallel.sharded_de import _agg_local, _wilcox_local
-from scconsensus_tpu.ops.gates import ClusterAggregates
 
 __all__ = ["distributed_refine_step", "fused_refine_step", "build_step_inputs"]
+
+
+def _build_step(agg_fn, wilcox_fn, sil_fn, *, min_pct, log_fc_thrs, q_val_thrs, n_pcs):
+    """The one step body. Kernel slots:
+    agg_fn(data, onehot) -> ClusterAggregates;
+    wilcox_fn(data, idx, m1, m2, n1, n2) -> log_p (B, G);
+    sil_fn(scores, onehot) -> (N, K) per-cluster distance sums."""
+
+    def step(data, onehot, pair_i, pair_j, idx, m1, m2, n1, n2):
+        # 1. per-cluster aggregates (three matmuls against the one-hot)
+        agg = agg_fn(data, onehot)
+        # 2. gates for every pair (small replicated tensors)
+        gate, log_fc, pct1, pct2 = pair_gates_fast(
+            agg, pair_i, pair_j,
+            min_pct=min_pct, min_diff_pct=-jnp.inf,
+            log_fc_thrs=log_fc_thrs, mean_exprs_thrs=0.0,
+        )
+        # 3. rank-sum test (genes embarrassingly parallel)
+        log_p = wilcox_fn(data, idx, m1, m2, n1, n2)
+        # 4. BH over surviving genes + DE call (G-sized sort per pair)
+        log_q = bh_adjust_masked(log_p, gate)
+        de = gate & (log_q < jnp.log(jnp.float32(q_val_thrs)))
+        # 5. embed on a fixed-size top-score gene panel (static shapes:
+        #    jit-safe stand-in for the data-dependent DE union; the real
+        #    pipeline re-gathers on the union host-side between steps)
+        var = agg.sum_expm1.sum(axis=1)
+        _, top_idx = jax.lax.top_k(var, min(64, data.shape[0]))
+        scores = pca_scores(data[top_idx].T, n_pcs)
+        # 6. silhouette sufficient statistics over the embedding
+        sil_sums = sil_fn(scores, onehot)
+        return {
+            "de_mask": de,
+            "log_q": log_q,
+            "log_fc": log_fc,
+            "de_counts": de.sum(axis=1),
+            "scores": scores,
+            "sil_sums": sil_sums,
+            "counts": agg.counts,
+        }
+
+    return jax.jit(step)
 
 
 def fused_refine_step(
@@ -36,38 +82,18 @@ def fused_refine_step(
     q_val_thrs: float = 0.1,
     n_pcs: int = 8,
 ):
-    """Single-device version of :func:`distributed_refine_step` — the same
-    aggregate → gate → test → BH → embed → silhouette-sums program with plain
-    jnp ops in place of the collectives. This is the flagship jittable forward
-    step the driver compile-checks via ``__graft_entry__.entry``."""
-    from scconsensus_tpu.ops.distance import distance_tile
-    from scconsensus_tpu.ops.gates import compute_aggregates
-    from scconsensus_tpu.ops.wilcoxon import wilcoxon_pairs_tile
-
-    def step(data, onehot, pair_i, pair_j, idx, m1, m2, n1, n2):
-        agg = compute_aggregates(data, onehot)
-        gate, log_fc, pct1, pct2 = pair_gates_fast(
-            agg, pair_i, pair_j,
-            min_pct=min_pct, min_diff_pct=-jnp.inf,
-            log_fc_thrs=log_fc_thrs, mean_exprs_thrs=0.0,
-        )
-        log_p, _u, _ties = wilcoxon_pairs_tile(data, idx, m1, m2, n1, n2)
-        log_q = bh_adjust_masked(log_p, gate)
-        de = gate & (log_q < jnp.log(jnp.float32(q_val_thrs)))
-        var = agg.sum_expm1.sum(axis=1)
-        _, top_idx = jax.lax.top_k(var, min(64, data.shape[0]))
-        scores = pca_scores(data[top_idx].T, n_pcs)
-        sil_sums = distance_tile(scores, scores) @ onehot
-        return {
-            "de_mask": de,
-            "log_q": log_q,
-            "log_fc": log_fc,
-            "de_counts": de.sum(axis=1),
-            "scores": scores,
-            "sil_sums": sil_sums,
-        }
-
-    return jax.jit(step)
+    """Single-device form — plain-jnp kernels in the step body. This is the
+    flagship jittable forward step the driver compile-checks via
+    ``__graft_entry__.entry``."""
+    return _build_step(
+        compute_aggregates,
+        lambda data, idx, m1, m2, n1, n2: wilcoxon_pairs_tile(
+            data, idx, m1, m2, n1, n2
+        )[0],
+        lambda scores, onehot: distance_tile(scores, scores) @ onehot,
+        min_pct=min_pct, log_fc_thrs=log_fc_thrs,
+        q_val_thrs=q_val_thrs, n_pcs=n_pcs,
+    )
 
 
 def distributed_refine_step(
@@ -79,7 +105,7 @@ def distributed_refine_step(
     q_val_thrs: float = 0.1,
     n_pcs: int = 8,
 ):
-    """Build the jitted step. Returns step(data, onehot, pair_i, pair_j, idx,
+    """Mesh-sharded form. Returns step(data, onehot, pair_i, pair_j, idx,
     m1, m2, n1, n2) -> dict of device outputs.
 
     Shardings (all over the one mesh axis):
@@ -90,7 +116,7 @@ def distributed_refine_step(
     """
     n_shards = int(mesh.devices.size)
 
-    agg_fn = jax.shard_map(
+    raw_agg = jax.shard_map(
         partial(_agg_local, axis_name=axis_name),
         mesh=mesh,
         in_specs=(P(None, axis_name), P(axis_name)),
@@ -102,48 +128,21 @@ def distributed_refine_step(
         in_specs=(P(axis_name), P(None), P(None), P(None), P(None), P(None)),
         out_specs=P(None, axis_name),
     )
-    ring_fn = jax.shard_map(
+    sil_fn = jax.shard_map(
         partial(_ring_sums_local, axis_name=axis_name, n_shards=n_shards),
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name)),
         out_specs=P(axis_name),
     )
 
-    def step(data, onehot, pair_i, pair_j, idx, m1, m2, n1, n2):
-        # 1. aggregates: cells sharded, psum over ICI
-        sum_log, sum_expm1, nnz, counts = agg_fn(data, onehot)
-        agg = ClusterAggregates(sum_log, sum_expm1, nnz, counts)
-        # 2. gates for every pair (replicated small tensors)
-        gate, log_fc, pct1, pct2 = pair_gates_fast(
-            agg, pair_i, pair_j,
-            min_pct=min_pct, min_diff_pct=-jnp.inf,
-            log_fc_thrs=log_fc_thrs, mean_exprs_thrs=0.0,
-        )
-        # 3. rank-sum test, genes sharded (pure local sorts)
-        log_p = wilcox_fn(data, idx, m1, m2, n1, n2)
-        # 4. BH over surviving genes + DE call (gathered; G-sized sort per pair)
-        log_q = bh_adjust_masked(log_p, gate)
-        de = gate & (log_q < jnp.log(jnp.float32(q_val_thrs)))
-        # 5. embed on a fixed-size top-variance gene panel (static shapes:
-        #    jit-safe stand-in for the data-dependent DE union; the real
-        #    pipeline re-gathers on the union host-side between steps)
-        var = sum_expm1.sum(axis=1)  # cheap per-gene score
-        _, top_idx = jax.lax.top_k(var, min(64, data.shape[0]))
-        panel = data[top_idx].T  # (N, 64)
-        scores = pca_scores(panel, n_pcs)
-        # 6. ring silhouette sums over the embedding (cells sharded, ppermute)
-        sil_sums = ring_fn(scores, onehot)
-        return {
-            "de_mask": de,
-            "log_q": log_q,
-            "log_fc": log_fc,
-            "de_counts": de.sum(axis=1),
-            "scores": scores,
-            "sil_sums": sil_sums,
-            "counts": counts,
-        }
+    def agg_fn(data, onehot):
+        return ClusterAggregates(*raw_agg(data, onehot))
 
-    return jax.jit(step)
+    return _build_step(
+        agg_fn, wilcox_fn, sil_fn,
+        min_pct=min_pct, log_fc_thrs=log_fc_thrs,
+        q_val_thrs=q_val_thrs, n_pcs=n_pcs,
+    )
 
 
 def build_step_inputs(

@@ -1,0 +1,59 @@
+"""Native C++ Ward NN-chain vs the numpy golden reference and scipy."""
+
+import numpy as np
+import pytest
+
+from scconsensus_tpu.native import native_available, ward_native
+from scconsensus_tpu.ops.linkage import HClustTree, _to_hclust, ward_linkage
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+
+def _heights_match(a: HClustTree, b: HClustTree):
+    np.testing.assert_allclose(a.height, b.height, rtol=1e-10, atol=1e-12)
+
+
+def test_native_matches_numpy_chain(rng):
+    x = rng.normal(size=(300, 7))
+    numpy_tree = ward_linkage(x, use_native=False)
+    pairs, h = ward_native(x, np.ones(300))
+    native_tree = _to_hclust(pairs, h, 300)
+    _heights_match(numpy_tree, native_tree)
+    np.testing.assert_array_equal(numpy_tree.merge, native_tree.merge)
+    np.testing.assert_array_equal(numpy_tree.order, native_tree.order)
+
+
+def test_native_matches_scipy_heights(rng):
+    scipy_hier = pytest.importorskip("scipy.cluster.hierarchy")
+    x = rng.normal(size=(200, 5))
+    pairs, h = ward_native(x, np.ones(200))
+    tree = _to_hclust(pairs, h, 200)
+    z = scipy_hier.linkage(x, method="ward")
+    np.testing.assert_allclose(np.sort(tree.height), np.sort(z[:, 2]), rtol=1e-8)
+
+
+def test_native_weighted_equals_premerged(rng):
+    # A weighted point must behave exactly like that many coincident points.
+    base = rng.normal(size=(40, 3))
+    w = rng.integers(1, 4, size=40).astype(np.float64)
+    expanded = np.repeat(base, w.astype(int), axis=0)
+    pairs, h = ward_native(base, w)
+    tree_w = _to_hclust(pairs, h, 40)
+    tree_e = ward_linkage(expanded, use_native=False)
+    # the expanded tree's zero-height merges collapse coincident points first;
+    # the remaining (positive) merge heights must coincide
+    hw = tree_w.height[tree_w.height > 1e-12]
+    he = tree_e.height[tree_e.height > 1e-12]
+    np.testing.assert_allclose(np.sort(hw), np.sort(he), rtol=1e-8)
+
+
+def test_default_path_uses_native(rng):
+    # ward_linkage(use_native=True) should agree with the explicit native call
+    x = rng.normal(size=(120, 4))
+    t1 = ward_linkage(x, use_native=True)
+    pairs, h = ward_native(x, np.ones(120))
+    t2 = _to_hclust(pairs, h, 120)
+    _heights_match(t1, t2)
+    np.testing.assert_array_equal(t1.merge, t2.merge)
