@@ -30,6 +30,10 @@ class CompatFlags:
     bh_reference_n: bool = True
     # §2d-6: return the per-deepSplit silhouette (reference computes & drops it).
     return_silhouette: bool = True
+    # The reference hands the *log-normalized* matrix to DGEList as counts
+    # (R/reclusterDEConsensus.R:133). True keeps that literal arithmetic;
+    # False tests on expm1(data) (count-scale, the statistically sane input).
+    edger_log_counts: bool = True
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

@@ -305,6 +305,61 @@ def pairwise_de(
             pct2=pct2,
         )
 
+    if method == "edger":
+        from scconsensus_tpu.de.edger import run_edger_pairs
+
+        # The reference passes the log-normalized matrix to DGEList as-is
+        # (R/reclusterDEConsensus.R:133) — counts in log space. Compat keeps
+        # that literal arithmetic; fixed mode tests on expm1(data).
+        expm1_data = np.expm1(data)
+        counts = data if config.compat.edger_log_counts else expm1_data
+        mean_expm1 = float(np.mean(expm1_data))
+        del expm1_data
+        with timer.stage("edger_nb"):
+            buckets = _bucket_pairs(cell_idx_of, pair_i, pair_j)
+            nb = run_edger_pairs(counts, buckets, G, int(pair_i.size))
+        with timer.stage("gates"):
+            mean_gate, _slow_fc = pair_gates_slow(
+                agg, pi, pj,
+                mean_exprs_thrs=config.mean_scaling_factor * mean_expm1,
+                mixed_spaces=config.compat.mean_gate_mixed_spaces,
+            )
+        with timer.stage("bh_adjust"):
+            log_q = np.asarray(
+                bh_adjust(jnp.asarray(nb.log_p), n=jnp.asarray(float(G)))
+                if config.compat.bh_reference_n
+                else bh_adjust(jnp.asarray(nb.log_p))
+            )
+        with timer.stage("de_call"):
+            log_thr = np.log(np.float32(config.q_val_thrs))
+            if config.compat.edger_drop_logfc:
+                # §2d-1: the reference stores edgeR's fold-changes into a dead
+                # variable; the criterion reads scalar-NA `logfc`, so the
+                # whole mask is NA → no gene is ever *selected*. Reproduced
+                # as an all-false DE mask (NA indexes select nothing usable).
+                de = np.zeros((pair_i.size, G), bool)
+            else:
+                de = (
+                    (log_q < log_thr)
+                    & (np.abs(nb.log_fc) > config.log_fc_thrs)
+                    & np.asarray(mean_gate)
+                )
+                de &= ~np.isnan(log_q)
+        return PairwiseDEResult(
+            cluster_names=names,
+            pair_i=pair_i,
+            pair_j=pair_j,
+            log_p=nb.log_p,
+            log_q=log_q,
+            log_fc=nb.log_fc,
+            tested=np.ones((pair_i.size, G), bool),
+            de_mask=de,
+            aux={
+                "common_dispersion": nb.common_disp,
+                "tagwise_dispersion": nb.tagwise_disp,
+            },
+        )
+
     raise NotImplementedError(f"DE method '{config.method}' not implemented yet")
 
 
