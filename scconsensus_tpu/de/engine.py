@@ -32,6 +32,7 @@ from scconsensus_tpu.ops.gates import (
     pair_gates_slow,
 )
 from scconsensus_tpu.ops.multipletests import bh_adjust, bh_adjust_masked
+from scconsensus_tpu.ops.seurat_tests import bimod_lrt_tile, welch_t_tile
 from scconsensus_tpu.ops.wilcoxon import (
     EXACT_N_LIMIT,
     wilcoxon_exact_host,
@@ -142,6 +143,59 @@ def _bucket_pairs(
 _wilcox_chunk = jax.jit(wilcoxon_pairs_tile)
 
 
+@jax.jit
+def _bimod_chunk(chunk, idx, m1, m2):
+    return bimod_lrt_tile(jnp.swapaxes(jnp.take(chunk, idx, axis=1), 0, 1), m1, m2)
+
+
+@jax.jit
+def _ttest_chunk(chunk, idx, m1, m2):
+    return welch_t_tile(jnp.swapaxes(jnp.take(chunk, idx, axis=1), 0, 1), m1, m2)
+
+
+def _chunk_tiles(data, cell_idx_of, pair_i, pair_j):
+    """Shared bucket/gene-chunk iteration for every tile test: yields
+    (bucket, (idx, m1, m2, n1, n2) device tensors, g0, g1, padded chunk).
+    Chunks are padded to a fixed width so each bucket shape compiles once."""
+    jdata = jnp.asarray(data)
+    G = data.shape[0]
+    for bucket in _bucket_pairs(cell_idx_of, pair_i, pair_j):
+        B, W = bucket.cell_idx.shape
+        gc = max(256, _CHUNK_ELEM_BUDGET // max(B * W, 1))
+        gc = min(_next_pow2(gc), _next_pow2(G))
+        tensors = (
+            jnp.asarray(bucket.cell_idx),
+            jnp.asarray(bucket.mask1),
+            jnp.asarray(bucket.mask2),
+            jnp.asarray(bucket.n1),
+            jnp.asarray(bucket.n2),
+        )
+        for g0 in range(0, G, gc):
+            chunk = jdata[g0 : g0 + gc]
+            if chunk.shape[0] < gc:
+                chunk = jnp.pad(chunk, ((0, gc - chunk.shape[0]), (0, 0)))
+            yield bucket, tensors, g0, min(g0 + gc, G), chunk
+
+
+def _run_tile_test(
+    data: np.ndarray,
+    cell_idx_of: List[np.ndarray],
+    pair_i: np.ndarray,
+    pair_j: np.ndarray,
+    chunk_fn,
+) -> np.ndarray:
+    """Generic moment-based tile test (bimod / t): same bucketing and gene
+    chunking as the rank-sum path, no exact branch. Returns log_p (P, G)."""
+    G, _ = data.shape
+    log_p = np.full((pair_i.shape[0], G), np.nan, np.float32)
+    for bucket, (idx, m1, m2, _n1, _n2), g0, g1, chunk in _chunk_tiles(
+        data, cell_idx_of, pair_i, pair_j
+    ):
+        lp = chunk_fn(chunk, idx, m1, m2)
+        log_p[bucket.rows, g0:g1] = np.asarray(lp)[:, : g1 - g0]
+    return log_p
+
+
 def _run_wilcox(
     data: np.ndarray,
     cell_idx_of: List[np.ndarray],
@@ -159,41 +213,29 @@ def _run_wilcox(
     P = pair_i.shape[0]
     log_p = np.full((P, G), np.nan, np.float32)
     u_stat = np.full((P, G), np.nan, np.float32)
-    jdata = jnp.asarray(data)
-    for bucket in _bucket_pairs(cell_idx_of, pair_i, pair_j):
-        B, W = bucket.cell_idx.shape
-        gc = max(256, _CHUNK_ELEM_BUDGET // max(B * W, 1))
-        gc = min(_next_pow2(gc), _next_pow2(G))
-        idx = jnp.asarray(bucket.cell_idx)
-        m1 = jnp.asarray(bucket.mask1)
-        m2 = jnp.asarray(bucket.mask2)
-        n1 = jnp.asarray(bucket.n1)
-        n2 = jnp.asarray(bucket.n2)
-        for g0 in range(0, G, gc):
-            chunk = jdata[g0 : g0 + gc]
-            if chunk.shape[0] < gc:  # pad to keep the jit cache to one entry
-                chunk = jnp.pad(chunk, ((0, gc - chunk.shape[0]), (0, 0)))
-            lp, u, ties = _wilcox_chunk(chunk, idx, m1, m2, n1, n2)
-            g1 = min(g0 + gc, G)
-            lp_h = np.asarray(lp)[:, : g1 - g0]
-            u_h = np.asarray(u)[:, : g1 - g0]
-            log_p[bucket.rows, g0:g1] = lp_h
-            u_stat[bucket.rows, g0:g1] = u_h
-            if exact == "auto":
-                small = (bucket.n1 < EXACT_N_LIMIT) & (bucket.n2 < EXACT_N_LIMIT)
-                if small.any():
-                    ties_h = np.asarray(ties)[:, : g1 - g0]
-                    for b in np.nonzero(small)[0]:
-                        tiefree = ties_h[b] == 0
-                        if tiefree.any():
-                            pe = wilcoxon_exact_host(
-                                u_h[b][tiefree],
-                                int(bucket.n1[b]),
-                                int(bucket.n2[b]),
-                            )
-                            row = log_p[bucket.rows[b], g0:g1]
-                            row[tiefree] = np.log(pe).astype(np.float32)
-                            log_p[bucket.rows[b], g0:g1] = row
+    for bucket, (idx, m1, m2, n1, n2), g0, g1, chunk in _chunk_tiles(
+        data, cell_idx_of, pair_i, pair_j
+    ):
+        lp, u, ties = _wilcox_chunk(chunk, idx, m1, m2, n1, n2)
+        lp_h = np.asarray(lp)[:, : g1 - g0]
+        u_h = np.asarray(u)[:, : g1 - g0]
+        log_p[bucket.rows, g0:g1] = lp_h
+        u_stat[bucket.rows, g0:g1] = u_h
+        if exact == "auto":
+            small = (bucket.n1 < EXACT_N_LIMIT) & (bucket.n2 < EXACT_N_LIMIT)
+            if small.any():
+                ties_h = np.asarray(ties)[:, : g1 - g0]
+                for b in np.nonzero(small)[0]:
+                    tiefree = ties_h[b] == 0
+                    if tiefree.any():
+                        pe = wilcoxon_exact_host(
+                            u_h[b][tiefree],
+                            int(bucket.n1[b]),
+                            int(bucket.n2[b]),
+                        )
+                        row = log_p[bucket.rows[b], g0:g1]
+                        row[tiefree] = np.log(pe).astype(np.float32)
+                        log_p[bucket.rows[b], g0:g1] = row
     return log_p, u_stat
 
 
@@ -242,7 +284,7 @@ def pairwise_de(
     method = config.method.lower()
     pi, pj = jnp.asarray(pair_i), jnp.asarray(pair_j)
 
-    if method in ("wilcox", "wilcoxon"):
+    if method in ("wilcox", "wilcoxon", "roc", "bimod", "t"):
         slow = method == "wilcoxon"
         with timer.stage("gates"):
             if slow:
@@ -266,8 +308,37 @@ def pairwise_de(
                 )
                 tested = np.asarray(gate)
                 pct1, pct2 = np.asarray(p1), np.asarray(p2)
-        with timer.stage("wilcox_test"):
-            log_p, _u = _run_wilcox(data, cell_idx_of, pair_i, pair_j)
+        aux: Optional[Dict[str, np.ndarray]] = None
+        stage_name = (
+            "wilcox_test" if method in ("wilcox", "wilcoxon") else f"{method}_test"
+        )
+        with timer.stage(stage_name):
+            if method == "bimod":
+                log_p = _run_tile_test(
+                    data, cell_idx_of, pair_i, pair_j, _bimod_chunk
+                )
+            elif method == "t":
+                log_p = _run_tile_test(
+                    data, cell_idx_of, pair_i, pair_j, _ttest_chunk
+                )
+            elif method == "roc":
+                # The reference's roc branch never produces a p-value usable
+                # downstream (dead Seurat helpers, SURVEY.md §2c); fixed
+                # behavior: AUC/power as the marker stats (N9: AUC falls out
+                # of the rank-sum statistic), rank-sum p for significance.
+                from scconsensus_tpu.ops.seurat_tests import auc_from_u
+
+                log_p, u = _run_wilcox(data, cell_idx_of, pair_i, pair_j)
+                n1s = np.array(
+                    [cell_idx_of[i].size for i in pair_i], np.float32
+                )[:, None]
+                n2s = np.array(
+                    [cell_idx_of[j].size for j in pair_j], np.float32
+                )[:, None]
+                auc, power = auc_from_u(jnp.asarray(u), n1s, n2s)
+                aux = {"auc": np.asarray(auc), "power": np.asarray(power)}
+            else:
+                log_p, _u = _run_wilcox(data, cell_idx_of, pair_i, pair_j)
         with timer.stage("bh_adjust"):
             if slow:
                 # BH with explicit n = G over all genes (§2d-4 slow semantics).
@@ -303,6 +374,7 @@ def pairwise_de(
             de_mask=de,
             pct1=pct1,
             pct2=pct2,
+            aux=aux,
         )
 
     if method == "edger":

@@ -1,0 +1,133 @@
+"""Seurat-style DE test kernels for the fast path: bimod LRT, Welch t, AUC.
+
+Reference: the ``switch`` dispatch inside ComputePairWiseDE
+(R/reclusterDEConsensusFast.R:306-333) with test bodies at :93-133 (bimod),
+:185-196 (t), :135-182 (roc). Note the reference's bimod and roc branches are
+dead on arrival — they call Seurat helpers (`MinMax`, `ExpMean`, `pblapply`)
+defined nowhere (SURVEY.md §2c) — so these kernels implement the *intended*
+published semantics (Seurat's zero-inflated-normal LRT, McDavid et al. 2013;
+R ``t.test`` Welch default; AUC as the normalized Mann-Whitney statistic).
+
+All kernels are moment-based masked reductions over a (B, G, W) tile — no
+sorts — so they are strictly cheaper than the rank-sum path and batch the
+same way (pairs × genes on the MXU-friendly reduction axis).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+__all__ = ["bimod_lrt_tile", "welch_t_tile", "auc_from_u"]
+
+_PI_CLIP_LO = 1e-5  # Seurat's MinMax(…, 1e-5, 1-1e-5) on the positive fraction
+
+
+def _zero_inflated_loglik(vals, mask, xmin: float):
+    """Seurat bimodLikData: log-likelihood of a zero-inflated normal fit.
+
+    vals/mask: (..., W). Positives are entries > xmin among masked cells.
+    sd uses the n−1 denominator (R ``sd``), and falls back to 1 when fewer
+    than 2 positive cells exist.
+    """
+    pos = mask & (vals > xmin)
+    n = jnp.sum(mask, axis=-1).astype(jnp.float32)
+    n_pos = jnp.sum(pos, axis=-1).astype(jnp.float32)
+    n_zero = n - n_pos
+    frac = jnp.clip(
+        n_pos / jnp.maximum(n, 1.0), _PI_CLIP_LO, 1.0 - _PI_CLIP_LO
+    )
+    vp = jnp.where(pos, vals, 0.0)
+    s = jnp.sum(vp, axis=-1)
+    ss = jnp.sum(vp * vp, axis=-1)
+    mean = s / jnp.maximum(n_pos, 1.0)
+    var = (ss - n_pos * mean * mean) / jnp.maximum(n_pos - 1.0, 1.0)
+    sd = jnp.where(n_pos < 2.0, 1.0, jnp.sqrt(jnp.maximum(var, 1e-30)))
+    # Σ log N(x; mean, sd) over positives, from the same moments:
+    # −n_pos·log(sd·√2π) − (ss − 2·mean·s + n_pos·mean²)/(2 sd²)
+    quad = ss - 2.0 * mean * s + n_pos * mean * mean
+    lik_pos = (
+        n_pos * jnp.log(frac)
+        - n_pos * (jnp.log(sd) + 0.5 * jnp.log(2.0 * jnp.pi))
+        - quad / (2.0 * sd * sd)
+    )
+    lik_zero = n_zero * jnp.log1p(-frac)
+    return lik_zero + lik_pos
+
+
+def bimod_lrt_tile(
+    vals: jnp.ndarray,
+    m1: jnp.ndarray,
+    m2: jnp.ndarray,
+    xmin: float = 0.0,
+) -> jnp.ndarray:
+    """Likelihood-ratio test of separate vs pooled zero-inflated normal fits,
+    χ² with 3 df (DifferentialLRT, R/reclusterDEConsensusFast.R:110-133).
+
+    vals: (B, G, W); m1/m2: (B, W) (broadcast over genes). Returns (B, G)
+    log p-values.
+    """
+    m1e = m1[:, None, :]
+    m2e = m2[:, None, :]
+    ll1 = _zero_inflated_loglik(vals, m1e, xmin)
+    ll2 = _zero_inflated_loglik(vals, m2e, xmin)
+    ll_pooled = _zero_inflated_loglik(vals, m1e | m2e, xmin)
+    lrt = 2.0 * (ll1 + ll2 - ll_pooled)
+    lrt = jnp.maximum(lrt, 0.0)
+    # log P(χ²₃ > lrt) = log Γ_upper-reg(3/2, lrt/2)
+    log_p = jnp.log(jnp.maximum(jsp.gammaincc(1.5, lrt / 2.0), 1e-38))
+    n1 = jnp.sum(m1, axis=-1)[:, None]
+    n2 = jnp.sum(m2, axis=-1)[:, None]
+    return jnp.where((n1 < 1) | (n2 < 1), jnp.nan, log_p)
+
+
+def welch_t_tile(
+    vals: jnp.ndarray, m1: jnp.ndarray, m2: jnp.ndarray
+) -> jnp.ndarray:
+    """Two-sided Welch t-test (R ``t.test`` default, var.equal=FALSE;
+    reference per-gene loop R/reclusterDEConsensusFast.R:185-196).
+
+    vals: (B, G, W); m1/m2: (B, W). Returns (B, G) log p-values via the
+    incomplete-beta tail of the t distribution with Welch–Satterthwaite df.
+    """
+    m1e = m1[:, None, :]
+    m2e = m2[:, None, :]
+
+    def moments(mask):
+        n = jnp.sum(mask, axis=-1).astype(jnp.float32)
+        v = jnp.where(mask, vals, 0.0)
+        s = jnp.sum(v, axis=-1)
+        ss = jnp.sum(v * v, axis=-1)
+        mean = s / jnp.maximum(n, 1.0)
+        var = (ss - n * mean * mean) / jnp.maximum(n - 1.0, 1.0)
+        return n, mean, jnp.maximum(var, 0.0)
+
+    n1, mu1, v1 = moments(m1e)
+    n2, mu2, v2 = moments(m2e)
+    se1 = v1 / jnp.maximum(n1, 1.0)
+    se2 = v2 / jnp.maximum(n2, 1.0)
+    se = se1 + se2
+    t = (mu1 - mu2) / jnp.sqrt(jnp.maximum(se, 1e-30))
+    df = se * se / jnp.maximum(
+        se1 * se1 / jnp.maximum(n1 - 1.0, 1.0)
+        + se2 * se2 / jnp.maximum(n2 - 1.0, 1.0),
+        1e-30,
+    )
+    # two-sided p = I_{df/(df+t²)}(df/2, 1/2)
+    x = df / (df + t * t)
+    log_p = jnp.log(jnp.maximum(jsp.betainc(df / 2.0, 0.5, x), 1e-38))
+    bad = (n1 < 2) | (n2 < 2) | (se <= 0.0)
+    return jnp.where(bad, jnp.nan, log_p)
+
+
+def auc_from_u(
+    u: jnp.ndarray, n1: jnp.ndarray, n2: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """AUC and Seurat's marker 'power' from the Mann-Whitney U statistic
+    (the ROCR AUC of the reference's roc branch equals U/(n1·n2) — SURVEY.md
+    §2b N9; power = 2|AUC − 0.5|, R/reclusterDEConsensusFast.R:144-150)."""
+    auc = u / jnp.maximum(n1 * n2, 1.0)
+    return auc, 2.0 * jnp.abs(auc - 0.5)
