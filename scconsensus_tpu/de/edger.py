@@ -132,13 +132,30 @@ def run_edger_pairs(
     """Run the NB pipeline for every bucketed pair.
 
     counts: (G, N) the matrix handed to DGEList (log-normalized data in
-    compat mode — the reference's literal behavior — or expm1 of it);
+    compat mode — the reference's literal behavior — or expm1 of it); may be
+    dense or scipy-sparse (gene chunks densified on demand);
     buckets: list of engine _PairBucket.
     """
-    counts = np.ascontiguousarray(counts, np.float32)
+    from scconsensus_tpu.io.sparsemat import (
+        as_csr,
+        is_sparse,
+        padded_row_chunk,
+        rows_dense,
+    )
+
+    sparse = is_sparse(counts)
+    if sparse:
+        counts = as_csr(counts)
+    else:
+        counts = np.ascontiguousarray(counts, np.float32)
     G = n_genes
-    jcounts = jnp.asarray(counts)
-    lib_all = jnp.sum(jcounts, axis=0)  # (N,) library sizes
+    jcounts = None if sparse else jnp.asarray(counts)
+    if sparse:
+        lib_all = jnp.asarray(
+            np.asarray(counts.sum(axis=0), np.float32).ravel()
+        )
+    else:
+        lib_all = jnp.sum(jcounts, axis=0)  # (N,) library sizes
 
     log_p = np.full((n_pairs, G), np.nan, np.float32)
     log_fc = np.full((n_pairs, G), np.nan, np.float32)
@@ -147,7 +164,10 @@ def run_edger_pairs(
 
     stride = max(1, G // _PILOT_MAX_GENES)
     sub_idx = np.arange(0, G, stride, dtype=np.int64)[:_PILOT_MAX_GENES]
-    jsub = jcounts[jnp.asarray(sub_idx)]
+    if sparse:
+        jsub = jnp.asarray(rows_dense(counts, sub_idx))
+    else:
+        jsub = jcounts[jnp.asarray(sub_idx)]
     deltas = delta_grid(24)
 
     for bucket in buckets:
@@ -182,9 +202,12 @@ def run_edger_pairs(
         ll_full = np.zeros((B, G, TAGWISE_GRID_EXPONENTS.shape[0]), np.float32)
         keep_full = np.zeros((B, G), bool)
         for g0 in range(0, G, gc):
-            chunk = jcounts[g0 : g0 + gc]
-            if chunk.shape[0] < gc:
-                chunk = jnp.pad(chunk, ((0, gc - chunk.shape[0]), (0, 0)))
+            if sparse:
+                chunk = jnp.asarray(padded_row_chunk(counts, g0, gc))
+            else:
+                chunk = jcounts[g0 : g0 + gc]
+                if chunk.shape[0] < gc:
+                    chunk = jnp.pad(chunk, ((0, gc - chunk.shape[0]), (0, 0)))
             s1, s2, ll_g, keep = _pass2_kernel(
                 chunk, idx, m1, m2, lib_tile, common_lib, common
             )

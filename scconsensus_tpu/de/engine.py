@@ -156,8 +156,16 @@ def _ttest_chunk(chunk, idx, m1, m2):
 def _chunk_tiles(data, cell_idx_of, pair_i, pair_j):
     """Shared bucket/gene-chunk iteration for every tile test: yields
     (bucket, (idx, m1, m2, n1, n2) device tensors, g0, g1, padded chunk).
-    Chunks are padded to a fixed width so each bucket shape compiles once."""
-    jdata = jnp.asarray(data)
+    Chunks are padded to a fixed width so each bucket shape compiles once.
+
+    ``data`` may be dense or scipy-sparse: only the current gene-chunk is
+    ever densified (the never-densify contract, SURVEY.md §2b N12). The
+    dense path keeps the whole matrix device-resident across buckets.
+    """
+    from scconsensus_tpu.io.sparsemat import is_sparse, padded_row_chunk
+
+    sparse = is_sparse(data)
+    jdata = None if sparse else jnp.asarray(data)
     G = data.shape[0]
     for bucket in _bucket_pairs(cell_idx_of, pair_i, pair_j):
         B, W = bucket.cell_idx.shape
@@ -171,9 +179,12 @@ def _chunk_tiles(data, cell_idx_of, pair_i, pair_j):
             jnp.asarray(bucket.n2),
         )
         for g0 in range(0, G, gc):
-            chunk = jdata[g0 : g0 + gc]
-            if chunk.shape[0] < gc:
-                chunk = jnp.pad(chunk, ((0, gc - chunk.shape[0]), (0, 0)))
+            if sparse:
+                chunk = jnp.asarray(padded_row_chunk(data, g0, gc))
+            else:
+                chunk = jdata[g0 : g0 + gc]
+                if chunk.shape[0] < gc:
+                    chunk = jnp.pad(chunk, ((0, gc - chunk.shape[0]), (0, 0)))
             yield bucket, tensors, g0, min(g0 + gc, G), chunk
 
 
@@ -249,10 +260,14 @@ def pairwise_de(
 
     data: (G, N) log-normalized expression; labels: per-cell cluster names.
     """
+    from scconsensus_tpu.io.sparsemat import as_csr, is_sparse, mean_expm1
     from scconsensus_tpu.utils.logging import StageTimer
 
     timer = timer or StageTimer()
-    data = np.ascontiguousarray(data, dtype=np.float32)
+    if is_sparse(data):
+        data = as_csr(data)  # canonicalize COO/CSC; sums duplicate entries
+    else:
+        data = np.ascontiguousarray(data, dtype=np.float32)
     G, N = data.shape
 
     with timer.stage("cluster_filter"):
@@ -279,7 +294,15 @@ def pairwise_de(
         onehot = np.zeros((N, K), np.float32)
         valid = cell_idx >= 0
         onehot[np.nonzero(valid)[0], cell_idx[valid]] = 1.0
-        agg = compute_aggregates(jnp.asarray(data), jnp.asarray(onehot))
+        if is_sparse(data):
+            from scconsensus_tpu.io.sparsemat import aggregates_from_sparse
+            from scconsensus_tpu.ops.gates import ClusterAggregates
+
+            agg = ClusterAggregates(
+                *(jnp.asarray(a) for a in aggregates_from_sparse(data, onehot))
+            )
+        else:
+            agg = compute_aggregates(jnp.asarray(data), jnp.asarray(onehot))
 
     method = config.method.lower()
     pi, pj = jnp.asarray(pair_i), jnp.asarray(pair_j)
@@ -290,8 +313,7 @@ def pairwise_de(
             if slow:
                 mean_gate, log_fc = pair_gates_slow(
                     agg, pi, pj,
-                    mean_exprs_thrs=config.mean_scaling_factor
-                    * float(np.mean(np.expm1(data))),
+                    mean_exprs_thrs=config.mean_scaling_factor * mean_expm1(data),
                     mixed_spaces=config.compat.mean_gate_mixed_spaces,
                 )
                 tested = np.ones((pair_i.size, G), bool)
@@ -383,17 +405,21 @@ def pairwise_de(
         # The reference passes the log-normalized matrix to DGEList as-is
         # (R/reclusterDEConsensus.R:133) — counts in log space. Compat keeps
         # that literal arithmetic; fixed mode tests on expm1(data).
-        expm1_data = np.expm1(data)
-        counts = data if config.compat.edger_log_counts else expm1_data
-        mean_expm1 = float(np.mean(expm1_data))
-        del expm1_data
+        from scconsensus_tpu.io.sparsemat import expm1_sparse, mean_value
+
+        if config.compat.edger_log_counts:
+            counts = data
+            gate_mean = mean_expm1(data)
+        else:
+            counts = expm1_sparse(data)
+            gate_mean = mean_value(counts)  # counts IS expm1(data): reuse it
         with timer.stage("edger_nb"):
             buckets = _bucket_pairs(cell_idx_of, pair_i, pair_j)
             nb = run_edger_pairs(counts, buckets, G, int(pair_i.size))
         with timer.stage("gates"):
             mean_gate, _slow_fc = pair_gates_slow(
                 agg, pi, pj,
-                mean_exprs_thrs=config.mean_scaling_factor * mean_expm1,
+                mean_exprs_thrs=config.mean_scaling_factor * gate_mean,
                 mixed_spaces=config.compat.mean_gate_mixed_spaces,
             )
         with timer.stage("bh_adjust"):

@@ -1,0 +1,37 @@
+"""Sparse-first data IO.
+
+The reference densifies everything at entry (``as.matrix`` at
+R/reclusterDEConsensus.R:32, per-call at R/reclusterDEConsensusFast.R:368);
+its only sparse-aware line is a ``Matrix::rowSums`` (SURVEY.md §2b N12). Here
+the contract is the opposite: matrices load as CSR (genes × cells), stay
+sparse on host, and only gene-chunk × cell-tile slices are densified onto the
+device — a 1M×20k matrix never materializes in full.
+"""
+
+from scconsensus_tpu.io.loaders import (
+    load_h5ad,
+    load_mtx,
+    load_npz,
+    log_normalize,
+)
+from scconsensus_tpu.io.sparsemat import (
+    aggregates_from_sparse,
+    expm1_sparse,
+    is_sparse,
+    mean_expm1,
+    nodg,
+    row_chunk_dense,
+)
+
+__all__ = [
+    "load_mtx",
+    "load_npz",
+    "load_h5ad",
+    "log_normalize",
+    "is_sparse",
+    "row_chunk_dense",
+    "expm1_sparse",
+    "mean_expm1",
+    "nodg",
+    "aggregates_from_sparse",
+]

@@ -1,0 +1,119 @@
+"""Sparse-matrix helpers behind the engine's never-densify contract.
+
+Every function accepts either a ``scipy.sparse`` matrix or a dense ndarray
+(the dense path is a passthrough), so the engine and pipeline stay agnostic:
+``is_sparse`` gates the few places where the code paths differ.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+try:  # scipy is available in this environment; gate defensively anyway
+    import scipy.sparse as _sp
+except ImportError:  # pragma: no cover
+    _sp = None
+
+__all__ = [
+    "is_sparse",
+    "as_csr",
+    "row_chunk_dense",
+    "padded_row_chunk",
+    "rows_dense",
+    "expm1_sparse",
+    "mean_expm1",
+    "mean_value",
+    "nodg",
+    "aggregates_from_sparse",
+]
+
+
+def is_sparse(x) -> bool:
+    return _sp is not None and _sp.issparse(x)
+
+
+def as_csr(x):
+    """Canonicalize any scipy-sparse format to CSR (summing duplicate COO
+    entries); dense input passes through. Entry points call this once so the
+    helpers below may assume a sliceable, canonical matrix."""
+    if is_sparse(x):
+        return x.tocsr()
+    return x
+
+
+def row_chunk_dense(x, g0: int, g1: int) -> np.ndarray:
+    """Dense float32 slice of rows [g0, g1) — the only densification the
+    engine performs (one gene-chunk × all-cells tile at a time)."""
+    if is_sparse(x):
+        return np.asarray(x[g0:g1].toarray(), dtype=np.float32)
+    return np.ascontiguousarray(x[g0:g1], dtype=np.float32)
+
+
+def padded_row_chunk(x, g0: int, width: int) -> np.ndarray:
+    """Dense float32 rows [g0, g0+width), zero-padded to exactly ``width``
+    rows (keeps every chunk shape identical so jit caches hold one entry).
+    The shared chunk primitive for the engine and NB driver loops."""
+    g1 = min(g0 + width, x.shape[0])
+    chunk = row_chunk_dense(x, g0, g1)
+    if chunk.shape[0] < width:
+        chunk = np.pad(chunk, ((0, width - chunk.shape[0]), (0, 0)))
+    return chunk
+
+
+def rows_dense(x, idx: np.ndarray) -> np.ndarray:
+    """Dense float32 gather of arbitrary gene rows (sparse-safe)."""
+    if is_sparse(x):
+        return np.asarray(x[idx].toarray(), dtype=np.float32)
+    return np.asarray(x[idx], dtype=np.float32)
+
+
+def expm1_sparse(x):
+    """expm1 applied to stored values only (expm1(0) = 0 keeps sparsity)."""
+    if is_sparse(x):
+        out = x.copy()
+        out.data = np.expm1(out.data)
+        return out
+    return np.expm1(x)
+
+
+def mean_expm1(x) -> float:
+    """mean(expm1(x)) over all entries (the slow path's global threshold
+    base, R/reclusterDEConsensus.R:36) without densifying."""
+    if is_sparse(x):
+        total = float(np.expm1(x.data).sum())
+        return total / float(x.shape[0] * x.shape[1])
+    return float(np.mean(np.expm1(x)))
+
+
+def mean_value(x) -> float:
+    """Mean over all entries without densifying."""
+    if is_sparse(x):
+        return float(x.sum()) / float(x.shape[0] * x.shape[1])
+    return float(np.mean(x))
+
+
+def nodg(x) -> np.ndarray:
+    """Number of detected genes per cell: column-wise nonzero counts
+    (the reference's O(N·G) interpreted loop, R/reclusterDEConsensus.R:272)."""
+    if is_sparse(x):
+        return np.asarray(x.astype(bool).sum(axis=0)).ravel().astype(np.int64)
+    return (x > 0).sum(axis=0).astype(np.int64)
+
+
+def aggregates_from_sparse(x, onehot: np.ndarray) -> Tuple[np.ndarray, ...]:
+    """Per-cluster sufficient statistics (Σx, Σexpm1 x, Σ[x>0], counts) as
+    host sparse matmuls against the membership one-hot — the sparse analog of
+    ops.gates.compute_aggregates' three MXU matmuls."""
+    counts = onehot.sum(axis=0)
+    if is_sparse(x):
+        sum_log = np.asarray(x @ onehot, dtype=np.float32)
+        sum_expm1 = np.asarray(expm1_sparse(x) @ onehot, dtype=np.float32)
+        nnz_mat = x.astype(bool).astype(np.float32)
+        nnz = np.asarray(nnz_mat @ onehot, dtype=np.float32)
+    else:
+        sum_log = x @ onehot
+        sum_expm1 = np.expm1(x) @ onehot
+        nnz = (x > 0).astype(np.float32) @ onehot
+    return sum_log, sum_expm1, nnz, counts.astype(np.float32)

@@ -70,11 +70,25 @@ def refine(
       labels: per-cell consensus cluster labels (e.g. from
         ``plot_contingency_table``).
     """
+    from scconsensus_tpu.io.sparsemat import (
+        as_csr,
+        is_sparse,
+        nodg as sparse_nodg,
+        rows_dense,
+    )
+
     logger = get_logger()
     timer = timer or StageTimer(logger)
     store = ArtifactStore(config.artifact_dir)
-    data = np.ascontiguousarray(data, dtype=np.float32)
+    if is_sparse(data):
+        data = as_csr(data)
+    else:
+        data = np.ascontiguousarray(data, dtype=np.float32)
     G, N = data.shape
+
+    def _rows_dense(idx: np.ndarray) -> np.ndarray:
+        """Dense (|idx|, N) gather of gene rows (sparse-safe)."""
+        return rows_dense(data, idx)
     if len(labels) != N:
         raise ValueError(f"labels length {len(labels)} != n_cells {N}")
 
@@ -106,12 +120,12 @@ def refine(
                 # distance = sqrt(2·(1−r)) — monotone in Pearson distance —
                 # then reduce with PCA. Cluster geometry matches 1−r; absolute
                 # tree heights differ by the monotone transform.
-                cols = data[union]  # (|U|, N)
+                cols = _rows_dense(union)  # (|U|, N)
                 c = cols - cols.mean(axis=0, keepdims=True)
                 norm = np.linalg.norm(c, axis=0, keepdims=True)
                 cells = (c / np.maximum(norm, 1e-12)).T  # (N, |U|)
             else:
-                cells = data[union].T
+                cells = _rows_dense(union).T
             scores = pca_scores(jnp.asarray(cells), n_pcs)
             return {"scores": np.asarray(scores)}
 
@@ -173,7 +187,7 @@ def refine(
     with timer.stage("nodg"):
         # per-cell number of detected genes; the reference's O(N·G)
         # interpreted loop (R/reclusterDEConsensus.R:272-275) is one reduction
-        nodg = (data > 0).sum(axis=0).astype(np.int64)
+        nodg = sparse_nodg(data)
 
     union_names = (
         np.asarray(gene_names)[union] if gene_names is not None else union.copy()
@@ -197,7 +211,7 @@ def refine(
             from scconsensus_tpu.report.de_heatmap import cell_type_de_plot
 
             cell_type_de_plot(
-                data_matrix=data[union],
+                data_matrix=_rows_dense(union),
                 nodg=nodg,
                 cell_tree=tree,
                 cluster_labels=np.asarray(labels).astype(str),

@@ -15,7 +15,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "pad_axis_to_multiple", "CELL_AXIS"]
+__all__ = ["make_mesh", "pad_axis_to_multiple", "require_dense", "CELL_AXIS"]
 
 CELL_AXIS = "cells"
 
@@ -34,6 +34,23 @@ def make_mesh(
             )
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (axis_name,))
+
+
+def require_dense(*arrays) -> None:
+    """The mesh-parallel entry points operate on device-resident dense
+    arrays; reject scipy sparse input with a pointer to the serial engine
+    (which densifies one gene chunk at a time) instead of letting np.asarray
+    fail with an opaque ValueError."""
+    from scconsensus_tpu.io.sparsemat import is_sparse
+
+    for x in arrays:
+        if is_sparse(x):
+            raise TypeError(
+                "mesh-parallel entry points require dense arrays; got a scipy "
+                "sparse matrix — densify the relevant slice first, or use the "
+                "serial engine (scconsensus_tpu.de.pairwise_de), which handles "
+                "sparse input by densifying one gene chunk at a time"
+            )
 
 
 def pad_axis_to_multiple(

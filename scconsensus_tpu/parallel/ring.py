@@ -24,7 +24,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from scconsensus_tpu.parallel.mesh import CELL_AXIS, make_mesh, pad_axis_to_multiple
+from scconsensus_tpu.parallel.mesh import (
+    CELL_AXIS,
+    make_mesh,
+    pad_axis_to_multiple,
+    require_dense,
+)
 
 __all__ = [
     "ring_cluster_distance_sums",
@@ -74,6 +79,7 @@ def ring_cluster_distance_sums(
     x: (N, d) embedding; onehot: (N, K) membership (zero rows allowed — e.g.
     padding or unassigned cells contribute to no cluster).
     """
+    require_dense(x, onehot)
     mesh = mesh or make_mesh(axis_name=axis_name)
     n_shards = mesh.devices.size
     n = x.shape[0]
@@ -110,6 +116,7 @@ def sharded_silhouette_widths(
     but scales across the mesh: no device ever holds more than N/n_shards
     rows of distance work.
     """
+    require_dense(x)
     labels = np.asarray(labels)
     n = labels.shape[0]
     valid = labels >= 0
@@ -181,6 +188,7 @@ def ring_knn(
     from results; self-neighbors are excluded. ``k`` must be < N (each row
     has only N−1 real neighbors).
     """
+    require_dense(x)
     mesh = mesh or make_mesh(axis_name=axis_name)
     n_shards = mesh.devices.size
     n = x.shape[0]

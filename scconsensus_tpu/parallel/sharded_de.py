@@ -23,7 +23,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from scconsensus_tpu.ops.gates import ClusterAggregates
 from scconsensus_tpu.ops.wilcoxon import wilcoxon_pairs_tile
-from scconsensus_tpu.parallel.mesh import CELL_AXIS, make_mesh, pad_axis_to_multiple
+from scconsensus_tpu.parallel.mesh import (
+    CELL_AXIS,
+    make_mesh,
+    pad_axis_to_multiple,
+    require_dense,
+)
 
 __all__ = ["sharded_aggregates", "sharded_wilcox_logp"]
 
@@ -50,6 +55,7 @@ def sharded_aggregates(
     data: (G, N) log-normalized; onehot: (N, K). Padding cells (zero onehot
     rows, zero data columns) do not perturb any statistic.
     """
+    require_dense(data, onehot)
     mesh = mesh or make_mesh(axis_name=axis_name)
     n_shards = mesh.devices.size
     dp, _ = pad_axis_to_multiple(np.asarray(data, np.float32), 1, n_shards)
@@ -95,6 +101,7 @@ def sharded_wilcox_logp(
     data: (G, N); idx/m1/m2: (B, W) gathered pair-cells; n1/n2: (B,).
     Returns (B, G) log p-values.
     """
+    require_dense(data)
     mesh = mesh or make_mesh(axis_name=axis_name)
     n_shards = mesh.devices.size
     G = data.shape[0]
