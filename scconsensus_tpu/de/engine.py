@@ -207,6 +207,116 @@ def _run_tile_test(
     return log_p
 
 
+@jax.jit
+def _wilcox_task_chunk(
+    data: jnp.ndarray,   # (G, N) device-resident full matrix
+    gid: jnp.ndarray,    # (T,) gene index per task
+    pidx: jnp.ndarray,   # (T,) bucket-local pair index per task
+    idx: jnp.ndarray,    # (B, W) pair cell gathers
+    m1: jnp.ndarray,     # (B, W)
+    m2: jnp.ndarray,
+    n1: jnp.ndarray,     # (B,)
+    n2: jnp.ndarray,
+):
+    """Rank-sum over a flat (pair, gene) task list — the gated fast path.
+
+    Each task is one gene of one pair; batching tasks instead of (pairs ×
+    all-genes) tiles means only gate-surviving genes are ever ranked (the
+    reference's fast path tests only survivors,
+    R/reclusterDEConsensusFast.R:306-333) and load is balanced across pairs.
+    Returns (log_p, u, tie_sum), each (T,).
+    """
+    cell_rows = jnp.take(idx, pidx, axis=0)          # (T, W)
+    vals = data[gid[:, None], cell_rows]             # (T, W) double gather
+    mask1 = jnp.take(m1, pidx, axis=0)
+    mask2 = jnp.take(m2, pidx, axis=0)
+    from scconsensus_tpu.ops.ranks import masked_midranks
+
+    ranks, tie_sum = masked_midranks(vals, mask1 | mask2)
+    rs1 = jnp.sum(jnp.where(mask1, ranks, 0.0), axis=-1)
+    from scconsensus_tpu.ops.wilcoxon import wilcoxon_from_ranks
+
+    log_p, u = wilcoxon_from_ranks(
+        rs1, tie_sum, jnp.take(n1, pidx), jnp.take(n2, pidx)
+    )
+    return log_p, u, tie_sum
+
+
+def _exact_host_update(
+    log_p: np.ndarray, row: int, cols: np.ndarray, u_vals: np.ndarray,
+    n1: int, n2: int,
+) -> None:
+    """Overwrite log_p[row, cols] with R's exact-branch p-values (shared by
+    the tile and task paths so the policy and arithmetic cannot drift)."""
+    pe = wilcoxon_exact_host(u_vals, n1, n2)
+    log_p[row, cols] = np.log(pe).astype(np.float32)
+
+
+def _run_wilcox_gated(
+    data: np.ndarray,
+    cell_idx_of: List[np.ndarray],
+    pair_i: np.ndarray,
+    pair_j: np.ndarray,
+    tested: np.ndarray,
+    exact: str = "auto",
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Rank-sum log-p over only the gate-surviving (pair, gene) tasks.
+
+    Dense-input fast path; results for untested entries stay NaN (they are
+    masked out of BH and the DE call anyway — fast-path semantics). Returns
+    (log_p (P, G), u (P, G)).
+    """
+    G, _ = data.shape
+    P = pair_i.shape[0]
+    log_p = np.full((P, G), np.nan, np.float32)
+    u_stat = np.full((P, G), np.nan, np.float32)
+    jdata = jnp.asarray(data)
+    for bucket in _bucket_pairs(cell_idx_of, pair_i, pair_j):
+        B, W = bucket.cell_idx.shape
+        pr, gi = np.nonzero(tested[bucket.rows])  # bucket-local task list
+        if pr.size == 0:
+            continue
+        # Chunk width depends only on W (never on the data-dependent task
+        # count) so each bucket shape compiles exactly once across calls.
+        tb = min(_next_pow2(max(256, _CHUNK_ELEM_BUDGET // max(W, 1))), 16384)
+        idx = jnp.asarray(bucket.cell_idx)
+        m1 = jnp.asarray(bucket.mask1)
+        m2 = jnp.asarray(bucket.mask2)
+        n1 = jnp.asarray(bucket.n1)
+        n2 = jnp.asarray(bucket.n2)
+        for t0 in range(0, pr.size, tb):
+            t1 = min(t0 + tb, pr.size)
+            pad = tb - (t1 - t0)
+            prt = np.pad(pr[t0:t1], (0, pad))
+            git = np.pad(gi[t0:t1], (0, pad))
+            lp, u, ties = _wilcox_task_chunk(
+                jdata, jnp.asarray(git), jnp.asarray(prt),
+                idx, m1, m2, n1, n2,
+            )
+            lp_h = np.asarray(lp)[: t1 - t0]
+            u_h = np.asarray(u)[: t1 - t0]
+            rows = bucket.rows[pr[t0:t1]]
+            cols = gi[t0:t1]
+            log_p[rows, cols] = lp_h
+            u_stat[rows, cols] = u_h
+            if exact == "auto":
+                prt_real = pr[t0:t1]
+                small = (bucket.n1[prt_real] < EXACT_N_LIMIT) & (
+                    bucket.n2[prt_real] < EXACT_N_LIMIT
+                )
+                if small.any():
+                    ties_h = np.asarray(ties)[: t1 - t0]
+                    pick = small & (ties_h == 0)
+                    # one vectorized exact call per pair, as the tile path does
+                    for b in np.unique(prt_real[pick]):
+                        sel = pick & (prt_real == b)
+                        _exact_host_update(
+                            log_p, bucket.rows[b], gi[t0:t1][sel], u_h[sel],
+                            int(bucket.n1[b]), int(bucket.n2[b]),
+                        )
+    return log_p, u_stat
+
+
 def _run_wilcox(
     data: np.ndarray,
     cell_idx_of: List[np.ndarray],
@@ -239,14 +349,11 @@ def _run_wilcox(
                 for b in np.nonzero(small)[0]:
                     tiefree = ties_h[b] == 0
                     if tiefree.any():
-                        pe = wilcoxon_exact_host(
-                            u_h[b][tiefree],
-                            int(bucket.n1[b]),
-                            int(bucket.n2[b]),
+                        cols = g0 + np.nonzero(tiefree)[0]
+                        _exact_host_update(
+                            log_p, bucket.rows[b], cols, u_h[b][tiefree],
+                            int(bucket.n1[b]), int(bucket.n2[b]),
                         )
-                        row = log_p[bucket.rows[b], g0:g1]
-                        row[tiefree] = np.log(pe).astype(np.float32)
-                        log_p[bucket.rows[b], g0:g1] = row
     return log_p, u_stat
 
 
@@ -334,6 +441,17 @@ def pairwise_de(
         stage_name = (
             "wilcox_test" if method in ("wilcox", "wilcoxon") else f"{method}_test"
         )
+
+        def _rank_sum(need_all_genes: bool = False):
+            """Fast path tests only gate survivors (dense input); the slow
+            path, sparse inputs, and callers needing per-gene statistics for
+            every gene (roc's AUC) rank full tiles."""
+            if not slow and not need_all_genes and not is_sparse(data):
+                return _run_wilcox_gated(
+                    data, cell_idx_of, pair_i, pair_j, tested
+                )
+            return _run_wilcox(data, cell_idx_of, pair_i, pair_j)
+
         with timer.stage(stage_name):
             if method == "bimod":
                 log_p = _run_tile_test(
@@ -350,7 +468,9 @@ def pairwise_de(
                 # of the rank-sum statistic), rank-sum p for significance.
                 from scconsensus_tpu.ops.seurat_tests import auc_from_u
 
-                log_p, u = _run_wilcox(data, cell_idx_of, pair_i, pair_j)
+                # AUC/power are marker statistics reported for every gene —
+                # rank full tiles so dense and sparse inputs agree exactly.
+                log_p, u = _rank_sum(need_all_genes=True)
                 n1s = np.array(
                     [cell_idx_of[i].size for i in pair_i], np.float32
                 )[:, None]
@@ -360,7 +480,7 @@ def pairwise_de(
                 auc, power = auc_from_u(jnp.asarray(u), n1s, n2s)
                 aux = {"auc": np.asarray(auc), "power": np.asarray(power)}
             else:
-                log_p, _u = _run_wilcox(data, cell_idx_of, pair_i, pair_j)
+                log_p, _u = _rank_sum()
         with timer.stage("bh_adjust"):
             if slow:
                 # BH with explicit n = G over all genes (§2d-4 slow semantics).

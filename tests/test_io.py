@@ -91,8 +91,11 @@ def test_engine_sparse_equals_dense(rng):
     cfg = ReclusterConfig(method="wilcox")
     dense_res = pairwise_de(data, lab, cfg)
     sparse_res = pairwise_de(sp.csr_matrix(data), lab, cfg)
+    # dense fast path is gate-filtered (untested log_p stays NaN); the sparse
+    # path ranks full tiles — compare where both tested, and the DE calls.
+    t = dense_res.tested
     np.testing.assert_allclose(
-        sparse_res.log_p, dense_res.log_p, rtol=1e-5, atol=1e-5, equal_nan=True
+        sparse_res.log_p[t], dense_res.log_p[t], rtol=1e-5, atol=1e-5
     )
     np.testing.assert_array_equal(sparse_res.de_mask, dense_res.de_mask)
 
