@@ -1,56 +1,67 @@
-"""Silhouette widths over blocked distance tiles.
+"""Silhouette widths from per-cluster distance sums.
 
 The reference computes per-deepSplit mean silhouette at O(N²) host cost and
 then discards it (R/reclusterDEConsensusFast.R:415-433; quirk §2d-6). Here it
-is a device reduction over distance row-blocks — the N×N matrix is never
-materialized — and the pipeline *returns* it.
+is a device reduction — the N×N matrix is never materialized — and the
+pipeline *returns* it.
 
-Semantics match ``cluster::silhouette``: a(i) = mean distance to own cluster's
-other members; b(i) = min over other clusters of mean distance; s(i) =
-(b−a)/max(a,b); singleton clusters get s = 0. The reported scalar is the mean
-of per-cluster average widths (the reference's ``clus.avg.widths`` mean).
+The sufficient statistic is S (N, K) = Σ_{j∈cluster k} d(i, j), produced by
+one of three interchangeable engines: the fused Pallas kernel (TPU), blocked
+XLA matmuls, or the mesh-sharded ring (parallel.ring). The width arithmetic
+(`widths_from_cluster_sums`) is shared by all three.
+
+Semantics match ``cluster::silhouette``: a(i) = mean distance to own
+cluster's other members; b(i) = min over other clusters of mean distance;
+s(i) = (b−a)/max(a,b); singleton clusters get s = 0. The reported scalar is
+the mean of per-cluster average widths (the reference's ``clus.avg.widths``).
 """
 
 from __future__ import annotations
 
 from typing import Dict, Tuple
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["silhouette_widths", "mean_cluster_silhouette"]
+__all__ = [
+    "silhouette_widths",
+    "mean_cluster_silhouette",
+    "widths_from_cluster_sums",
+]
 
 
-@jax.jit
-def _block_widths(x_block, x_all, onehot, counts, own):
-    """Silhouette widths for a row-block.
-
-    x_block: (B, d); x_all: (N, d); onehot: (N, K); counts: (K,);
-    own: (B,) cluster index of each block row.
-    """
-    a2 = jnp.sum(x_block * x_block, axis=1, keepdims=True)
-    b2 = jnp.sum(x_all * x_all, axis=1, keepdims=True)
-    d = jnp.sqrt(jnp.maximum(a2 + b2.T - 2.0 * (x_block @ x_all.T), 0.0))  # (B, N)
-    sums = d @ onehot  # (B, K) summed distance to each cluster
-    k = onehot.shape[1]
-    own_oh = jax.nn.one_hot(own, k, dtype=x_block.dtype)  # (B, K)
-    n_own = jnp.sum(own_oh * counts[None, :], axis=1)  # (B,)
-    sum_own = jnp.sum(own_oh * sums, axis=1)
-    a = sum_own / jnp.maximum(n_own - 1.0, 1.0)  # d(i,i)=0 excluded
-    mean_other = sums / jnp.maximum(counts[None, :], 1.0)
-    mean_other = jnp.where(own_oh > 0, jnp.inf, mean_other)
-    b = jnp.min(mean_other, axis=1)
-    s = (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30)
-    s = jnp.where(n_own <= 1.0, 0.0, s)  # singleton clusters: s = 0
-    return s
+def widths_from_cluster_sums(
+    sums: np.ndarray, counts: np.ndarray, own: np.ndarray
+) -> np.ndarray:
+    """Per-point silhouette widths from S (N, K), cluster sizes (K,), and
+    each point's own-cluster index (N,). Self-distance is zero, so the
+    own-cluster mean divides by (n_own − 1)."""
+    n = sums.shape[0]
+    idx = np.arange(n)
+    sum_own = sums[idx, own]
+    n_own = counts[own]
+    a = sum_own / np.maximum(n_own - 1.0, 1.0)
+    mean_other = sums / np.maximum(counts[None, :], 1.0)
+    mean_other[idx, own] = np.inf
+    b = mean_other.min(axis=1)
+    s = (b - a) / np.maximum(np.maximum(a, b), 1e-30)
+    return np.where(n_own <= 1.0, 0.0, s).astype(np.float32)
 
 
 def silhouette_widths(
-    x: np.ndarray, labels: np.ndarray, block: int = 4096
+    x: np.ndarray,
+    labels: np.ndarray,
+    block: int = 4096,
+    backend: str = "auto",
 ) -> np.ndarray:
-    """Per-cell silhouette widths from the embedding (N, d) and integer labels.
-    Cells with label < 0 are excluded (width NaN)."""
+    """Per-cell silhouette widths from the embedding (N, d) and integer
+    labels. Cells with label < 0 are excluded (width NaN).
+
+    ``backend`` selects the distance-sums engine (see
+    ops.pallas_kernels.distance_cluster_sums): 'auto' fuses on TPU via
+    Pallas and falls back to blocked XLA elsewhere.
+    """
+    from scconsensus_tpu.ops.pallas_kernels import distance_cluster_sums
+
     labels = np.asarray(labels)
     valid = labels >= 0
     uniq, inv = np.unique(labels[valid], return_inverse=True)
@@ -62,26 +73,18 @@ def silhouette_widths(
     xv = np.ascontiguousarray(x[valid], np.float32)
     onehot = np.zeros((xv.shape[0], k), np.float32)
     onehot[np.arange(xv.shape[0]), inv] = 1.0
+    sums = distance_cluster_sums(xv, onehot, backend=backend, block=block)
     counts = onehot.sum(axis=0)
-    jx = jnp.asarray(xv)
-    joh = jnp.asarray(onehot)
-    jc = jnp.asarray(counts)
-    widths = np.empty(xv.shape[0], np.float32)
-    for s in range(0, xv.shape[0], block):
-        e = min(s + block, xv.shape[0])
-        widths[s:e] = np.asarray(
-            _block_widths(jx[s:e], jx, joh, jc, jnp.asarray(inv[s:e]))
-        )
-    out[valid] = widths
+    out[valid] = widths_from_cluster_sums(sums, counts, inv)
     return out
 
 
 def mean_cluster_silhouette(
-    x: np.ndarray, labels: np.ndarray, block: int = 4096
+    x: np.ndarray, labels: np.ndarray, block: int = 4096, backend: str = "auto"
 ) -> Tuple[float, Dict[int, float]]:
     """Mean of per-cluster average widths (reference's reported SI,
     R/reclusterDEConsensusFast.R:433) plus the per-cluster breakdown."""
-    w = silhouette_widths(x, labels, block)
+    w = silhouette_widths(x, labels, block, backend=backend)
     labels = np.asarray(labels)
     per: Dict[int, float] = {}
     for u in np.unique(labels[labels >= 0]):

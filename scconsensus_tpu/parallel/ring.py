@@ -129,18 +129,10 @@ def sharded_silhouette_widths(
     onehot[np.nonzero(valid)[0], inv_all] = 1.0
     sums = ring_cluster_distance_sums(x, onehot, mesh, axis_name)  # (N, K)
     counts = onehot.sum(axis=0)  # (K,)
-    own = np.full(n, -1, np.int64)
-    own[valid] = inv_all
+    from scconsensus_tpu.ops.silhouette import widths_from_cluster_sums
+
     iv = np.nonzero(valid)[0]
-    sum_own = sums[iv, own[iv]]
-    n_own = counts[own[iv]]
-    a = sum_own / np.maximum(n_own - 1.0, 1.0)
-    mean_other = sums[iv] / np.maximum(counts[None, :], 1.0)
-    mean_other[np.arange(iv.size), own[iv]] = np.inf
-    b = mean_other.min(axis=1)
-    s = (b - a) / np.maximum(np.maximum(a, b), 1e-30)
-    s = np.where(n_own <= 1.0, 0.0, s)
-    out[iv] = s.astype(np.float32)
+    out[iv] = widths_from_cluster_sums(sums[iv], counts, inv_all)
     return out
 
 

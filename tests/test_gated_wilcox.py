@@ -3,8 +3,6 @@ NaN elsewhere, same DE calls as the full-tile path."""
 
 import numpy as np
 
-from scconsensus_tpu.config import ReclusterConfig
-from scconsensus_tpu.de import pairwise_de
 from scconsensus_tpu.de.engine import (
     _run_wilcox,
     _run_wilcox_gated,
@@ -55,16 +53,5 @@ def test_gated_exact_branch_small_clusters(rng):
     np.testing.assert_allclose(gated_lp[0], full_lp[0], rtol=1e-5, atol=1e-5)
 
 
-def test_pipeline_de_calls_unchanged_by_gating(rng):
-    data, labels, _ = synthetic_scrna(n_genes=200, n_cells=300, n_clusters=3, seed=21)
-    lab = np.array([f"c{v}" for v in labels])
-    import scipy.sparse as sp
-
-    cfg = ReclusterConfig(method="wilcox")
-    gated = pairwise_de(data, lab, cfg)          # dense → gated
-    ungated = pairwise_de(sp.csr_matrix(data), lab, cfg)  # sparse → full tiles
-    np.testing.assert_array_equal(gated.de_mask, ungated.de_mask)
-    t = gated.tested
-    np.testing.assert_allclose(
-        gated.log_q[t], ungated.log_q[t], rtol=1e-4, atol=1e-4, equal_nan=True
-    )
+# Dense(gated) vs sparse(full-tile) engine equivalence is covered by
+# tests/test_io.py::test_engine_sparse_equals_dense (log_p/log_q/de_mask).

@@ -97,6 +97,10 @@ def test_engine_sparse_equals_dense(rng):
     np.testing.assert_allclose(
         sparse_res.log_p[t], dense_res.log_p[t], rtol=1e-5, atol=1e-5
     )
+    np.testing.assert_allclose(
+        sparse_res.log_q[t], dense_res.log_q[t], rtol=1e-4, atol=1e-4,
+        equal_nan=True,
+    )
     np.testing.assert_array_equal(sparse_res.de_mask, dense_res.de_mask)
 
 
