@@ -70,6 +70,44 @@ class PairwiseDEResult:
         R/reclusterDEConsensus.R:172-178 — here a returned metric)."""
         return self.de_mask.sum(axis=1)
 
+    _ARRAY_FIELDS = ("pair_i", "pair_j", "log_p", "log_q", "log_fc",
+                     "tested", "de_mask")
+    _OPT_ARRAY_FIELDS = ("pct1", "pct2")
+
+    def to_store(self) -> Tuple[Dict[str, np.ndarray], Dict]:
+        """(arrays, meta) for ArtifactStore — the single serialization point,
+        so the field list cannot drift from the dataclass."""
+        arrays = {f: getattr(self, f) for f in self._ARRAY_FIELDS}
+        for f in self._OPT_ARRAY_FIELDS:
+            v = getattr(self, f)
+            if v is not None:
+                arrays[f] = v
+        if self.aux:
+            for k, v in self.aux.items():
+                arrays[f"aux_{k}"] = np.asarray(v)
+        return arrays, {"cluster_names": self.cluster_names}
+
+    @classmethod
+    def from_store(cls, arrays: Dict[str, np.ndarray], meta: Dict
+                   ) -> "PairwiseDEResult":
+        """Inverse of to_store. Raises ValueError on incomplete artifacts
+        (e.g. a missing meta sidecar) so callers recompute instead of
+        resuming into a corrupt state."""
+        if "cluster_names" not in meta:
+            raise ValueError("de artifact incomplete: missing cluster_names meta")
+        missing = [f for f in cls._ARRAY_FIELDS if f not in arrays]
+        if missing:
+            raise ValueError(f"de artifact incomplete: missing arrays {missing}")
+        aux = {
+            k[len("aux_"):]: v for k, v in arrays.items() if k.startswith("aux_")
+        }
+        return cls(
+            cluster_names=list(meta["cluster_names"]),
+            **{f: arrays[f] for f in cls._ARRAY_FIELDS},
+            **{f: arrays.get(f) for f in cls._OPT_ARRAY_FIELDS},
+            aux=aux or None,
+        )
+
 
 def filter_clusters(
     labels: Sequence, min_cluster_size: int, drop_grey: bool = True

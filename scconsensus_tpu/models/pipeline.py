@@ -92,7 +92,17 @@ def refine(
     if len(labels) != N:
         raise ValueError(f"labels length {len(labels)} != n_cells {N}")
 
-    de_res = pairwise_de(data, labels, config, timer=timer)
+    store.check_config(config.to_json())
+    de_res = None
+    if store.has("de"):
+        try:
+            de_res = PairwiseDEResult.from_store(*store.load("de"))
+            logger.info("stage de: resumed from artifact store")
+        except ValueError as e:
+            logger.warning("stage de: artifact unusable (%s); recomputing", e)
+    if de_res is None:
+        de_res = pairwise_de(data, labels, config, timer=timer)
+        store.save("de", *de_res.to_store())
 
     with timer.stage("union") as rec:
         union = store.cached(
@@ -134,16 +144,28 @@ def refine(
     with timer.stage("tree", n_cells=N) as rec:
         approx = N > config.approx_threshold
         rec["approx"] = approx
-        if approx:
-            from scconsensus_tpu.ops.pooling import pooled_ward_linkage
 
-            tree, pool_assign, pool_centroids = pooled_ward_linkage(
-                embedding, n_centroids=config.n_pool_centroids,
-                seed=config.random_seed,
-            )
-        else:
-            tree = ward_linkage(embedding)
-            pool_assign, pool_centroids = None, None
+        def _tree():
+            if approx:
+                from scconsensus_tpu.ops.pooling import pooled_ward_linkage
+
+                t, assign, cents = pooled_ward_linkage(
+                    embedding, n_centroids=config.n_pool_centroids,
+                    seed=config.random_seed,
+                )
+                return {"merge": t.merge, "height": t.height, "order": t.order,
+                        "pool_assign": assign, "pool_centroids": cents}
+            t = ward_linkage(embedding)
+            return {"merge": t.merge, "height": t.height, "order": t.order}
+
+        tree_arrays = store.cached("tree", _tree)
+        tree = HClustTree(
+            merge=tree_arrays["merge"],
+            height=tree_arrays["height"],
+            order=tree_arrays["order"],
+        )
+        pool_assign = tree_arrays.get("pool_assign")
+        pool_centroids = tree_arrays.get("pool_centroids")
 
     dynamic_colors: Dict[str, np.ndarray] = {}
     dynamic_labels: Dict[str, np.ndarray] = {}
@@ -157,16 +179,25 @@ def refine(
             avg_pool = max(N / pool_centroids.shape[0], 1.0)
             cut_points = pool_centroids
             cut_min_size = max(2, int(round(config.min_cluster_size / avg_pool)))
+
+        def _cuts():
+            out = {}
+            for dsv in config.deep_split_values:
+                cut_labels = cutree_hybrid(
+                    tree,
+                    cut_points,
+                    deep_split=int(dsv),
+                    min_cluster_size=cut_min_size,
+                    pam_stage=config.pam_stage,
+                )
+                if pool_assign is not None:
+                    cut_labels = cut_labels[pool_assign]
+                out[f"ds{dsv}"] = cut_labels
+            return out
+
+        cut_arrays = store.cached("cuts", _cuts)
         for dsv in config.deep_split_values:
-            cut_labels = cutree_hybrid(
-                tree,
-                cut_points,
-                deep_split=int(dsv),
-                min_cluster_size=cut_min_size,
-                pam_stage=config.pam_stage,
-            )
-            if pool_assign is not None:
-                cut_labels = cut_labels[pool_assign]
+            cut_labels = cut_arrays[f"ds{dsv}"]
             key = f"deepsplit: {dsv}"
             dynamic_labels[key] = cut_labels
             dynamic_colors[key] = labels_to_colors(cut_labels)

@@ -62,12 +62,18 @@ def cell_type_de_plot(
     col_scheme: str = "violet",
     filename: str = "DE_Heatmap.png",
     max_cells_rendered: int = 4000,
+    cluster_genes: bool = True,
+    gene_groups: Optional[Sequence[str]] = None,
 ) -> None:
     """Render the DE heatmap report.
 
     data_matrix: (|U|, N) expression of the DE-gene union;
     cell_tree: HClustTree whose ``order`` sets the column order;
-    dynamic_colors_list: {"deepsplit: k": color-name per cell}.
+    dynamic_colors_list: {"deepsplit: k": color-name per cell};
+    cluster_genes: order rows by a Ward dendrogram over genes (the
+    reference Heatmap's row clustering, R/cellTypeDEPlot.R:225-253);
+    gene_groups: optional per-gene group names rendered as a row-annotation
+    color bar (the reference's geneLabels annotation, :260-282).
 
     Columns are downsampled (in dendrogram order) past ``max_cells_rendered``
     — the reference rasterizes a 50×50-inch PDF instead (:250-258).
@@ -89,6 +95,17 @@ def cell_type_de_plot(
     mat = np.asarray(data_matrix)[:, sel]
     labels = np.asarray(cluster_labels).astype(str)[sel]
     nodg_o = np.asarray(nodg)[sel]
+
+    gene_order = np.arange(mat.shape[0])
+    if cluster_genes and mat.shape[0] > 2:
+        from scconsensus_tpu.ops.linkage import ward_linkage
+
+        gene_order = np.asarray(ward_linkage(mat).order)
+    mat = mat[gene_order]
+    if gene_labels is not None:
+        gene_labels = np.asarray(gene_labels)[gene_order]
+    if gene_groups is not None:
+        gene_groups = np.asarray(gene_groups).astype(str)[gene_order]
 
     uniq_clusters = sorted(set(labels.tolist()))
     n_k = len(uniq_clusters)
@@ -135,6 +152,19 @@ def cell_type_de_plot(
     else:
         ax.set_yticks([])
     ax.set_ylabel(f"{mat.shape[0]} DE genes", fontsize=9)
+
+    if gene_groups is not None:  # row annotation (:260-282)
+        import matplotlib as mpl
+
+        uniq = sorted(set(gene_groups.tolist()))
+        palette = mpl.colormaps["tab20"].resampled(max(len(uniq), 1))
+        group_idx = {g: i for i, g in enumerate(uniq)}
+        rgba = np.array([palette(group_idx[g]) for g in gene_groups])
+        inset = ax.inset_axes([1.005, 0.0, 0.015, 1.0])
+        inset.imshow(rgba[:, None, :], aspect="auto", interpolation="nearest")
+        inset.set_xticks([])
+        inset.set_yticks([])
+        inset.set_title("groups", fontsize=6)
 
     fig.suptitle("DE gene expression (columns in dendrogram order)", fontsize=12)
     fig.savefig(filename, dpi=120, bbox_inches="tight")

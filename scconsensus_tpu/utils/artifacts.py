@@ -38,6 +38,30 @@ class ArtifactStore:
             os.path.join(self.root, f"{stage}.json"),
         )
 
+    def check_config(self, config_json: str) -> None:
+        """Pin the store to one pipeline configuration.
+
+        First call writes the fingerprint; later calls compare and raise on
+        mismatch — stage caches are keyed only by stage name, so resuming
+        with a different config would silently return stale results.
+        """
+        if not self.enabled:
+            return
+        path = os.path.join(self.root, "config.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                stored = f.read()
+            if stored != config_json:
+                raise ValueError(
+                    f"artifact store {self.root!r} was written with a "
+                    "different config — use a fresh artifact_dir for a new "
+                    "configuration (stored config is in its config.json)"
+                )
+            return
+        with open(path + ".tmp", "w") as f:
+            f.write(config_json)
+        os.replace(path + ".tmp", path)
+
     def has(self, stage: str) -> bool:
         """True iff the stage's array artifact exists (the resume key).
         Meta sidecars alone do not mark a stage complete."""

@@ -1,0 +1,101 @@
+"""Report rendering + artifact-store resume coverage (SURVEY.md §5.4)."""
+
+import os
+
+import numpy as np
+
+from scconsensus_tpu import recluster_de_consensus_fast
+from scconsensus_tpu.utils.synthetic import synthetic_scrna
+
+
+def _run(data, labels, tmp_path, **kw):
+    return recluster_de_consensus_fast(
+        data,
+        labels,
+        deep_split_values=(1, 2),
+        artifact_dir=str(tmp_path / "store"),
+        **kw,
+    )
+
+
+def test_refine_resumes_from_artifacts(tmp_path, rng):
+    data, truth, _ = synthetic_scrna(n_genes=150, n_cells=220, n_clusters=3, seed=5)
+    labels = np.array([f"c{v}" for v in truth])
+    first = _run(data, labels, tmp_path)
+    store = tmp_path / "store"
+    for stage in ("de", "union", "embed", "tree", "cuts"):
+        assert (store / f"{stage}.npz").exists(), stage
+
+    # Second run gets DIFFERENT data but the same store: every resumable
+    # stage must come from the artifacts, reproducing the first run exactly.
+    other = rng.normal(size=data.shape).astype(np.float32)
+    second = _run(np.abs(other), labels, tmp_path)
+    np.testing.assert_array_equal(
+        first.de_gene_union_idx, second.de_gene_union_idx
+    )
+    np.testing.assert_array_equal(first.cell_tree.merge, second.cell_tree.merge)
+    for key in first.dynamic_labels:
+        np.testing.assert_array_equal(
+            first.dynamic_labels[key], second.dynamic_labels[key]
+        )
+    np.testing.assert_allclose(first.de.log_p, second.de.log_p, equal_nan=True)
+
+
+def test_resume_rejects_changed_config(tmp_path, rng):
+    import pytest
+
+    data, truth, _ = synthetic_scrna(n_genes=100, n_cells=150, n_clusters=2, seed=5)
+    labels = np.array([f"c{v}" for v in truth])
+    _run(data, labels, tmp_path)
+    with pytest.raises(ValueError, match="different config"):
+        _run(data, labels, tmp_path, q_val_thrs=0.01)
+
+
+def test_resume_preserves_aux(tmp_path, rng):
+    from scconsensus_tpu import recluster_de_consensus
+
+    data, truth, _ = synthetic_scrna(n_genes=100, n_cells=150, n_clusters=2, seed=5)
+    labels = np.array([f"c{v}" for v in truth])
+    kw = dict(
+        method="edgeR", q_val_thrs=0.05, mean_scaling_factor=0.1,
+        deep_split_values=(1,), artifact_dir=str(tmp_path / "s"),
+    )
+    first = recluster_de_consensus(data, labels, **kw)
+    second = recluster_de_consensus(data, labels, **kw)
+    assert second.de.aux is not None
+    np.testing.assert_allclose(
+        first.de.aux["common_dispersion"], second.de.aux["common_dispersion"]
+    )
+
+
+def test_de_heatmap_renders_with_groups(tmp_path, rng):
+    from scconsensus_tpu.ops.linkage import ward_linkage
+    from scconsensus_tpu.report.de_heatmap import cell_type_de_plot
+
+    n, g = 120, 30
+    mat = np.abs(rng.normal(size=(g, n))).astype(np.float32)
+    tree = ward_linkage(rng.normal(size=(n, 5)))
+    out = str(tmp_path / "de.png")
+    cell_type_de_plot(
+        data_matrix=mat,
+        nodg=(mat > 0.5).sum(axis=0),
+        cell_tree=tree,
+        cluster_labels=np.array([f"c{i % 3}" for i in range(n)]),
+        dynamic_colors_list={"deepsplit: 1": np.array(["turquoise"] * n)},
+        gene_labels=np.array([f"g{i}" for i in range(g)]),
+        gene_groups=np.array(["A", "B"] * (g // 2)),
+        cluster_genes=True,
+        filename=out,
+    )
+    assert os.path.getsize(out) > 10_000
+
+
+def test_contingency_heatmap_renders(tmp_path):
+    from scconsensus_tpu.consensus import contingency_table
+    from scconsensus_tpu.report.heatmaps import plot_contingency_heatmap
+
+    l1 = np.array(["a", "a", "b", "b", "c"] * 10)
+    l2 = np.array(["x", "y", "x", "y", "y"] * 10)
+    out = str(tmp_path / "ctg.pdf")
+    plot_contingency_heatmap(contingency_table(l1, l2), out)
+    assert os.path.getsize(out) > 1_000
