@@ -1,13 +1,21 @@
-"""Benchmark harness: 26k-cell end-to-end refinement (the north-star config).
+"""Benchmark harness over the BASELINE.json configs.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Baseline: BASELINE.json north star — 26k PBMC reclusterDEConsensus end-to-end
-in < 30 s (vs_baseline = 30 / measured_seconds; > 1.0 beats the target).
+Default config is the north star — 26k PBMC-scale consensus+recluster
+end-to-end in < 30 s on one chip (vs_baseline = 30 / measured_seconds;
+> 1.0 beats the target).
 
-Synthetic NB data with planted clusters stands in for the Zenodo 26k-PBMC
-dataset (no network egress). Scale knobs via env: SCC_BENCH_CELLS,
-SCC_BENCH_GENES, SCC_BENCH_CLUSTERS, SCC_BENCH_COLD=1 to report the
-cold-compile run instead of steady state.
+Select a config with SCC_BENCH_CONFIG:
+  flagship  26k cells × 15k genes, K=22, fast Wilcoxon, exact Ward tree
+  pbmc68k   68k cells × 15k genes, 3-way consensus (chained), fast Wilcoxon
+  cite8k    8k cells, ADT-style coarse supervised × RNA unsupervised
+  tm100k    100k cells, 40 clusters, centroid-pooled approximate tree
+  brain1m   1M-cell embedding → pooled Ward + dynamic cut + ring silhouette
+            (reports cells/sec; DE is out of scope for this config)
+
+Synthetic NB data with planted clusters stands in for the public datasets
+(no network egress). Extra knobs: SCC_BENCH_CELLS / _GENES / _CLUSTERS
+override the flagship sizes; SCC_BENCH_COLD=1 reports the cold-compile run.
 """
 
 from __future__ import annotations
@@ -26,63 +34,154 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def run_once(data, labels1, labels2):
-    from scconsensus_tpu import plot_contingency_table, recluster_de_consensus_fast
+def _consensus(*labelings):
+    """Chain plot_contingency_table across 2+ labelings (3-way consensus is
+    consensus(consensus(l1, l2), l3) — the README's multi-tool workflow)."""
+    from scconsensus_tpu import plot_contingency_table
 
-    t0 = time.perf_counter()
-    consensus = plot_contingency_table(
-        labels1, labels2, automate_consensus=True, filename=None
+    out = labelings[0]
+    for nxt in labelings[1:]:
+        out = plot_contingency_table(out, nxt, filename=None)
+    return out
+
+
+def _gen(n_cells, n_genes, n_clusters, seed=7):
+    from scconsensus_tpu.utils.synthetic import synthetic_scrna
+
+    return synthetic_scrna(
+        n_genes=n_genes,
+        n_cells=n_cells,
+        n_clusters=n_clusters,
+        n_markers_per_cluster=min(40, n_genes // n_clusters),
+        seed=seed,
     )
-    result = recluster_de_consensus_fast(
-        data,
-        consensus,
-        method="wilcox",
-        deep_split_values=(1, 2, 3, 4),
-    )
-    t1 = time.perf_counter()
-    return t1 - t0, result
+
+
+def run_refine_config(n_cells, n_genes, n_clusters, n_way=2, **refine_kw):
+    from scconsensus_tpu import recluster_de_consensus_fast
+    from scconsensus_tpu.utils.synthetic import noisy_labeling
+
+    data, truth, _ = _gen(n_cells, n_genes, n_clusters)
+    labelings = [noisy_labeling(truth, 0.05, seed=1, prefix="sup")]
+    labelings.append(noisy_labeling(
+        truth, 0.10, n_out_clusters=max(2, n_clusters - 4), seed=2, prefix="uns"
+    ))
+    for i in range(n_way - 2):
+        labelings.append(noisy_labeling(truth, 0.08, seed=3 + i, prefix=f"t{i}"))
+
+    def once():
+        t0 = time.perf_counter()
+        consensus = _consensus(*labelings)
+        result = recluster_de_consensus_fast(
+            data, consensus, method="wilcox",
+            deep_split_values=(1, 2, 3, 4), **refine_kw,
+        )
+        return time.perf_counter() - t0, result
+
+    return once
+
+
+def run_brain1m(n_cells=1_000_000, n_pcs=15, n_clusters=24):
+    """1M-cell scale config: pooled Ward + cut + ring silhouette over a
+    synthetic embedding (the 'pod-sharded distance + approx hierarchical'
+    configuration; metric is cells/sec)."""
+    import numpy as np
+
+    from scconsensus_tpu.ops.pooling import pooled_ward_linkage
+    from scconsensus_tpu.ops.silhouette import mean_cluster_silhouette
+    from scconsensus_tpu.ops.treecut import cutree_hybrid
+
+    rng = np.random.default_rng(3)
+    centers = rng.normal(scale=6.0, size=(n_clusters, n_pcs))
+    lab = rng.integers(0, n_clusters, n_cells)
+    x = (centers[lab] + rng.normal(size=(n_cells, n_pcs))).astype(np.float32)
+
+    def once():
+        t0 = time.perf_counter()
+        tree, assign, cents = pooled_ward_linkage(x, n_centroids=4096, seed=1)
+        cut = cutree_hybrid(tree, cents, deep_split=1, min_cluster_size=2)
+        cells = cut[assign]
+        sub = rng.choice(n_cells, size=50_000, replace=False)  # SI on a sample
+        si, _ = mean_cluster_silhouette(x[sub], cells[sub])
+        dt = time.perf_counter() - t0
+        return dt, {"clusters": len(set(cells[cells > 0].tolist())),
+                    "silhouette": round(si, 3)}
+
+    return once
+
+
+CONFIGS = {
+    "flagship": dict(kind="refine", n_cells=26000, n_genes=15000, n_clusters=22),
+    "pbmc68k": dict(kind="refine", n_cells=68000, n_genes=15000, n_clusters=12,
+                    n_way=3),
+    "cite8k": dict(kind="refine", n_cells=8000, n_genes=10000, n_clusters=8),
+    "tm100k": dict(kind="refine", n_cells=100000, n_genes=12000, n_clusters=40,
+                   refine_kw=dict(approx_threshold=50000)),
+    "brain1m": dict(kind="brain1m"),
+}
 
 
 def main() -> None:
     import jax
 
+    # SCC_BENCH_PLATFORM=cpu pins the backend before first init (the env var
+    # JAX_PLATFORMS alone is overridden by site-level TPU plugin config).
+    plat = os.environ.get("SCC_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
     jax.config.update("jax_compilation_cache_dir", "/tmp/scc_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
-    n_cells = int(os.environ.get("SCC_BENCH_CELLS", 26000))
-    n_genes = int(os.environ.get("SCC_BENCH_GENES", 15000))
-    n_clusters = int(os.environ.get("SCC_BENCH_CLUSTERS", 22))
+    name = os.environ.get("SCC_BENCH_CONFIG", "flagship")
+    cfg = dict(CONFIGS[name])
+    kind = cfg.pop("kind")
+    log(f"[bench] config={name} on {jax.devices()[0].platform}")
 
-    from scconsensus_tpu.utils.synthetic import noisy_labeling, synthetic_scrna
+    if kind == "brain1m":
+        once = run_brain1m()
+        cold_s, cold_info = once()
+        log(f"[bench] cold run: {cold_s:.2f}s {cold_info}")
+        if os.environ.get("SCC_BENCH_COLD"):
+            elapsed, info = cold_s, cold_info
+        else:
+            elapsed, info = once()
+        log(f"[bench] steady: {elapsed:.2f}s {info}")
+        # nominal target: 1M cells through the approx-hierarchical path in
+        # 300 s (no published reference numbers exist, SURVEY.md §6)
+        print(json.dumps({
+            "metric": "1M-cell pooled distance+linkage+cut+silhouette throughput",
+            "value": round(1_000_000 / elapsed),
+            "unit": "cells/sec",
+            "vs_baseline": round((1_000_000 / elapsed) / (1_000_000 / 300.0), 3),
+        }))
+        return
 
-    log(f"[bench] generating synthetic data: {n_genes} genes x {n_cells} cells, "
-        f"{n_clusters} planted clusters on {jax.devices()[0].platform}")
-    data, true_labels, _ = synthetic_scrna(
-        n_genes=n_genes,
-        n_cells=n_cells,
-        n_clusters=n_clusters,
-        n_markers_per_cluster=min(40, n_genes // n_clusters),
-        seed=7,
-    )
-    labels1 = noisy_labeling(true_labels, 0.05, seed=1, prefix="sup")
-    labels2 = noisy_labeling(
-        true_labels, 0.10, n_out_clusters=max(2, n_clusters - 4), seed=2, prefix="unsup"
-    )
+    cfg.setdefault("n_cells", 26000)
+    if name == "flagship":  # env overrides for ad-hoc scaling runs
+        cfg["n_cells"] = int(os.environ.get("SCC_BENCH_CELLS", cfg["n_cells"]))
+        cfg["n_genes"] = int(os.environ.get("SCC_BENCH_GENES", cfg["n_genes"]))
+        cfg["n_clusters"] = int(
+            os.environ.get("SCC_BENCH_CLUSTERS", cfg["n_clusters"])
+        )
+    refine_kw = cfg.pop("refine_kw", {})
+    log(f"[bench] generating synthetic data: {cfg}")
+    once = run_refine_config(**cfg, **refine_kw)
 
-    cold_s, _ = run_once(data, labels1, labels2)
+    cold_s, _ = once()
     log(f"[bench] cold run (includes XLA compiles): {cold_s:.2f}s")
     if os.environ.get("SCC_BENCH_COLD"):
         elapsed = cold_s
     else:
-        elapsed, result = run_once(data, labels1, labels2)
+        elapsed, result = once()
         log(f"[bench] steady-state run: {elapsed:.2f}s; union="
             f"{result.de_gene_union_idx.size} genes; "
             f"deep_split_info={result.deep_split_info}")
 
+    n_cells = cfg["n_cells"]
     print(json.dumps({
         "metric": (
             f"{n_cells // 1000}k" if n_cells >= 1000 else str(n_cells)
-        ) + "-cell end-to-end consensus+recluster wall-clock",
+        ) + f"-cell end-to-end consensus+recluster wall-clock ({name})",
         "value": round(elapsed, 3),
         "unit": "seconds",
         "vs_baseline": round(BASELINE_SECONDS / elapsed, 3),

@@ -22,30 +22,65 @@ from scconsensus_tpu.ops.linkage import HClustTree, ward_linkage
 __all__ = ["kmeans_pool", "pooled_ward_linkage"]
 
 
+# Point-block width for the assignment sweep: bounds the live (block, m)
+# distance tile so 1M×4096 never materializes (16 GB would blow v5e HBM).
+_LLOYD_BLOCK = 65_536
+
+
 @partial(jax.jit, static_argnames=("n_iter",))
 def _lloyd(points: jnp.ndarray, centroids: jnp.ndarray, n_iter: int = 10):
-    """Lloyd iterations; returns (centroids, assignment)."""
+    """Blocked Lloyd iterations; returns (centroids, assignment).
 
-    def step(cent, _):
-        d = (
-            jnp.sum(points * points, axis=1, keepdims=True)
-            - 2.0 * points @ cent.T
+    ``points`` must be zero-padded to a multiple of the block width with a
+    parallel validity mask folded into the pad rows being all-zero AND
+    assigned to centroid 0 with zero weight — handled by the caller passing
+    ``weights`` (1 for real rows, 0 for padding).
+    """
+    n, d = points.shape
+    m = centroids.shape[0]
+    nb = n // _LLOYD_BLOCK if n % _LLOYD_BLOCK == 0 else n // _LLOYD_BLOCK + 1
+    pad = nb * _LLOYD_BLOCK - n
+    pts = jnp.pad(points, ((0, pad), (0, 0)))
+    valid = jnp.pad(jnp.ones((n,), points.dtype), (0, pad))
+    pb = pts.reshape(nb, _LLOYD_BLOCK, d)
+    vb = valid.reshape(nb, _LLOYD_BLOCK)
+
+    def assign_block(cent, block, vmask):
+        dist = (
+            jnp.sum(block * block, axis=1, keepdims=True)
+            - 2.0 * block @ cent.T
             + jnp.sum(cent * cent, axis=1)[None, :]
         )
-        assign = jnp.argmin(d, axis=1)
-        oh = jax.nn.one_hot(assign, cent.shape[0], dtype=points.dtype)
-        counts = jnp.sum(oh, axis=0)
-        sums = oh.T @ points
-        new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], cent)
+        a = jnp.argmin(dist, axis=1)
+        oh = jax.nn.one_hot(a, m, dtype=block.dtype) * vmask[:, None]
+        return a, jnp.sum(oh, axis=0), oh.T @ block
+
+    def step(cent, _):
+        def fold(carry, inp):
+            counts, sums = carry
+            block, vmask = inp
+            _, c, s = assign_block(cent, block, vmask)
+            return (counts + c, sums + s), None
+
+        (counts, sums), _ = jax.lax.scan(
+            fold,
+            (jnp.zeros((m,), pts.dtype), jnp.zeros((m, d), pts.dtype)),
+            (pb, vb),
+        )
+        new = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], cent
+        )
         return new, None
 
     cent, _ = jax.lax.scan(step, centroids, None, length=n_iter)
-    d = (
-        jnp.sum(points * points, axis=1, keepdims=True)
-        - 2.0 * points @ cent.T
-        + jnp.sum(cent * cent, axis=1)[None, :]
-    )
-    return cent, jnp.argmin(d, axis=1)
+
+    def final(carry, inp):
+        block, vmask = inp
+        a, _, _ = assign_block(cent, block, vmask)
+        return carry, a
+
+    _, assign = jax.lax.scan(final, None, (pb, vb))
+    return cent, assign.reshape(-1)[:n]
 
 
 def kmeans_pool(

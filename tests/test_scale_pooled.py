@@ -1,0 +1,56 @@
+"""Approximate-path scale checks (centroid pooling, SURVEY.md §7 stage 6).
+
+Sizes kept CPU-test friendly; the bench harness exercises the 100k/1M
+configurations on hardware.
+"""
+
+import numpy as np
+import pytest
+from sklearn.metrics import adjusted_rand_score
+
+from scconsensus_tpu.ops.pooling import kmeans_pool, pooled_ward_linkage
+from scconsensus_tpu.ops.treecut import cutree_hybrid
+
+
+@pytest.fixture
+def blobs(rng):
+    centers = rng.normal(scale=8.0, size=(5, 8))
+    lab = rng.integers(0, 5, 30_000)
+    x = (centers[lab] + rng.normal(size=(30_000, 8))).astype(np.float32)
+    return x, lab
+
+
+def test_blocked_lloyd_matches_small_case(rng):
+    # blocked assignment must agree with a direct numpy Lloyd on tiny data
+    x = rng.normal(size=(500, 4)).astype(np.float32)
+    cent, assign = kmeans_pool(x, 8, n_iter=5, seed=3)
+    d = np.linalg.norm(x[:, None, :] - cent[None, :, :], axis=-1)
+    np.testing.assert_array_equal(assign, d.argmin(axis=1))
+
+
+def test_pooled_path_recovers_planted_clusters(blobs):
+    x, lab = blobs
+    tree, assign, cents = pooled_ward_linkage(x, n_centroids=256, seed=1)
+    cut = cutree_hybrid(tree, cents, deep_split=1, min_cluster_size=2)
+    cells = cut[assign]
+    m = cells > 0
+    assert adjusted_rand_score(lab[m], cells[m]) > 0.95
+
+
+def test_refine_switches_to_pooled_above_threshold(rng):
+    from scconsensus_tpu import recluster_de_consensus_fast
+    from scconsensus_tpu.utils.synthetic import synthetic_scrna
+
+    data, truth, _ = synthetic_scrna(n_genes=120, n_cells=2500, n_clusters=3, seed=4)
+    res = recluster_de_consensus_fast(
+        data,
+        np.array([f"c{v}" for v in truth]),
+        deep_split_values=(1,),
+        approx_threshold=1000,     # force the pooled path
+        n_pool_centroids=256,
+    )
+    tree_rec = next(r for r in res.metrics["stages"] if r["stage"] == "tree")
+    assert tree_rec["approx"] is True
+    lab = res.dynamic_labels["deepsplit: 1"]
+    m = lab > 0
+    assert adjusted_rand_score(truth[m], lab[m]) > 0.9
