@@ -74,10 +74,37 @@ def load_h5ad(path: str) -> ExpressionData:
             data = np.asarray(x["data"])
             indices = np.asarray(x["indices"])
             indptr = np.asarray(x["indptr"])
-            enc = x.attrs.get("encoding-type", "csr_matrix")
+            enc = x.attrs.get("encoding-type")
             if isinstance(enc, bytes):
                 enc = enc.decode()
             shape = tuple(int(v) for v in x.attrs["shape"])
+            if enc is None:
+                # Older h5ad files may omit encoding-type; infer the layout
+                # from the indptr length (CSR: shape[0]+1, CSC: shape[1]+1)
+                # rather than guessing — a wrong guess can yield a
+                # shape-valid but scrambled matrix.
+                csr_len, csc_len = shape[0] + 1, shape[1] + 1
+                if indptr.size == csr_len and indptr.size != csc_len:
+                    enc = "csr_matrix"
+                elif indptr.size == csc_len and indptr.size != csr_len:
+                    enc = "csc_matrix"
+                elif indptr.size == csr_len:  # square: either is consistent
+                    import warnings
+
+                    warnings.warn(
+                        f"X is square ({shape}) with no encoding-type attr; "
+                        "CSR and CSC are indistinguishable from indptr — "
+                        "assuming CSR. If the file is CSC the result is the "
+                        "transpose.",
+                        stacklevel=2,
+                    )
+                    enc = "csr_matrix"
+                else:
+                    raise ValueError(
+                        f"cannot infer sparse layout of X: indptr length "
+                        f"{indptr.size} matches neither CSR ({csr_len}) nor "
+                        f"CSC ({csc_len}) for shape {shape}"
+                    )
             cls = _sp.csr_matrix if "csr" in enc else _sp.csc_matrix
             mat = cls((data, indices, indptr), shape=shape)
         else:

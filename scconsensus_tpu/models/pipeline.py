@@ -92,7 +92,10 @@ def refine(
     if len(labels) != N:
         raise ValueError(f"labels length {len(labels)} != n_cells {N}")
 
-    store.check_config(config.to_json())
+    if store.enabled:
+        from scconsensus_tpu.utils.artifacts import input_fingerprint
+
+        store.check_config(config.to_json(), inputs=input_fingerprint(data, labels))
     de_res = None
     if store.has("de"):
         try:

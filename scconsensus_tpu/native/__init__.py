@@ -13,6 +13,7 @@ golden reference for these kernels.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -23,19 +24,55 @@ import numpy as np
 __all__ = ["ward_native", "native_available"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_DIR, "libscc_native.so")
 _SRC = os.path.join(_DIR, "ward.cpp")
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _LOAD_ERROR: Optional[Exception] = None
 
+# Generic flags only: the built .so must be valid on any host that clones the
+# repo (no -march=native), and the artifact is never committed — it is keyed
+# by a content hash of the source + compiler so a stale or foreign binary can
+# never be picked up by accident.
+_CFLAGS = ["-O3", "-fopenmp", "-shared", "-fPIC", "-std=c++17"]
 
-def _build() -> None:
-    cmd = [
-        "g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
-        "-std=c++17", _SRC, "-o", _SO,
-    ]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+def _compiler_tag() -> str:
+    try:
+        out = subprocess.run(
+            ["g++", "--version"], capture_output=True, text=True, check=True
+        ).stdout.splitlines()[0]
+    except Exception:
+        out = "g++-unknown"
+    return out
+
+
+def _so_path() -> str:
+    with open(_SRC, "rb") as f:
+        src = f.read()
+    key = hashlib.sha256(
+        src + ("\x00".join(_CFLAGS) + "\x00" + _compiler_tag()).encode()
+    ).hexdigest()[:16]
+    return os.path.join(_DIR, f"libscc_native-{key}.so")
+
+
+def _build(so: str) -> None:
+    # pid-unique tmp: concurrent first builds from separate processes must
+    # not interleave writes into one tmp file (os.replace is then atomic).
+    tmp = f"{so}.tmp.{os.getpid()}.so"
+    cmd = ["g++", *_CFLAGS, _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, so)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    for f in os.listdir(_DIR):  # drop orphaned builds of older source revs
+        if f.startswith("libscc_native-") and f.endswith(".so"):
+            if os.path.join(_DIR, f) != so:
+                try:
+                    os.unlink(os.path.join(_DIR, f))
+                except OSError:
+                    pass
 
 
 def _load() -> ctypes.CDLL:
@@ -46,11 +83,10 @@ def _load() -> ctypes.CDLL:
         if _LOAD_ERROR is not None:
             raise _LOAD_ERROR
         try:
-            if (not os.path.exists(_SO)) or (
-                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
-            ):
-                _build()
-            lib = ctypes.CDLL(_SO)
+            so = _so_path()
+            if not os.path.exists(so):
+                _build(so)
+            lib = ctypes.CDLL(so)
             fn = lib.scc_ward_nnchain
             fn.restype = ctypes.c_int
             fn.argtypes = [

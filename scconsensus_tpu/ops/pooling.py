@@ -31,10 +31,9 @@ _LLOYD_BLOCK = 65_536
 def _lloyd(points: jnp.ndarray, centroids: jnp.ndarray, n_iter: int = 10):
     """Blocked Lloyd iterations; returns (centroids, assignment).
 
-    ``points`` must be zero-padded to a multiple of the block width with a
-    parallel validity mask folded into the pad rows being all-zero AND
-    assigned to centroid 0 with zero weight — handled by the caller passing
-    ``weights`` (1 for real rows, 0 for padding).
+    Callers pass only real rows: padding to a multiple of the block width
+    happens internally, with an internal validity mask giving pad rows zero
+    weight in the centroid update.
     """
     n, d = points.shape
     m = centroids.shape[0]

@@ -12,13 +12,44 @@ sidecar for scalars/metadata — portable, no pickle.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
-__all__ = ["ArtifactStore"]
+__all__ = ["ArtifactStore", "input_fingerprint"]
+
+
+def input_fingerprint(data, labels) -> Dict[str, Any]:
+    """Cheap content fingerprint of a pipeline's inputs.
+
+    Resuming a store with the same *config* but different *data* would
+    silently return artifacts computed from the old dataset; this pins shape,
+    nnz, a strided sample hash of the values, and a labels hash so a data
+    change raises instead (ADVICE r1). Sampling keeps it O(1e5) regardless of
+    matrix size.
+    """
+    from scconsensus_tpu.io.sparsemat import is_sparse
+
+    h = hashlib.sha256()
+    if is_sparse(data):
+        vals = data.data
+        nnz = int(data.nnz)
+    else:
+        vals = np.asarray(data).ravel()
+        nnz = int(np.count_nonzero(data)) if vals.size <= 10_000_000 else -1
+    step = max(1, vals.size // 65_536)
+    h.update(np.ascontiguousarray(vals[::step], dtype=np.float32).tobytes())
+    lab = np.asarray(labels).astype(str)
+    lh = hashlib.sha256("\x00".join(lab.tolist()).encode()).hexdigest()[:16]
+    return {
+        "shape": [int(s) for s in data.shape],
+        "nnz": nnz,
+        "data_sample_sha": h.hexdigest()[:16],
+        "labels_sha": lh,
+    }
 
 
 class ArtifactStore:
@@ -38,28 +69,53 @@ class ArtifactStore:
             os.path.join(self.root, f"{stage}.json"),
         )
 
-    def check_config(self, config_json: str) -> None:
-        """Pin the store to one pipeline configuration.
+    def check_config(
+        self, config_json: str, inputs: Optional[Dict[str, Any]] = None
+    ) -> None:
+        """Pin the store to one pipeline configuration + input fingerprint.
 
-        First call writes the fingerprint; later calls compare and raise on
+        First call writes the fingerprint (one JSON object,
+        ``{"config": ..., "inputs": ...}``); later calls compare and raise on
         mismatch — stage caches are keyed only by stage name, so resuming
-        with a different config would silently return stale results.
+        with a different config or different input data would silently
+        return stale results.
         """
         if not self.enabled:
             return
+        config = json.loads(config_json)
         path = os.path.join(self.root, "config.json")
         if os.path.exists(path):
             with open(path) as f:
-                stored = f.read()
-            if stored != config_json:
+                stored = json.load(f)
+            if "config" not in stored:
+                # store written before input fingerprinting: bare config JSON
+                if stored == config:
+                    self._write_pin(path, config, inputs)  # accept + upgrade
+                    return
+                stored = {"config": stored, "inputs": None}
+            if stored["config"] != config:
                 raise ValueError(
                     f"artifact store {self.root!r} was written with a "
                     "different config — use a fresh artifact_dir for a new "
-                    "configuration (stored config is in its config.json)"
+                    "configuration (stored fingerprint: config.json)"
+                )
+            if (
+                inputs is not None
+                and stored.get("inputs") is not None
+                and stored["inputs"] != inputs
+            ):
+                raise ValueError(
+                    f"artifact store {self.root!r} was written with "
+                    "different input data — use a fresh artifact_dir for a "
+                    "new dataset (stored fingerprint: config.json)"
                 )
             return
+        self._write_pin(path, config, inputs)
+
+    @staticmethod
+    def _write_pin(path: str, config: Any, inputs: Optional[Dict[str, Any]]):
         with open(path + ".tmp", "w") as f:
-            f.write(config_json)
+            json.dump({"config": config, "inputs": inputs}, f, indent=2)
         os.replace(path + ".tmp", path)
 
     def has(self, stage: str) -> bool:

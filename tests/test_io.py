@@ -67,6 +67,22 @@ def test_h5ad_roundtrip(tmp_path, small_sparse):
     assert got.cell_names[-1] == "cell29"
 
 
+def test_h5ad_infers_layout_without_encoding_attr(tmp_path, small_sparse):
+    # Older h5ad files omit encoding-type; the loader must infer CSR vs CSC
+    # from the indptr length instead of defaulting to CSR.
+    h5py = pytest.importorskip("h5py")
+    p = str(tmp_path / "b.h5ad")
+    x = small_sparse.T.tocsc()  # cells x genes, CSC this time
+    with h5py.File(p, "w") as f:
+        g = f.create_group("X")
+        g.attrs["shape"] = x.shape  # 30 x 50: indptr length 51 → CSC
+        g.create_dataset("data", data=x.data)
+        g.create_dataset("indices", data=x.indices)
+        g.create_dataset("indptr", data=x.indptr)
+    got = load_h5ad(p)
+    np.testing.assert_array_equal(got.matrix.toarray(), small_sparse.toarray())
+
+
 def test_log_normalize_sparse_matches_dense(small_sparse):
     dense = small_sparse.toarray()
     got = log_normalize(small_sparse, scale=1000.0)

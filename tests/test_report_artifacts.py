@@ -18,7 +18,7 @@ def _run(data, labels, tmp_path, **kw):
     )
 
 
-def test_refine_resumes_from_artifacts(tmp_path, rng):
+def test_refine_resumes_from_artifacts(tmp_path, rng, monkeypatch):
     data, truth, _ = synthetic_scrna(n_genes=150, n_cells=220, n_clusters=3, seed=5)
     labels = np.array([f"c{v}" for v in truth])
     first = _run(data, labels, tmp_path)
@@ -26,10 +26,16 @@ def test_refine_resumes_from_artifacts(tmp_path, rng):
     for stage in ("de", "union", "embed", "tree", "cuts"):
         assert (store / f"{stage}.npz").exists(), stage
 
-    # Second run gets DIFFERENT data but the same store: every resumable
-    # stage must come from the artifacts, reproducing the first run exactly.
-    other = rng.normal(size=data.shape).astype(np.float32)
-    second = _run(np.abs(other), labels, tmp_path)
+    # Second run gets the SAME inputs but a poisoned DE engine: every
+    # resumable stage must come from the artifacts (the engine is never
+    # called), reproducing the first run exactly.
+    import scconsensus_tpu.models.pipeline as pl
+
+    def _boom(*a, **kw):
+        raise AssertionError("pairwise_de was re-run despite artifacts")
+
+    monkeypatch.setattr(pl, "pairwise_de", _boom)
+    second = _run(data, labels, tmp_path)
     np.testing.assert_array_equal(
         first.de_gene_union_idx, second.de_gene_union_idx
     )
@@ -49,6 +55,37 @@ def test_resume_rejects_changed_config(tmp_path, rng):
     _run(data, labels, tmp_path)
     with pytest.raises(ValueError, match="different config"):
         _run(data, labels, tmp_path, q_val_thrs=0.01)
+
+
+def test_resume_rejects_changed_data(tmp_path, rng):
+    import pytest
+
+    data, truth, _ = synthetic_scrna(n_genes=100, n_cells=150, n_clusters=2, seed=5)
+    labels = np.array([f"c{v}" for v in truth])
+    _run(data, labels, tmp_path)
+    other = np.abs(rng.normal(size=data.shape)).astype(np.float32)
+    with pytest.raises(ValueError, match="different input data"):
+        _run(other, labels, tmp_path)
+    # changed labels count as changed inputs too
+    flipped = labels.copy()
+    flipped[0] = "c9"
+    with pytest.raises(ValueError, match="different input data"):
+        _run(data, flipped, tmp_path)
+
+
+def test_resume_accepts_legacy_store_pin(tmp_path, rng):
+    # Stores written before input fingerprinting hold bare config JSON;
+    # resuming with identical config must accept and upgrade, not raise.
+    data, truth, _ = synthetic_scrna(n_genes=100, n_cells=150, n_clusters=2, seed=5)
+    labels = np.array([f"c{v}" for v in truth])
+    _run(data, labels, tmp_path)
+    pin = tmp_path / "store" / "config.json"
+    import json
+
+    full = json.loads(pin.read_text())
+    pin.write_text(json.dumps(full["config"], indent=2))  # legacy format
+    _run(data, labels, tmp_path)  # must not raise
+    assert "inputs" in json.loads(pin.read_text())  # upgraded in place
 
 
 def test_resume_preserves_aux(tmp_path, rng):
