@@ -58,6 +58,9 @@ class ReclusterConfig:
     mean_exprs_thrs: float = 0.0  # fast-path gate (Seurat MeanExprsThrs)
     min_pct: float = 20.0  # fast path: min % of cells expressing (minPerCent)
     min_diff_pct: float = -float("inf")
+    # Pairs where either group has fewer cells are skipped with a recorded
+    # reason (the reference's hard per-pair validation error,
+    # R/reclusterDEConsensusFast.R:201-226, turned into a skip-and-flag).
     min_cells_group: int = 3
     pseudocount: float = 1.0
     max_cells_per_ident: Optional[int] = None  # subsample per group (seeded)
@@ -72,7 +75,8 @@ class ReclusterConfig:
     # --- embed + recluster ---
     n_pcs: int = 15
     distance: str = "euclidean"  # euclidean | pearson (reference's commented alt)
-    linkage: str = "ward.D2"
+    # linkage is always Ward.D2 (the only method the reference uses,
+    # R/reclusterDEConsensus.R:242-246) — not a config knob.
     deep_split_values: Tuple[int, ...] = (1, 2, 3, 4)
     pam_stage: bool = False
 
@@ -84,7 +88,6 @@ class ReclusterConfig:
     compat: CompatFlags = dataclasses.field(default_factory=CompatFlags)
     artifact_dir: Optional[str] = None  # stage-keyed checkpoint store; None = off
     plot_name: Optional[str] = None  # DE heatmap output path; None = no plot
-    dtype: str = "float32"
 
     @classmethod
     def slow_path_preset(cls, q_val_thrs: float, fc_thrs: float, **kw) -> "ReclusterConfig":

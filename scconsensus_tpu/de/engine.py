@@ -57,9 +57,11 @@ class PairwiseDEResult:
     log_fc: np.ndarray  # (P, G) natural-log fold change (path convention)
     tested: np.ndarray  # (P, G) bool: entered the statistical test
     de_mask: np.ndarray  # (P, G) bool: final DE call
+    pair_skipped: np.ndarray = None  # (P,) bool: skipped by group-size validation
     pct1: Optional[np.ndarray] = None  # (P, G) fast path only
     pct2: Optional[np.ndarray] = None
     aux: Optional[Dict[str, np.ndarray]] = None  # extra per-test stats (AUC...)
+    skip_reasons: Optional[List[str]] = None  # one per skipped pair
 
     @property
     def n_pairs(self) -> int:
@@ -71,8 +73,12 @@ class PairwiseDEResult:
         return self.de_mask.sum(axis=1)
 
     _ARRAY_FIELDS = ("pair_i", "pair_j", "log_p", "log_q", "log_fc",
-                     "tested", "de_mask")
+                     "tested", "de_mask", "pair_skipped")
     _OPT_ARRAY_FIELDS = ("pct1", "pct2")
+
+    def __post_init__(self):
+        if self.pair_skipped is None:
+            self.pair_skipped = np.zeros(self.pair_i.shape[0], bool)
 
     def to_store(self) -> Tuple[Dict[str, np.ndarray], Dict]:
         """(arrays, meta) for ArtifactStore — the single serialization point,
@@ -85,7 +91,10 @@ class PairwiseDEResult:
         if self.aux:
             for k, v in self.aux.items():
                 arrays[f"aux_{k}"] = np.asarray(v)
-        return arrays, {"cluster_names": self.cluster_names}
+        return arrays, {
+            "cluster_names": self.cluster_names,
+            "skip_reasons": self.skip_reasons or [],
+        }
 
     @classmethod
     def from_store(cls, arrays: Dict[str, np.ndarray], meta: Dict
@@ -95,7 +104,10 @@ class PairwiseDEResult:
         resuming into a corrupt state."""
         if "cluster_names" not in meta:
             raise ValueError("de artifact incomplete: missing cluster_names meta")
-        missing = [f for f in cls._ARRAY_FIELDS if f not in arrays]
+        # pair_skipped may be absent in stores written before group-size
+        # validation existed; __post_init__ synthesizes the all-False default.
+        required = [f for f in cls._ARRAY_FIELDS if f != "pair_skipped"]
+        missing = [f for f in required if f not in arrays]
         if missing:
             raise ValueError(f"de artifact incomplete: missing arrays {missing}")
         aux = {
@@ -103,9 +115,10 @@ class PairwiseDEResult:
         }
         return cls(
             cluster_names=list(meta["cluster_names"]),
-            **{f: arrays[f] for f in cls._ARRAY_FIELDS},
+            **{f: arrays.get(f) for f in cls._ARRAY_FIELDS},
             **{f: arrays.get(f) for f in cls._OPT_ARRAY_FIELDS},
             aux=aux or None,
+            skip_reasons=list(meta.get("skip_reasons", [])) or None,
         )
 
 
@@ -133,6 +146,17 @@ def _all_pairs(k: int) -> Tuple[np.ndarray, np.ndarray]:
 
 def _next_pow2(x: int) -> int:
     return 1 << (int(x) - 1).bit_length()
+
+
+def _expand_rows(sub: np.ndarray, ok_rows: np.ndarray, n_rows: int) -> np.ndarray:
+    """Scatter per-run-pair results back onto the full pair axis; rows of
+    pairs skipped by group-size validation stay NaN (float) / False (bool)."""
+    if ok_rows.size == n_rows:
+        return sub
+    fill = False if sub.dtype == bool else np.nan
+    out = np.full((n_rows,) + sub.shape[1:], fill, sub.dtype)
+    out[ok_rows] = sub
+    return out
 
 
 @dataclasses.dataclass
@@ -434,6 +458,25 @@ def pairwise_de(
                 for ci in cell_idx_of
             ]
         pair_i, pair_j = _all_pairs(K)
+        # Group-size validation: the reference hard-errors on pairs with <3
+        # cells per group (R/reclusterDEConsensusFast.R:201-226); here such
+        # pairs are skipped with a recorded reason instead of killing the run.
+        n_of = np.array([ci.size for ci in cell_idx_of], np.int32)
+        pair_ok = (n_of[pair_i] >= config.min_cells_group) & (
+            n_of[pair_j] >= config.min_cells_group
+        )
+        skip_reasons = [
+            f"{names[i]} vs {names[j]}: group sizes ({n_of[i]}, {n_of[j]}) "
+            f"below min_cells_group={config.min_cells_group}"
+            for i, j in zip(pair_i[~pair_ok], pair_j[~pair_ok])
+        ]
+        ok_rows = np.nonzero(pair_ok)[0]
+        run_i, run_j = pair_i[pair_ok], pair_j[pair_ok]
+        if run_i.size == 0:
+            raise ValueError(
+                "every cluster pair has a group below "
+                f"min_cells_group={config.min_cells_group}; nothing to test"
+            )
 
     with timer.stage("aggregates", n_clusters=K, n_pairs=int(pair_i.size)):
         onehot = np.zeros((N, K), np.float32)
@@ -451,6 +494,7 @@ def pairwise_de(
 
     method = config.method.lower()
     pi, pj = jnp.asarray(pair_i), jnp.asarray(pair_j)
+    P = int(pair_i.size)
 
     if method in ("wilcox", "wilcoxon", "roc", "bimod", "t"):
         slow = method == "wilcoxon"
@@ -461,7 +505,8 @@ def pairwise_de(
                     mean_exprs_thrs=config.mean_scaling_factor * mean_expm1(data),
                     mixed_spaces=config.compat.mean_gate_mixed_spaces,
                 )
-                tested = np.ones((pair_i.size, G), bool)
+                tested = np.ones((P, G), bool)
+                tested[~pair_ok] = False
                 pct1 = pct2 = None
             else:
                 gate, log_fc, p1, p2 = pair_gates_fast(
@@ -473,7 +518,8 @@ def pairwise_de(
                     pseudocount=config.pseudocount,
                     only_pos=config.only_pos,
                 )
-                tested = np.asarray(gate)
+                tested = np.array(gate)  # copy: jax buffers are read-only
+                tested[~pair_ok] = False
                 pct1, pct2 = np.asarray(p1), np.asarray(p2)
         aux: Optional[Dict[str, np.ndarray]] = None
         stage_name = (
@@ -483,21 +529,25 @@ def pairwise_de(
         def _rank_sum(need_all_genes: bool = False):
             """Fast path tests only gate survivors (dense input); the slow
             path, sparse inputs, and callers needing per-gene statistics for
-            every gene (roc's AUC) rank full tiles."""
+            every gene (roc's AUC) rank full tiles. Skipped pairs never run."""
             if not slow and not need_all_genes and not is_sparse(data):
-                return _run_wilcox_gated(
-                    data, cell_idx_of, pair_i, pair_j, tested
+                lp, u = _run_wilcox_gated(
+                    data, cell_idx_of, run_i, run_j, tested[ok_rows]
                 )
-            return _run_wilcox(data, cell_idx_of, pair_i, pair_j)
+            else:
+                lp, u = _run_wilcox(data, cell_idx_of, run_i, run_j)
+            return _expand_rows(lp, ok_rows, P), _expand_rows(u, ok_rows, P)
 
         with timer.stage(stage_name):
             if method == "bimod":
-                log_p = _run_tile_test(
-                    data, cell_idx_of, pair_i, pair_j, _bimod_chunk
+                log_p = _expand_rows(
+                    _run_tile_test(data, cell_idx_of, run_i, run_j, _bimod_chunk),
+                    ok_rows, P,
                 )
             elif method == "t":
-                log_p = _run_tile_test(
-                    data, cell_idx_of, pair_i, pair_j, _ttest_chunk
+                log_p = _expand_rows(
+                    _run_tile_test(data, cell_idx_of, run_i, run_j, _ttest_chunk),
+                    ok_rows, P,
                 )
             elif method == "roc":
                 # The reference's roc branch never produces a p-value usable
@@ -552,9 +602,11 @@ def pairwise_de(
             log_fc=log_fc,
             tested=tested,
             de_mask=de,
+            pair_skipped=~pair_ok,
             pct1=pct1,
             pct2=pct2,
             aux=aux,
+            skip_reasons=skip_reasons or None,
         )
 
     if method == "edger":
@@ -572,19 +624,21 @@ def pairwise_de(
             counts = expm1_sparse(data)
             gate_mean = mean_value(counts)  # counts IS expm1(data): reuse it
         with timer.stage("edger_nb"):
-            buckets = _bucket_pairs(cell_idx_of, pair_i, pair_j)
-            nb = run_edger_pairs(counts, buckets, G, int(pair_i.size))
+            buckets = _bucket_pairs(cell_idx_of, run_i, run_j)
+            nb = run_edger_pairs(counts, buckets, G, int(run_i.size))
         with timer.stage("gates"):
             mean_gate, _slow_fc = pair_gates_slow(
                 agg, pi, pj,
                 mean_exprs_thrs=config.mean_scaling_factor * gate_mean,
                 mixed_spaces=config.compat.mean_gate_mixed_spaces,
             )
+        log_p = _expand_rows(nb.log_p, ok_rows, P)
+        log_fc = _expand_rows(nb.log_fc, ok_rows, P)
         with timer.stage("bh_adjust"):
             log_q = np.asarray(
-                bh_adjust(jnp.asarray(nb.log_p), n=jnp.asarray(float(G)))
+                bh_adjust(jnp.asarray(log_p), n=jnp.asarray(float(G)))
                 if config.compat.bh_reference_n
-                else bh_adjust(jnp.asarray(nb.log_p))
+                else bh_adjust(jnp.asarray(log_p))
             )
         with timer.stage("de_call"):
             log_thr = np.log(np.float32(config.q_val_thrs))
@@ -593,27 +647,31 @@ def pairwise_de(
                 # variable; the criterion reads scalar-NA `logfc`, so the
                 # whole mask is NA → no gene is ever *selected*. Reproduced
                 # as an all-false DE mask (NA indexes select nothing usable).
-                de = np.zeros((pair_i.size, G), bool)
+                de = np.zeros((P, G), bool)
             else:
                 de = (
                     (log_q < log_thr)
-                    & (np.abs(nb.log_fc) > config.log_fc_thrs)
+                    & (np.abs(log_fc) > config.log_fc_thrs)
                     & np.asarray(mean_gate)
                 )
                 de &= ~np.isnan(log_q)
+        tested = np.ones((P, G), bool)
+        tested[~pair_ok] = False
         return PairwiseDEResult(
             cluster_names=names,
             pair_i=pair_i,
             pair_j=pair_j,
-            log_p=nb.log_p,
+            log_p=log_p,
             log_q=log_q,
-            log_fc=nb.log_fc,
-            tested=np.ones((pair_i.size, G), bool),
+            log_fc=log_fc,
+            tested=tested,
             de_mask=de,
+            pair_skipped=~pair_ok,
             aux={
-                "common_dispersion": nb.common_disp,
-                "tagwise_dispersion": nb.tagwise_disp,
+                "common_dispersion": _expand_rows(nb.common_disp, ok_rows, P),
+                "tagwise_dispersion": _expand_rows(nb.tagwise_disp, ok_rows, P),
             },
+            skip_reasons=skip_reasons or None,
         )
 
     raise NotImplementedError(f"DE method '{config.method}' not implemented yet")

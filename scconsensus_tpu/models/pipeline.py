@@ -113,6 +113,8 @@ def refine(
         )["idx"]
         rec["union_size"] = int(union.size)
         rec["per_pair_de_counts"] = de_res.de_counts().tolist()
+        if de_res.skip_reasons:
+            rec["skipped_pairs"] = de_res.skip_reasons
     if union.size < 2:
         raise ValueError(
             f"DE gene union has {union.size} genes — nothing to re-embed. "

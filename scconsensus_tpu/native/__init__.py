@@ -66,11 +66,18 @@ def _build(so: str) -> None:
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
-    for f in os.listdir(_DIR):  # drop orphaned builds of older source revs
+
+
+def _cleanup_stale(keep: str) -> None:
+    """Drop orphaned builds of older source revisions. Called only after a
+    successful CDLL load: a concurrent process that loses its .so to this
+    unlink already has the inode mapped, so its handle stays valid."""
+    for f in os.listdir(_DIR):
         if f.startswith("libscc_native-") and f.endswith(".so"):
-            if os.path.join(_DIR, f) != so:
+            p = os.path.join(_DIR, f)
+            if p != keep:
                 try:
-                    os.unlink(os.path.join(_DIR, f))
+                    os.unlink(p)
                 except OSError:
                     pass
 
@@ -98,6 +105,7 @@ def _load() -> ctypes.CDLL:
                 ctypes.POINTER(ctypes.c_double),
             ]
             _LIB = lib
+            _cleanup_stale(keep=so)
             return lib
         except Exception as e:  # compiler missing, load failure, ...
             _LOAD_ERROR = e

@@ -128,6 +128,75 @@ class TestExactBranch:
             np.testing.assert_allclose(got, ref.pvalue, rtol=1e-5)
 
 
+class TestGroupSizeValidation:
+    """The reference hard-errors on pairs with <3 cells per group
+    (R/reclusterDEConsensusFast.R:201-226); the engine skips them with a
+    recorded reason instead."""
+
+    def _case(self):
+        data, truth, _ = synthetic_scrna(
+            n_genes=80, n_cells=200, n_clusters=2, seed=11
+        )
+        names = [f"c{v}" for v in truth]
+        names[:2] = ["tiny", "tiny"]  # a 2-cell cluster
+        return data, np.array(names)
+
+    @pytest.mark.parametrize("method", ["wilcox", "edger"])
+    def test_small_pairs_skipped_with_reason(self, method):
+        data, labels = self._case()
+        cfg = ReclusterConfig(
+            method=method, min_cluster_size=1, mean_exprs_thrs=-1.0,
+            min_pct=0.0, q_val_thrs=0.5,
+        )
+        res = pairwise_de(data, labels, cfg)
+        assert res.cluster_names == ["c0", "c1", "tiny"]
+        skipped = res.pair_skipped
+        # both pairs involving 'tiny' are skipped; c0-vs-c1 runs
+        for p in range(res.n_pairs):
+            names = {res.cluster_names[res.pair_i[p]],
+                     res.cluster_names[res.pair_j[p]]}
+            if "tiny" in names:
+                assert skipped[p]
+                assert not res.tested[p].any()
+                assert not res.de_mask[p].any()
+                assert np.isnan(res.log_p[p]).all()
+            else:
+                assert not skipped[p]
+                assert res.tested[p].any()
+        assert len(res.skip_reasons) == 2
+        assert all("min_cells_group=3" in r for r in res.skip_reasons)
+
+    def test_all_pairs_skipped_raises(self):
+        data, _, _ = synthetic_scrna(n_genes=50, n_cells=60, n_clusters=1, seed=1)
+        labels = np.array(["a"] * 2 + ["b"] * 2 + ["c"] * 56)
+        cfg = ReclusterConfig(min_cluster_size=1, min_cells_group=30)
+        with pytest.raises(ValueError, match="min_cells_group"):
+            pairwise_de(data, labels, cfg)
+
+    def test_skip_survives_store_roundtrip(self):
+        from scconsensus_tpu.de.engine import PairwiseDEResult
+
+        data, labels = self._case()
+        cfg = ReclusterConfig(min_cluster_size=1, mean_exprs_thrs=-1.0,
+                              min_pct=0.0)
+        res = pairwise_de(data, labels, cfg)
+        back = PairwiseDEResult.from_store(*res.to_store())
+        np.testing.assert_array_equal(back.pair_skipped, res.pair_skipped)
+        assert back.skip_reasons == res.skip_reasons
+
+    def test_legacy_store_without_pair_skipped_loads(self):
+        from scconsensus_tpu.de.engine import PairwiseDEResult
+
+        data, labels = self._case()
+        cfg = ReclusterConfig(min_cluster_size=1, mean_exprs_thrs=-1.0,
+                              min_pct=0.0)
+        arrays, meta = pairwise_de(data, labels, cfg).to_store()
+        del arrays["pair_skipped"]  # store written before this field existed
+        meta.pop("skip_reasons", None)
+        back = PairwiseDEResult.from_store(arrays, meta)
+        assert not back.pair_skipped.any()
+
+
 def test_de_gene_union_top_n():
     # construct a fake result with known fold changes
     from scconsensus_tpu.de.engine import PairwiseDEResult
