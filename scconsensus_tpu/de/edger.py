@@ -227,8 +227,13 @@ def run_edger_pairs(
         tagwise_out[bucket.rows] = np.asarray(tagwise)
 
         # Exact test, chunked to bound the (B, Gc, s_max) tail tensor.
-        # Gene axis padded so the jit cache holds one entry per bucket shape.
-        gce = max(64, _NB_CHUNK_ELEMS // max(B * _EXACT_SMAX, 1))
+        # s_max adapts to the largest rounded total actually present (pow2 so
+        # the jit cache stays small): in compat mode the "counts" are
+        # log-normalized values whose sums are tiny, and a fixed 4096-wide
+        # tail tensor would be ~10× wasted bandwidth on every platform.
+        max_total = float(np.max(np.round(s1_full) + np.round(s2_full), initial=0.0))
+        s_max = int(min(_EXACT_SMAX, _next_pow2(max(int(max_total) + 2, 64))))
+        gce = max(64, _NB_CHUNK_ELEMS // max(B * s_max, 1))
         tagwise_np = np.asarray(tagwise)
         for g0 in range(0, G, gce):
             g1 = min(g0 + gce, G)
@@ -240,7 +245,7 @@ def run_edger_pairs(
                 n1[:, None],
                 n2[:, None],
                 jnp.asarray(np.pad(tagwise_np[:, g0:g1], pad_w, constant_values=1.0)),
-                s_max=_EXACT_SMAX,
+                s_max=s_max,
             )
             log_p[bucket.rows, g0:g1] = np.asarray(lp)[:, : g1 - g0]
 

@@ -105,6 +105,74 @@ def test_resume_preserves_aux(tmp_path, rng):
     )
 
 
+class TestCellTypeDEPlotFidelity:
+    """Pin the report to the reference's literal constants
+    (R/cellTypeDEPlot.R:173-258)."""
+
+    def test_ramp_stops_match_reference(self):
+        from scconsensus_tpu.report.de_heatmap import COLOR_SCHEMES
+
+        rainbow = ["#00007F", "blue", "#007FFF", "cyan", "#7FFF7F",
+                   "yellow", "#FF7F00", "red", "#7F0000"]  # :180-190
+        assert COLOR_SCHEMES["blue"] == rainbow
+        assert COLOR_SCHEMES["green"] == rainbow  # same stops, range differs
+        assert COLOR_SCHEMES["violet"] == [
+            "#7777FF", "white", "red", "#7F0000", "#2F0000"]  # :216-220
+
+    def test_scheme_ranges(self):
+        from scconsensus_tpu.report.de_heatmap import SCHEME_RANGES
+
+        data = np.array([[-2.0, 1.0], [0.5, 3.0]])
+        assert SCHEME_RANGES("blue", data) == (-2.0, 3.0)      # [min, max]
+        assert SCHEME_RANGES("green", data) == (-3.0, 3.0)     # ±max|.|
+        assert SCHEME_RANGES("violet", data) == (0.5, 3.0)     # [min|.|, max|.|]
+
+    def test_default_scheme_is_green(self):
+        import inspect
+
+        from scconsensus_tpu.report.de_heatmap import cell_type_de_plot
+
+        sig = inspect.signature(cell_type_de_plot)
+        assert sig.parameters["col_scheme"].default == "green"  # :23
+
+    def test_pdf_naming_and_nodg_fallback(self, tmp_path, rng):
+        from scconsensus_tpu.ops.linkage import ward_linkage
+        from scconsensus_tpu.report.de_heatmap import cell_type_de_plot
+
+        n, g = 60, 12
+        mat = np.abs(rng.normal(size=(g, n))).astype(np.float32)
+        tree = ward_linkage(rng.normal(size=(n, 4)))
+        out = cell_type_de_plot(
+            data_matrix=mat,
+            nodg=None,  # reference fallback :31-36
+            cell_tree=tree,
+            cluster_labels=np.array([f"c{i % 2}" for i in range(n)]),
+            dynamic_colors_list={"deepsplit: 1": np.array(["turquoise"] * n)},
+            filename=str(tmp_path / "report"),  # no extension
+        )
+        assert out.endswith("report.pdf")  # paste0(filename, ".pdf") :256
+        assert os.path.getsize(out) > 5_000
+
+    def test_binned_rendering_keeps_small_cluster(self, tmp_path, rng):
+        from scconsensus_tpu.ops.linkage import ward_linkage
+        from scconsensus_tpu.report.de_heatmap import cell_type_de_plot
+
+        n, g = 600, 10
+        mat = np.abs(rng.normal(size=(g, n))).astype(np.float32)
+        tree = ward_linkage(rng.normal(size=(n, 4)))
+        labels = np.array(["big"] * (n - 3) + ["tiny"] * 3)
+        out = cell_type_de_plot(
+            data_matrix=mat,
+            nodg=(mat > 0.5).sum(axis=0),
+            cell_tree=tree,
+            cluster_labels=labels,
+            dynamic_colors_list={},
+            filename=str(tmp_path / "binned.png"),
+            max_cells_rendered=50,  # force aggregation
+        )
+        assert os.path.getsize(out) > 5_000
+
+
 def test_de_heatmap_renders_with_groups(tmp_path, rng):
     from scconsensus_tpu.ops.linkage import ward_linkage
     from scconsensus_tpu.report.de_heatmap import cell_type_de_plot
