@@ -1,0 +1,269 @@
+"""Direct per-pair NB reference engine (test oracle for ``de.edger``).
+
+This is the round-2 production driver, retained verbatim as the small-scale
+reference implementation: it equalizes library sizes per pair and evaluates
+every conditional-likelihood grid densely over the pair's cells — the
+literal shape of the reference pipeline (R/reclusterDEConsensus.R:123-156:
+per pair, DGEList(group ±1) → estimateCommonDisp → estimateTagwiseDisp →
+calcNormFactors("none") → exactTest). It is O(pairs × genes × cells ×
+grid) and memory-unbounded in the pilot phase, so it is NOT reachable from
+the production engine — ``de.edger`` (global equalization + node-table
+grids) is validated against it in tests/test_edger_parity.py.
+
+TPU shape of the computation (SURVEY.md §7 stage 4): cluster pairs are
+bucketed by padded width exactly like the Wilcoxon path; genes ride a vmapped
+chunk axis. Two device phases per bucket:
+
+  phase 1 (pilot): on a strided gene subsample, equalize library sizes at the
+    pilot dispersion 0.01, score the conditional log-likelihood over a φ grid,
+    and take the per-pair qCML **common dispersion** (grid + quadratic refine
+    stands in for R's optimize(); the subsample — the common dispersion is a
+    single scalar pooled over thousands of genes — is a documented divergence
+    from edgeR, which uses every gene passing the rowsum filter).
+
+  phase 2 (full): re-equalize at the common dispersion, accumulate per-gene
+    conditional-LL grids for the tagwise EB shrinkage, group pseudo-count
+    sums, and the mean-expression/abundance numbers; then the Beta-Binomial
+    exact test per gene.
+
+Note the reference feeds *log-normalized* values to DGEList as if they were
+counts (R/reclusterDEConsensus.R:133 passes `data` directly). Compat mode
+reproduces that literal arithmetic; fixed mode tests on expm1(data).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scconsensus_tpu.ops.negbin import (
+    common_dispersion_grid,
+    delta_grid,
+    equalize_pseudo,
+    nb_cond_log_lik,
+    nb_exact_test_logp,
+    tagwise_dispersion,
+    TAGWISE_GRID_EXPONENTS,
+)
+
+__all__ = ["run_edger_pairs", "EdgerPairResult"]
+
+_PILOT_DISPERSION = 0.01
+_PILOT_MAX_GENES = 2048
+_ROWSUM_FILTER = 5.0
+_PRIOR_DF = 10.0
+_LOGFC_PRIOR_COUNT = 0.125
+_EXACT_SMAX = 4096
+# Per-chunk element budget for (B, Gc, W) tiles (transcendental-heavy).
+_NB_CHUNK_ELEMS = 8_000_000
+
+
+@dataclasses.dataclass
+class EdgerPairResult:
+    log_p: np.ndarray      # (P, G)
+    log_fc: np.ndarray     # (P, G) natural-log fold change group1 vs group2
+    common_disp: np.ndarray  # (P,)
+    tagwise_disp: np.ndarray  # (P, G)
+
+
+@jax.jit
+def _pilot_kernel(sub_counts, idx, m1, m2, lib_tile, common_lib, deltas):
+    """Pilot-phase conditional-LL grid. sub_counts: (Gs, N); idx/m1/m2:
+    (B, W); lib_tile: (B, W); common_lib: (B,); deltas: (D,).
+    Returns (B, D) LL sums over filtered subsample genes."""
+    y = jnp.swapaxes(jnp.take(sub_counts, idx, axis=1), 0, 1)  # (B, Gs, W)
+    m1e = m1[:, None, :]
+    m2e = m2[:, None, :]
+    lib = lib_tile[:, None, :]
+    ps = equalize_pseudo(
+        y, lib, m1e, m2e, common_lib[:, None], jnp.float32(_PILOT_DISPERSION)
+    )
+    pooled = m1e | m2e
+    z = jnp.sum(jnp.where(pooled, y, 0.0), axis=-1)  # (B, Gs)
+    keep = z > _ROWSUM_FILTER
+
+    def ll_at(delta):
+        r = (1.0 - delta) / delta
+        ll = nb_cond_log_lik(ps.pseudo, m1e, r) + nb_cond_log_lik(
+            ps.pseudo, m2e, r
+        )
+        return jnp.sum(jnp.where(keep, ll, 0.0), axis=-1)  # (B,)
+
+    grid = jax.lax.map(ll_at, deltas)  # (D, B)
+    return grid.T
+
+
+@jax.jit
+def _pass2_kernel(chunk, idx, m1, m2, lib_tile, common_lib, common_disp):
+    """Full-phase per-gene statistics at the common dispersion.
+
+    chunk: (Gc, N); common_disp: (B,). Returns
+    (s1, s2, ll_grid (B, Gc, T), keep (B, Gc))."""
+    y = jnp.swapaxes(jnp.take(chunk, idx, axis=1), 0, 1)  # (B, Gc, W)
+    m1e = m1[:, None, :]
+    m2e = m2[:, None, :]
+    lib = lib_tile[:, None, :]
+    ps = equalize_pseudo(
+        y, lib, m1e, m2e, common_lib[:, None], common_disp[:, None]
+    )
+    s1 = jnp.sum(jnp.where(m1e, ps.pseudo, 0.0), axis=-1)  # (B, Gc)
+    s2 = jnp.sum(jnp.where(m2e, ps.pseudo, 0.0), axis=-1)
+    pooled = m1e | m2e
+    z = jnp.sum(jnp.where(pooled, y, 0.0), axis=-1)
+    keep = z > _ROWSUM_FILTER
+
+    def ll_at(expo):
+        phi = common_disp[:, None] * jnp.exp2(expo)  # (B, 1)
+        r = 1.0 / jnp.maximum(phi, 1e-10)
+        return nb_cond_log_lik(ps.pseudo, m1e, r) + nb_cond_log_lik(
+            ps.pseudo, m2e, r
+        )  # (B, Gc)
+
+    ll_grid = jax.lax.map(ll_at, TAGWISE_GRID_EXPONENTS)  # (T, B, Gc)
+    return s1, s2, jnp.moveaxis(ll_grid, 0, -1), keep
+
+
+def run_edger_pairs(
+    counts: np.ndarray,
+    buckets,
+    n_genes: int,
+    n_pairs: int,
+) -> EdgerPairResult:
+    """Run the NB pipeline for every bucketed pair.
+
+    counts: (G, N) the matrix handed to DGEList (log-normalized data in
+    compat mode — the reference's literal behavior — or expm1 of it); may be
+    dense or scipy-sparse (gene chunks densified on demand);
+    buckets: list of engine _PairBucket.
+    """
+    from scconsensus_tpu.io.sparsemat import (
+        as_csr,
+        is_sparse,
+        padded_row_chunk,
+        rows_dense,
+    )
+
+    sparse = is_sparse(counts)
+    if sparse:
+        counts = as_csr(counts)
+    else:
+        counts = np.ascontiguousarray(counts, np.float32)
+    G = n_genes
+    jcounts = None if sparse else jnp.asarray(counts)
+    if sparse:
+        lib_all = jnp.asarray(
+            np.asarray(counts.sum(axis=0), np.float32).ravel()
+        )
+    else:
+        lib_all = jnp.sum(jcounts, axis=0)  # (N,) library sizes
+
+    log_p = np.full((n_pairs, G), np.nan, np.float32)
+    log_fc = np.full((n_pairs, G), np.nan, np.float32)
+    common_out = np.zeros(n_pairs, np.float32)
+    tagwise_out = np.full((n_pairs, G), np.nan, np.float32)
+
+    stride = max(1, G // _PILOT_MAX_GENES)
+    sub_idx = np.arange(0, G, stride, dtype=np.int64)[:_PILOT_MAX_GENES]
+    if sparse:
+        jsub = jnp.asarray(rows_dense(counts, sub_idx))
+    else:
+        jsub = jcounts[jnp.asarray(sub_idx)]
+    deltas = delta_grid(24)
+
+    for bucket in buckets:
+        B, W = bucket.cell_idx.shape
+        idx = jnp.asarray(bucket.cell_idx)
+        m1 = jnp.asarray(bucket.mask1)
+        m2 = jnp.asarray(bucket.mask2)
+        n1 = jnp.asarray(bucket.n1).astype(jnp.float32)
+        n2 = jnp.asarray(bucket.n2).astype(jnp.float32)
+        lib_tile = jnp.take(lib_all, idx)  # (B, W)
+        pooled = bucket.mask1 | bucket.mask2
+        # Geometric mean of the pooled cells' library sizes (common lib size).
+        lib_np = np.asarray(lib_tile)
+        with np.errstate(divide="ignore"):
+            loglib = np.where(pooled, np.log(np.maximum(lib_np, 1e-30)), 0.0)
+        common_lib = jnp.asarray(
+            np.exp(loglib.sum(axis=1) / np.maximum(pooled.sum(axis=1), 1))
+        )
+
+        # Phase 1: pilot common dispersion.
+        grid = _pilot_kernel(jsub, idx, m1, m2, lib_tile, common_lib, deltas)
+        common = common_dispersion_grid(grid, deltas)  # (B,)
+        common_out[bucket.rows] = np.asarray(common)
+
+        # Phase 2: per-gene LL grids + pseudo sums, chunked over genes.
+        from scconsensus_tpu.de.engine import _next_pow2
+
+        gc = max(128, _NB_CHUNK_ELEMS // max(B * W, 1))
+        gc = min(_next_pow2(gc), _next_pow2(G))
+        s1_full = np.zeros((B, G), np.float32)
+        s2_full = np.zeros((B, G), np.float32)
+        ll_full = np.zeros((B, G, TAGWISE_GRID_EXPONENTS.shape[0]), np.float32)
+        keep_full = np.zeros((B, G), bool)
+        for g0 in range(0, G, gc):
+            if sparse:
+                chunk = jnp.asarray(padded_row_chunk(counts, g0, gc))
+            else:
+                chunk = jcounts[g0 : g0 + gc]
+                if chunk.shape[0] < gc:
+                    chunk = jnp.pad(chunk, ((0, gc - chunk.shape[0]), (0, 0)))
+            s1, s2, ll_g, keep = _pass2_kernel(
+                chunk, idx, m1, m2, lib_tile, common_lib, common
+            )
+            g1 = min(g0 + gc, G)
+            s1_full[:, g0:g1] = np.asarray(s1)[:, : g1 - g0]
+            s2_full[:, g0:g1] = np.asarray(s2)[:, : g1 - g0]
+            ll_full[:, g0:g1] = np.asarray(ll_g)[:, : g1 - g0]
+            keep_full[:, g0:g1] = np.asarray(keep)[:, : g1 - g0]
+
+        # Tagwise EB shrinkage (prior.df = 10, trend="none" semantics).
+        prior_n = jnp.asarray(
+            _PRIOR_DF / np.maximum(bucket.n1 + bucket.n2 - 2, 1)
+        ).astype(jnp.float32)
+        tagwise = tagwise_dispersion(
+            jnp.asarray(ll_full), common, prior_n, jnp.asarray(keep_full)
+        )  # (B, G)
+        tagwise_out[bucket.rows] = np.asarray(tagwise)
+
+        # Exact test, chunked to bound the (B, Gc, s_max) tail tensor.
+        # s_max adapts to the largest rounded total actually present (pow2 so
+        # the jit cache stays small): in compat mode the "counts" are
+        # log-normalized values whose sums are tiny, and a fixed 4096-wide
+        # tail tensor would be ~10× wasted bandwidth on every platform.
+        max_total = float(np.max(np.round(s1_full) + np.round(s2_full), initial=0.0))
+        s_max = int(min(_EXACT_SMAX, _next_pow2(max(int(max_total) + 2, 64))))
+        gce = max(64, _NB_CHUNK_ELEMS // max(B * s_max, 1))
+        tagwise_np = np.asarray(tagwise)
+        for g0 in range(0, G, gce):
+            g1 = min(g0 + gce, G)
+            pad = gce - (g1 - g0)
+            pad_w = ((0, 0), (0, pad))
+            lp = nb_exact_test_logp(
+                jnp.asarray(np.pad(s1_full[:, g0:g1], pad_w)),
+                jnp.asarray(np.pad(s2_full[:, g0:g1], pad_w)),
+                n1[:, None],
+                n2[:, None],
+                jnp.asarray(np.pad(tagwise_np[:, g0:g1], pad_w, constant_values=1.0)),
+                s_max=s_max,
+            )
+            log_p[bucket.rows, g0:g1] = np.asarray(lp)[:, : g1 - g0]
+
+        # logFC (natural log) from equalized group abundances with the small
+        # prior count (edgeR exactTest reports log2; the engine thresholds in
+        # natural log — §2d-1's unit mismatch resolved explicitly here).
+        ab1 = s1_full / np.maximum(bucket.n1[:, None], 1) + _LOGFC_PRIOR_COUNT
+        ab2 = s2_full / np.maximum(bucket.n2[:, None], 1) + _LOGFC_PRIOR_COUNT
+        log_fc[bucket.rows] = np.log(ab1) - np.log(ab2)
+
+    return EdgerPairResult(
+        log_p=log_p,
+        log_fc=log_fc,
+        common_disp=common_out,
+        tagwise_disp=tagwise_out,
+    )

@@ -2,14 +2,22 @@
 
 The reference fans the outer cluster index over R worker processes with a
 triangular load imbalance (R/reclusterDEConsensusFast.R:61-65; SURVEY.md §3
-E3). Here all K(K−1)/2 pairs are flattened into one batch axis, bucketed by
-padded pair width so shapes stay static, and driven through vmapped kernels —
-the TPU equivalent of the reference's doParallel backend (SURVEY.md §2b N10).
+E3). Here every statistic is computed for all K(K−1)/2 pairs at once from
+per-cluster structures — the TPU equivalent of the reference's doParallel
+backend (SURVEY.md §2b N10):
+
+  * rank tests (wilcox/roc) ride the sorted-cumsum all-pairs engine
+    (ops.ranksum_allpairs): one sort per gene, cross-cluster dominance
+    counts via MXU contractions, zero per-pair gathers;
+  * moment tests (bimod/t) and all gates come straight from the per-cluster
+    aggregate matmuls (ops.gates, ops.seurat_tests) — per-cell data is
+    touched exactly once;
+  * the NB/edgeR path buckets pairs by padded width (de.edger).
 
 Engine flow:
   1. cluster filter (count > min_cluster_size, drop 'grey'; reference
      R/reclusterDEConsensus.R:39-49),
-  2. per-cluster aggregates: three matmuls against the membership one-hot,
+  2. per-cluster aggregates: four matmuls against the membership one-hot,
   3. per-pair gates from aggregates (masks, no ragged selection),
   4. per-pair statistical test over gene chunks (device),
   5. per-pair BH (masked or explicit-n, per path semantics),
@@ -32,17 +40,10 @@ from scconsensus_tpu.ops.gates import (
     pair_gates_slow,
 )
 from scconsensus_tpu.ops.multipletests import bh_adjust, bh_adjust_masked
-from scconsensus_tpu.ops.seurat_tests import bimod_lrt_tile, welch_t_tile
-from scconsensus_tpu.ops.wilcoxon import (
-    EXACT_N_LIMIT,
-    wilcoxon_exact_host,
-    wilcoxon_pairs_tile,
-)
+from scconsensus_tpu.ops.seurat_tests import bimod_lrt_pairs, welch_t_pairs
+from scconsensus_tpu.ops.wilcoxon import EXACT_N_LIMIT, wilcoxon_exact_host
 
 __all__ = ["PairwiseDEResult", "pairwise_de", "filter_clusters", "de_gene_union"]
-
-# Per-chunk element budget for the (pairs × genes × cells) test tensor.
-_CHUNK_ELEM_BUDGET = 24_000_000
 
 
 @dataclasses.dataclass
@@ -199,183 +200,128 @@ def _bucket_pairs(
     return buckets
 
 
-# Rank-sum test for one gene-chunk × pair-bucket tile; the shared
-# implementation lives in ops.wilcoxon so the sharded and fused paths
-# cannot diverge from the serial engine.
-_wilcox_chunk = jax.jit(wilcoxon_pairs_tile)
+def _cid_from_groups(cell_idx_of: List[np.ndarray], n_cells: int) -> np.ndarray:
+    """Per-cell cluster index (−1 = excluded) from the per-cluster cell lists
+    — the post-subsampling group definition every statistical test uses."""
+    cid = np.full(n_cells, -1, np.int32)
+    for k, ci in enumerate(cell_idx_of):
+        cid[ci] = k
+    return cid
 
 
-@jax.jit
-def _bimod_chunk(chunk, idx, m1, m2):
-    return bimod_lrt_tile(jnp.swapaxes(jnp.take(chunk, idx, axis=1), 0, 1), m1, m2)
-
-
-@jax.jit
-def _ttest_chunk(chunk, idx, m1, m2):
-    return welch_t_tile(jnp.swapaxes(jnp.take(chunk, idx, axis=1), 0, 1), m1, m2)
-
-
-def _chunk_tiles(data, cell_idx_of, pair_i, pair_j):
-    """Shared bucket/gene-chunk iteration for every tile test: yields
-    (bucket, (idx, m1, m2, n1, n2) device tensors, g0, g1, padded chunk).
-    Chunks are padded to a fixed width so each bucket shape compiles once.
-
-    ``data`` may be dense or scipy-sparse: only the current gene-chunk is
-    ever densified (the never-densify contract, SURVEY.md §2b N12). The
-    dense path keeps the whole matrix device-resident across buckets.
-    """
+def _gene_chunks(data, gc: int, jdata=None):
+    """Yield (g0, g1, device chunk padded to gc rows). Sparse inputs densify
+    one chunk at a time (never-densify contract, SURVEY.md §2b N12); dense
+    callers pass the already-uploaded ``jdata`` so the matrix crosses
+    host→device exactly once per pipeline run."""
     from scconsensus_tpu.io.sparsemat import is_sparse, padded_row_chunk
 
-    sparse = is_sparse(data)
-    jdata = None if sparse else jnp.asarray(data)
     G = data.shape[0]
-    for bucket in _bucket_pairs(cell_idx_of, pair_i, pair_j):
-        B, W = bucket.cell_idx.shape
-        gc = max(256, _CHUNK_ELEM_BUDGET // max(B * W, 1))
-        gc = min(_next_pow2(gc), _next_pow2(G))
-        tensors = (
-            jnp.asarray(bucket.cell_idx),
-            jnp.asarray(bucket.mask1),
-            jnp.asarray(bucket.mask2),
-            jnp.asarray(bucket.n1),
-            jnp.asarray(bucket.n2),
-        )
-        for g0 in range(0, G, gc):
-            if sparse:
-                chunk = jnp.asarray(padded_row_chunk(data, g0, gc))
-            else:
-                chunk = jdata[g0 : g0 + gc]
-                if chunk.shape[0] < gc:
-                    chunk = jnp.pad(chunk, ((0, gc - chunk.shape[0]), (0, 0)))
-            yield bucket, tensors, g0, min(g0 + gc, G), chunk
-
-
-def _run_tile_test(
-    data: np.ndarray,
-    cell_idx_of: List[np.ndarray],
-    pair_i: np.ndarray,
-    pair_j: np.ndarray,
-    chunk_fn,
-) -> np.ndarray:
-    """Generic moment-based tile test (bimod / t): same bucketing and gene
-    chunking as the rank-sum path, no exact branch. Returns log_p (P, G)."""
-    G, _ = data.shape
-    log_p = np.full((pair_i.shape[0], G), np.nan, np.float32)
-    for bucket, (idx, m1, m2, _n1, _n2), g0, g1, chunk in _chunk_tiles(
-        data, cell_idx_of, pair_i, pair_j
-    ):
-        lp = chunk_fn(chunk, idx, m1, m2)
-        log_p[bucket.rows, g0:g1] = np.asarray(lp)[:, : g1 - g0]
-    return log_p
-
-
-@jax.jit
-def _wilcox_task_chunk(
-    data: jnp.ndarray,   # (G, N) device-resident full matrix
-    gid: jnp.ndarray,    # (T,) gene index per task
-    pidx: jnp.ndarray,   # (T,) bucket-local pair index per task
-    idx: jnp.ndarray,    # (B, W) pair cell gathers
-    m1: jnp.ndarray,     # (B, W)
-    m2: jnp.ndarray,
-    n1: jnp.ndarray,     # (B,)
-    n2: jnp.ndarray,
-):
-    """Rank-sum over a flat (pair, gene) task list — the gated fast path.
-
-    Each task is one gene of one pair; batching tasks instead of (pairs ×
-    all-genes) tiles means only gate-surviving genes are ever ranked (the
-    reference's fast path tests only survivors,
-    R/reclusterDEConsensusFast.R:306-333) and load is balanced across pairs.
-    Returns (log_p, u, tie_sum), each (T,).
-    """
-    cell_rows = jnp.take(idx, pidx, axis=0)          # (T, W)
-    vals = data[gid[:, None], cell_rows]             # (T, W) double gather
-    mask1 = jnp.take(m1, pidx, axis=0)
-    mask2 = jnp.take(m2, pidx, axis=0)
-    from scconsensus_tpu.ops.ranks import masked_midranks
-
-    ranks, tie_sum = masked_midranks(vals, mask1 | mask2)
-    rs1 = jnp.sum(jnp.where(mask1, ranks, 0.0), axis=-1)
-    from scconsensus_tpu.ops.wilcoxon import wilcoxon_from_ranks
-
-    log_p, u = wilcoxon_from_ranks(
-        rs1, tie_sum, jnp.take(n1, pidx), jnp.take(n2, pidx)
-    )
-    return log_p, u, tie_sum
+    sparse = is_sparse(data)
+    if jdata is None and not sparse:
+        jdata = jnp.asarray(data)
+    for g0 in range(0, G, gc):
+        if sparse:
+            chunk = jnp.asarray(padded_row_chunk(data, g0, gc))
+        else:
+            chunk = jdata[g0 : g0 + gc]
+            if chunk.shape[0] < gc:
+                chunk = jnp.pad(chunk, ((0, gc - chunk.shape[0]), (0, 0)))
+        yield g0, min(g0 + gc, G), chunk
 
 
 def _exact_host_update(
     log_p: np.ndarray, row: int, cols: np.ndarray, u_vals: np.ndarray,
     n1: int, n2: int,
 ) -> None:
-    """Overwrite log_p[row, cols] with R's exact-branch p-values (shared by
-    the tile and task paths so the policy and arithmetic cannot drift)."""
+    """Overwrite log_p[row, cols] with R's exact-branch p-values (one shared
+    implementation so the policy and arithmetic cannot drift)."""
     pe = wilcoxon_exact_host(u_vals, n1, n2)
     log_p[row, cols] = np.log(pe).astype(np.float32)
 
 
-def _run_wilcox_gated(
+def _run_wilcox_device(
     data: np.ndarray,
     cell_idx_of: List[np.ndarray],
     pair_i: np.ndarray,
     pair_j: np.ndarray,
-    tested: np.ndarray,
     exact: str = "auto",
-) -> Tuple[np.ndarray, np.ndarray]:
-    """Rank-sum log-p over only the gate-surviving (pair, gene) tasks.
+    mesh=None,
+    jdata=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Rank-sum for every (pair, gene) via the all-pairs sorted-cumsum
+    engine (ops.ranksum_allpairs — one sort per gene, zero per-pair
+    gathers). Returns DEVICE arrays (log_p (P, G), u (P, G)) — device→host
+    transfer through the axon tunnel runs at ~36 MB/s, so results stay on
+    device until the caller's single batched fetch.
 
-    Dense-input fast path; results for untested entries stay NaN (they are
-    masked out of BH and the DE call anyway — fast-path semantics). Returns
-    (log_p (P, G), u (P, G)).
+    ``exact``: 'auto' applies R's exact branch on host for pairs with both
+    groups < 50 cells and tie-free genes (only those pairs' rows are
+    fetched); 'never' keeps everything on the normal-approximation device
+    path. ``mesh``: optional device mesh — gene chunks are sharded across
+    it (genes are embarrassingly parallel).
     """
-    G, _ = data.shape
-    P = pair_i.shape[0]
-    log_p = np.full((P, G), np.nan, np.float32)
-    u_stat = np.full((P, G), np.nan, np.float32)
-    jdata = jnp.asarray(data)
-    for bucket in _bucket_pairs(cell_idx_of, pair_i, pair_j):
-        B, W = bucket.cell_idx.shape
-        pr, gi = np.nonzero(tested[bucket.rows])  # bucket-local task list
-        if pr.size == 0:
-            continue
-        # Chunk width depends only on W (never on the data-dependent task
-        # count) so each bucket shape compiles exactly once across calls.
-        tb = min(_next_pow2(max(256, _CHUNK_ELEM_BUDGET // max(W, 1))), 16384)
-        idx = jnp.asarray(bucket.cell_idx)
-        m1 = jnp.asarray(bucket.mask1)
-        m2 = jnp.asarray(bucket.mask2)
-        n1 = jnp.asarray(bucket.n1)
-        n2 = jnp.asarray(bucket.n2)
-        for t0 in range(0, pr.size, tb):
-            t1 = min(t0 + tb, pr.size)
-            pad = tb - (t1 - t0)
-            prt = np.pad(pr[t0:t1], (0, pad))
-            git = np.pad(gi[t0:t1], (0, pad))
-            lp, u, ties = _wilcox_task_chunk(
-                jdata, jnp.asarray(git), jnp.asarray(prt),
-                idx, m1, m2, n1, n2,
+    from scconsensus_tpu.ops.ranksum_allpairs import (
+        allpairs_ranksum_chunk,
+        chunk_genes_for_budget,
+    )
+
+    G, N = data.shape
+    K = len(cell_idx_of)
+    n_of = np.array([ci.size for ci in cell_idx_of], np.int32)
+    cid = _cid_from_groups(cell_idx_of, N)
+    jcid = jnp.asarray(cid)
+    jn = jnp.asarray(n_of)
+    jpi = jnp.asarray(pair_i)
+    jpj = jnp.asarray(pair_j)
+    gc = chunk_genes_for_budget(N, K)
+    gc = min(gc, 1 << (int(G) - 1).bit_length())
+    if mesh is not None:
+        from scconsensus_tpu.parallel.sharded_de import sharded_allpairs_ranksum
+
+        n_dev = int(mesh.devices.size)
+        gc = max(gc, n_dev * 8)
+
+    outs = []
+    for g0, g1, chunk in _gene_chunks(data, gc, jdata=jdata):
+        if mesh is not None:
+            outs.append((g0, g1, sharded_allpairs_ranksum(
+                chunk, jcid, jn, jpi, jpj, K, mesh=mesh
+            )))
+        else:
+            outs.append((g0, g1, allpairs_ranksum_chunk(
+                chunk, jcid, jn, jpi, jpj, K
+            )))
+    log_p = jnp.concatenate(
+        [lp[: g1 - g0] for g0, g1, (lp, _, _) in outs], axis=0
+    ).T  # (P, G)
+    u_stat = jnp.concatenate(
+        [u[: g1 - g0] for g0, g1, (_, u, _) in outs], axis=0
+    ).T
+
+    if exact == "auto":
+        small = np.nonzero(
+            (n_of[pair_i] < EXACT_N_LIMIT) & (n_of[pair_j] < EXACT_N_LIMIT)
+        )[0]
+        if small.size:
+            # Fetch only the small pairs' rows (u + tie indicator).
+            ties = jnp.concatenate(
+                [ts[: g1 - g0] for g0, g1, (_, _, ts) in outs], axis=0
+            ).T
+            rows = jnp.asarray(small)
+            u_small, tie_small = jax.device_get(
+                (u_stat[rows], ties[rows])
             )
-            lp_h = np.asarray(lp)[: t1 - t0]
-            u_h = np.asarray(u)[: t1 - t0]
-            rows = bucket.rows[pr[t0:t1]]
-            cols = gi[t0:t1]
-            log_p[rows, cols] = lp_h
-            u_stat[rows, cols] = u_h
-            if exact == "auto":
-                prt_real = pr[t0:t1]
-                small = (bucket.n1[prt_real] < EXACT_N_LIMIT) & (
-                    bucket.n2[prt_real] < EXACT_N_LIMIT
-                )
-                if small.any():
-                    ties_h = np.asarray(ties)[: t1 - t0]
-                    pick = small & (ties_h == 0)
-                    # one vectorized exact call per pair, as the tile path does
-                    for b in np.unique(prt_real[pick]):
-                        sel = pick & (prt_real == b)
-                        _exact_host_update(
-                            log_p, bucket.rows[b], gi[t0:t1][sel], u_h[sel],
-                            int(bucket.n1[b]), int(bucket.n2[b]),
-                        )
+            lp_small = np.array(log_p[rows])  # writable host copy
+            for r, p in enumerate(small):
+                tiefree = tie_small[r] == 0
+                if tiefree.any():
+                    cols = np.nonzero(tiefree)[0]
+                    _exact_host_update(
+                        lp_small, r, cols, u_small[r][tiefree],
+                        int(n_of[pair_i[p]]), int(n_of[pair_j[p]]),
+                    )
+            log_p = log_p.at[rows].set(jnp.asarray(lp_small))
     return log_p, u_stat
 
 
@@ -385,38 +331,13 @@ def _run_wilcox(
     pair_i: np.ndarray,
     pair_j: np.ndarray,
     exact: str = "auto",
+    mesh=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Rank-sum log-p for every (pair, gene). Returns (log_p (P,G), u (P,G)).
-
-    ``exact``: 'auto' applies R's exact branch on host for pairs with both
-    groups < 50 cells and tie-free genes; 'never' keeps everything on the
-    normal-approximation device path.
-    """
-    G, _ = data.shape
-    P = pair_i.shape[0]
-    log_p = np.full((P, G), np.nan, np.float32)
-    u_stat = np.full((P, G), np.nan, np.float32)
-    for bucket, (idx, m1, m2, n1, n2), g0, g1, chunk in _chunk_tiles(
-        data, cell_idx_of, pair_i, pair_j
-    ):
-        lp, u, ties = _wilcox_chunk(chunk, idx, m1, m2, n1, n2)
-        lp_h = np.asarray(lp)[:, : g1 - g0]
-        u_h = np.asarray(u)[:, : g1 - g0]
-        log_p[bucket.rows, g0:g1] = lp_h
-        u_stat[bucket.rows, g0:g1] = u_h
-        if exact == "auto":
-            small = (bucket.n1 < EXACT_N_LIMIT) & (bucket.n2 < EXACT_N_LIMIT)
-            if small.any():
-                ties_h = np.asarray(ties)[:, : g1 - g0]
-                for b in np.nonzero(small)[0]:
-                    tiefree = ties_h[b] == 0
-                    if tiefree.any():
-                        cols = g0 + np.nonzero(tiefree)[0]
-                        _exact_host_update(
-                            log_p, bucket.rows[b], cols, u_h[b][tiefree],
-                            int(bucket.n1[b]), int(bucket.n2[b]),
-                        )
-    return log_p, u_stat
+    """Host-array form of ``_run_wilcox_device`` (tests, small callers)."""
+    lp, u = _run_wilcox_device(
+        data, cell_idx_of, pair_i, pair_j, exact=exact, mesh=mesh
+    )
+    return np.asarray(lp), np.asarray(u)
 
 
 def pairwise_de(
@@ -424,10 +345,14 @@ def pairwise_de(
     labels: Sequence,
     config: ReclusterConfig,
     timer=None,
+    mesh=None,
 ) -> PairwiseDEResult:
     """Run the configured all-pairs DE test.
 
     data: (G, N) log-normalized expression; labels: per-cell cluster names.
+    ``mesh``: optional jax.sharding.Mesh — the rank-sum gene chunks shard
+    across it (the product pipeline's dp analog of the reference's
+    doParallel fan-out, R/reclusterDEConsensusFast.R:61-65).
     """
     from scconsensus_tpu.io.sparsemat import as_csr, is_sparse, mean_expm1
     from scconsensus_tpu.utils.logging import StageTimer
@@ -449,12 +374,13 @@ def pairwise_de(
                 f"need >= 2 clusters above min_cluster_size={config.min_cluster_size}, got {K}"
             )
         cell_idx_of = [np.nonzero(cell_idx == k)[0].astype(np.int32) for k in range(K)]
+        subsampled = False
         if config.max_cells_per_ident is not None:
             rng = np.random.default_rng(config.random_seed)
+            cap = config.max_cells_per_ident
+            subsampled = any(ci.size > cap for ci in cell_idx_of)
             cell_idx_of = [
-                rng.choice(ci, size=config.max_cells_per_ident, replace=False)
-                if ci.size > config.max_cells_per_ident
-                else ci
+                rng.choice(ci, size=cap, replace=False) if ci.size > cap else ci
                 for ci in cell_idx_of
             ]
         pair_i, pair_j = _all_pairs(K)
@@ -479,6 +405,9 @@ def pairwise_de(
             )
 
     with timer.stage("aggregates", n_clusters=K, n_pairs=int(pair_i.size)):
+        # The matrix crosses host→device exactly once per run; every later
+        # stage reuses jdata.
+        jdata = None if is_sparse(data) else jnp.asarray(data)
         onehot = np.zeros((N, K), np.float32)
         valid = cell_idx >= 0
         onehot[np.nonzero(valid)[0], cell_idx[valid]] = 1.0
@@ -490,7 +419,7 @@ def pairwise_de(
                 *(jnp.asarray(a) for a in aggregates_from_sparse(data, onehot))
             )
         else:
-            agg = compute_aggregates(jnp.asarray(data), jnp.asarray(onehot))
+            agg = compute_aggregates(jdata, jnp.asarray(onehot))
 
     method = config.method.lower()
     pi, pj = jnp.asarray(pair_i), jnp.asarray(pair_j)
@@ -498,18 +427,20 @@ def pairwise_de(
 
     if method in ("wilcox", "wilcoxon", "roc", "bimod", "t"):
         slow = method == "wilcoxon"
+        j_ok = jnp.asarray(pair_ok)
         with timer.stage("gates"):
             if slow:
                 mean_gate, log_fc = pair_gates_slow(
                     agg, pi, pj,
-                    mean_exprs_thrs=config.mean_scaling_factor * mean_expm1(data),
+                    mean_exprs_thrs=float(
+                        config.mean_scaling_factor * mean_expm1(data)
+                    ),
                     mixed_spaces=config.compat.mean_gate_mixed_spaces,
                 )
-                tested = np.ones((P, G), bool)
-                tested[~pair_ok] = False
+                tested = jnp.broadcast_to(j_ok[:, None], (P, G))
                 pct1 = pct2 = None
             else:
-                gate, log_fc, p1, p2 = pair_gates_fast(
+                gate, log_fc, pct1, pct2 = pair_gates_fast(
                     agg, pi, pj,
                     min_pct=config.min_pct,
                     min_diff_pct=config.min_diff_pct,
@@ -518,94 +449,117 @@ def pairwise_de(
                     pseudocount=config.pseudocount,
                     only_pos=config.only_pos,
                 )
-                tested = np.array(gate)  # copy: jax buffers are read-only
-                tested[~pair_ok] = False
-                pct1, pct2 = np.asarray(p1), np.asarray(p2)
+                tested = gate & j_ok[:, None]
         aux: Optional[Dict[str, np.ndarray]] = None
         stage_name = (
             "wilcox_test" if method in ("wilcox", "wilcoxon") else f"{method}_test"
         )
 
-        def _rank_sum(need_all_genes: bool = False):
-            """Fast path tests only gate survivors (dense input); the slow
-            path, sparse inputs, and callers needing per-gene statistics for
-            every gene (roc's AUC) rank full tiles. Skipped pairs never run."""
-            if not slow and not need_all_genes and not is_sparse(data):
-                lp, u = _run_wilcox_gated(
-                    data, cell_idx_of, run_i, run_j, tested[ok_rows]
-                )
-            else:
-                lp, u = _run_wilcox(data, cell_idx_of, run_i, run_j)
-            return _expand_rows(lp, ok_rows, P), _expand_rows(u, ok_rows, P)
+        # The statistical tests run on the post-subsampling groups
+        # (max_cells_per_ident, reference R/reclusterDEConsensusFast.R:293-303
+        # — applied after the gates, which use the full-cluster aggregates).
+        # Skipped when no cluster actually exceeded the cap (identical agg).
+        test_agg = agg
+        if subsampled:
+            sub_onehot = np.zeros((N, K), np.float32)
+            for k, ci in enumerate(cell_idx_of):
+                sub_onehot[ci, k] = 1.0
+            if is_sparse(data):
+                from scconsensus_tpu.io.sparsemat import aggregates_from_sparse
+                from scconsensus_tpu.ops.gates import ClusterAggregates
 
+                test_agg = ClusterAggregates(*(
+                    jnp.asarray(a)
+                    for a in aggregates_from_sparse(data, sub_onehot)
+                ))
+            else:
+                test_agg = compute_aggregates(jdata, jnp.asarray(sub_onehot))
+
+        # All (pair, gene) statistics stay on device through BH and the DE
+        # call; ONE batched device_get at the end (the axon tunnel moves
+        # device→host at ~36 MB/s — per-stage np.asarray round-trips were
+        # the round-2 engine's hidden cost). The all-pairs kernels price
+        # every pair anyway, so group-size-skipped pairs are computed and
+        # masked to NaN rather than sliced out.
         with timer.stage(stage_name):
+            u_dev = None
             if method == "bimod":
-                log_p = _expand_rows(
-                    _run_tile_test(data, cell_idx_of, run_i, run_j, _bimod_chunk),
-                    ok_rows, P,
-                )
+                log_p = bimod_lrt_pairs(test_agg, pi, pj)
             elif method == "t":
-                log_p = _expand_rows(
-                    _run_tile_test(data, cell_idx_of, run_i, run_j, _ttest_chunk),
-                    ok_rows, P,
+                log_p = welch_t_pairs(test_agg, pi, pj)
+            else:
+                log_p, u_dev = _run_wilcox_device(
+                    data, cell_idx_of, pair_i, pair_j,
+                    mesh=mesh, jdata=jdata,
                 )
-            elif method == "roc":
+            if method == "roc":
                 # The reference's roc branch never produces a p-value usable
                 # downstream (dead Seurat helpers, SURVEY.md §2c); fixed
                 # behavior: AUC/power as the marker stats (N9: AUC falls out
                 # of the rank-sum statistic), rank-sum p for significance.
                 from scconsensus_tpu.ops.seurat_tests import auc_from_u
 
-                # AUC/power are marker statistics reported for every gene —
-                # rank full tiles so dense and sparse inputs agree exactly.
-                log_p, u = _rank_sum(need_all_genes=True)
-                n1s = np.array(
-                    [cell_idx_of[i].size for i in pair_i], np.float32
-                )[:, None]
-                n2s = np.array(
-                    [cell_idx_of[j].size for j in pair_j], np.float32
-                )[:, None]
-                auc, power = auc_from_u(jnp.asarray(u), n1s, n2s)
-                aux = {"auc": np.asarray(auc), "power": np.asarray(power)}
-            else:
-                log_p, _u = _rank_sum()
+                n1s = jnp.asarray(
+                    np.array([cell_idx_of[i].size for i in pair_i],
+                             np.float32)[:, None]
+                )
+                n2s = jnp.asarray(
+                    np.array([cell_idx_of[j].size for j in pair_j],
+                             np.float32)[:, None]
+                )
+                auc, power = auc_from_u(u_dev, n1s, n2s)
+                aux = {"auc": auc, "power": power}
+            # Fast-path contract: untested entries surface as NaN (they are
+            # additionally masked out of BH and the DE call); skipped pairs
+            # are NaN on every path.
+            log_p = jnp.where(tested if not slow else j_ok[:, None],
+                              log_p, jnp.nan)
         with timer.stage("bh_adjust"):
             if slow:
                 # BH with explicit n = G over all genes (§2d-4 slow semantics).
-                log_q = np.asarray(
-                    bh_adjust(jnp.asarray(log_p), n=jnp.asarray(float(G)))
+                log_q = (
+                    bh_adjust(log_p, n=jnp.asarray(float(G)))
                     if config.compat.bh_reference_n
-                    else bh_adjust(jnp.asarray(log_p))
+                    else bh_adjust(log_p)
                 )
             else:
-                log_q = np.asarray(
-                    bh_adjust_masked(jnp.asarray(log_p), jnp.asarray(tested))
-                )
-        log_fc = np.asarray(log_fc)
+                log_q = bh_adjust_masked(log_p, tested)
         with timer.stage("de_call"):
-            log_thr = np.log(np.float32(config.q_val_thrs))
+            log_thr = float(np.log(np.float32(config.q_val_thrs)))
             if slow:
                 de = (
                     (log_q < log_thr)
-                    & (np.abs(log_fc) > config.log_fc_thrs)
-                    & np.asarray(mean_gate)
+                    & (jnp.abs(log_fc) > config.log_fc_thrs)
+                    & mean_gate
                 )
-                de &= ~np.isnan(log_q)
             else:
-                de = tested & (log_q < log_thr) & ~np.isnan(log_q)
+                de = tested & (log_q < log_thr)
+            de = de & ~jnp.isnan(log_q)
+            fetch = {
+                "log_p": log_p, "log_q": log_q, "log_fc": log_fc,
+                "tested": tested, "de": de,
+            }
+            if pct1 is not None:
+                fetch["pct1"], fetch["pct2"] = pct1, pct2
+            if aux is not None:
+                fetch.update(aux)
+            host = jax.device_get(fetch)
         return PairwiseDEResult(
             cluster_names=names,
             pair_i=pair_i,
             pair_j=pair_j,
-            log_p=log_p,
-            log_q=log_q,
-            log_fc=log_fc,
-            tested=tested,
-            de_mask=de,
+            log_p=host["log_p"],
+            log_q=host["log_q"],
+            log_fc=host["log_fc"],
+            tested=host["tested"],
+            de_mask=host["de"],
             pair_skipped=~pair_ok,
-            pct1=pct1,
-            pct2=pct2,
-            aux=aux,
+            pct1=host.get("pct1"),
+            pct2=host.get("pct2"),
+            aux=(
+                {"auc": host["auc"], "power": host["power"]}
+                if aux is not None else None
+            ),
             skip_reasons=skip_reasons or None,
         )
 
@@ -624,8 +578,10 @@ def pairwise_de(
             counts = expm1_sparse(data)
             gate_mean = mean_value(counts)  # counts IS expm1(data): reuse it
         with timer.stage("edger_nb"):
-            buckets = _bucket_pairs(cell_idx_of, run_i, run_j)
-            nb = run_edger_pairs(counts, buckets, G, int(run_i.size))
+            nb = run_edger_pairs(
+                counts, cell_idx_of, run_i, run_j, G,
+                seed=config.random_seed,
+            )
         with timer.stage("gates"):
             mean_gate, _slow_fc = pair_gates_slow(
                 agg, pi, pj,

@@ -103,17 +103,19 @@ def nodg(x) -> np.ndarray:
 
 
 def aggregates_from_sparse(x, onehot: np.ndarray) -> Tuple[np.ndarray, ...]:
-    """Per-cluster sufficient statistics (Σx, Σexpm1 x, Σ[x>0], counts) as
-    host sparse matmuls against the membership one-hot — the sparse analog of
-    ops.gates.compute_aggregates' three MXU matmuls."""
+    """Per-cluster sufficient statistics (Σx, Σexpm1 x, Σx², Σ[x>0], counts)
+    as host sparse matmuls against the membership one-hot — the sparse analog
+    of ops.gates.compute_aggregates' four MXU matmuls."""
     counts = onehot.sum(axis=0)
     if is_sparse(x):
         sum_log = np.asarray(x @ onehot, dtype=np.float32)
         sum_expm1 = np.asarray(expm1_sparse(x) @ onehot, dtype=np.float32)
+        sum_sq = np.asarray(x.multiply(x) @ onehot, dtype=np.float32)
         nnz_mat = x.astype(bool).astype(np.float32)
         nnz = np.asarray(nnz_mat @ onehot, dtype=np.float32)
     else:
         sum_log = x @ onehot
         sum_expm1 = np.expm1(x) @ onehot
+        sum_sq = (x * x) @ onehot
         nnz = (x > 0).astype(np.float32) @ onehot
-    return sum_log, sum_expm1, nnz, counts.astype(np.float32)
+    return sum_log, sum_expm1, sum_sq, nnz, counts.astype(np.float32)

@@ -16,6 +16,7 @@ Two gate conventions exist in the reference and both are supported:
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Optional
 
 import jax
@@ -27,10 +28,16 @@ __all__ = ["ClusterAggregates", "compute_aggregates", "pair_gates_fast", "pair_g
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class ClusterAggregates:
-    """Per-cluster sufficient statistics, all (G, K) except counts (K,)."""
+    """Per-cluster sufficient statistics, all (G, K) except counts (K,).
+
+    These four matmuls carry every moment the fast-path tests consume:
+    gates (pct/logFC), Welch t (mean/var), and the bimod zero-inflated-normal
+    LRT (positive-fraction, positive mean/var) — so the per-pair statistical
+    tests never touch per-cell data again (SURVEY.md §7 stage 2)."""
 
     sum_log: jnp.ndarray      # Σ x (x = log-normalized input)
     sum_expm1: jnp.ndarray    # Σ expm1(x)
+    sum_sq: jnp.ndarray       # Σ x²
     nnz: jnp.ndarray          # Σ [x > 0]
     counts: jnp.ndarray       # cells per cluster (K,)
 
@@ -50,14 +57,27 @@ class ClusterAggregates:
 
 @jax.jit
 def compute_aggregates(data: jnp.ndarray, onehot: jnp.ndarray) -> ClusterAggregates:
-    """data: (G, N) log-normalized; onehot: (N, K) float cluster membership."""
+    """data: (G, N) log-normalized; onehot: (N, K) float cluster membership.
+
+    HIGHEST precision: these sums feed Welch/bimod variances via
+    ss − n·mean², where TPU bf16 matmul passes would wreck the cancellation
+    (and diverge from the exact-fp32 sparse host path)."""
+    hi = jax.lax.Precision.HIGHEST
     counts = jnp.sum(onehot, axis=0)
-    sum_log = data @ onehot
-    sum_expm1 = jnp.expm1(data) @ onehot
-    nnz = (data > 0).astype(data.dtype) @ onehot
-    return ClusterAggregates(sum_log, sum_expm1, nnz, counts)
+    sum_log = jnp.dot(data, onehot, precision=hi)
+    sum_expm1 = jnp.dot(jnp.expm1(data), onehot, precision=hi)
+    sum_sq = jnp.dot(data * data, onehot, precision=hi)
+    nnz = jnp.dot((data > 0).astype(data.dtype), onehot, precision=hi)
+    return ClusterAggregates(sum_log, sum_expm1, sum_sq, nnz, counts)
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "min_pct", "min_diff_pct", "log_fc_thrs", "mean_exprs_thrs",
+        "pseudocount", "only_pos",
+    ),
+)
 def pair_gates_fast(
     agg: ClusterAggregates,
     pair_i: jnp.ndarray,
@@ -99,6 +119,7 @@ def pair_gates_fast(
     return gate, log_fc, pct1, pct2
 
 
+@partial(jax.jit, static_argnames=("mean_exprs_thrs", "mixed_spaces"))
 def pair_gates_slow(
     agg: ClusterAggregates,
     pair_i: jnp.ndarray,

@@ -44,10 +44,12 @@ __all__ = [
     "nb_cond_log_lik",
     "one_group_nb_rate",
     "q2q_nbinom",
+    "q2q_normal",
     "equalize_pseudo",
     "common_dispersion_grid",
     "tagwise_dispersion",
     "nb_exact_test_logp",
+    "nb_exact_test_logp_normal",
     "DEFAULT_DELTA_GRID_SIZE",
     "TAGWISE_GRID_EXPONENTS",
 ]
@@ -166,6 +168,29 @@ def _qgamma(p: jnp.ndarray, shape: jnp.ndarray, n_iter: int = 6) -> jnp.ndarray:
         return jnp.maximum(x_new, 1e-10)
 
     return jax.lax.fori_loop(0, n_iter, body, x0)
+
+
+def q2q_normal(
+    x: jnp.ndarray,
+    mu_in: jnp.ndarray,
+    mu_out: jnp.ndarray,
+    dispersion: jnp.ndarray,
+) -> jnp.ndarray:
+    """Normal-approximation half of the NB quantile map: exact z-score
+    transfer between the two moment-matched normals (~10 flops/element, no
+    transcendentals beyond one sqrt).
+
+    Used for full-matrix library equalization where only group *sums* of the
+    pseudo-counts are consumed downstream (the skewness correction the gamma
+    map adds is zero-mean across cells and washes out of sums; the full
+    two-map average ``q2q_nbinom`` is reserved for the dispersion-estimation
+    subsample where per-value shape matters).
+    """
+    mu_in = jnp.maximum(mu_in, 1e-10)
+    mu_out = jnp.maximum(mu_out, 1e-10)
+    v_in = mu_in + dispersion * mu_in * mu_in
+    v_out = mu_out + dispersion * mu_out * mu_out
+    return jnp.maximum(mu_out + (x - mu_in) * jnp.sqrt(v_out / v_in), 0.0)
 
 
 def q2q_nbinom(
@@ -298,6 +323,42 @@ def tagwise_dispersion(
     return common_dispersion[..., None] * jnp.exp2(expo)
 
 
+def _normal_tails(s1r, s, alpha, beta):
+    """Moment-matched Beta-Binomial normal tails with continuity correction
+    (the large-total branch of the exact test)."""
+    ab = alpha + beta
+    m = s * alpha / ab
+    var = s * alpha * beta * (ab + s) / (ab * ab * (ab + 1.0))
+    sd = jnp.sqrt(jnp.maximum(var, 1e-30))
+    log_pl = jax.scipy.stats.norm.logcdf((s1r + 0.5 - m) / sd)
+    log_pu = jax.scipy.stats.norm.logcdf(-(s1r - 0.5 - m) / sd)
+    return log_pl, log_pu
+
+
+@jax.jit
+def nb_exact_test_logp_normal(
+    s1: jnp.ndarray,
+    s2: jnp.ndarray,
+    n1: jnp.ndarray,
+    n2: jnp.ndarray,
+    dispersion: jnp.ndarray,
+) -> jnp.ndarray:
+    """Two-sided log p via the normal branch only — for (pair, gene) entries
+    whose totals exceed the exact-tail budget (callers route small totals to
+    ``nb_exact_test_logp``; same doubling/guard semantics)."""
+    s1r = jnp.round(s1)
+    s2r = jnp.round(s2)
+    s = s1r + s2r
+    phi = jnp.maximum(dispersion, 1e-10)
+    log_pl, log_pu = _normal_tails(
+        s1r, s, n1.astype(jnp.float32) / phi, n2.astype(jnp.float32) / phi
+    )
+    log_p = jnp.minimum(jnp.log(2.0) + jnp.minimum(log_pl, log_pu), 0.0)
+    log_p = jnp.where(s <= 0, 0.0, log_p)
+    bad = (n1 < 1) | (n2 < 1)
+    return jnp.where(bad, jnp.nan, log_p)
+
+
 @partial(jax.jit, static_argnames=("s_max",))
 def nb_exact_test_logp(
     s1: jnp.ndarray,
@@ -348,12 +409,7 @@ def nb_exact_test_logp(
     log_pu_exact = jsp.logsumexp(jnp.where(upper, u, -jnp.inf), axis=-1) - log_z
 
     # --- normal branch (s >= s_max) ---
-    ab = alpha + beta
-    m = s * alpha / ab
-    var = s * alpha * beta * (ab + s) / (ab * ab * (ab + 1.0))
-    sd = jnp.sqrt(jnp.maximum(var, 1e-30))
-    log_pl_norm = jax.scipy.stats.norm.logcdf((s1r + 0.5 - m) / sd)
-    log_pu_norm = jax.scipy.stats.norm.logcdf(-(s1r - 0.5 - m) / sd)
+    log_pl_norm, log_pu_norm = _normal_tails(s1r, s, alpha, beta)
 
     small = s < float(s_max)
     log_pl = jnp.where(small, log_pl_exact, log_pl_norm)

@@ -21,28 +21,22 @@ import jax
 import jax.numpy as jnp
 import jax.scipy.special as jsp
 
-__all__ = ["bimod_lrt_tile", "welch_t_tile", "auc_from_u"]
+__all__ = [
+    "bimod_lrt_tile", "welch_t_tile",
+    "bimod_lrt_pairs", "welch_t_pairs", "auc_from_u",
+]
 
 _PI_CLIP_LO = 1e-5  # Seurat's MinMax(…, 1e-5, 1-1e-5) on the positive fraction
 
 
-def _zero_inflated_loglik(vals, mask, xmin: float):
-    """Seurat bimodLikData: log-likelihood of a zero-inflated normal fit.
-
-    vals/mask: (..., W). Positives are entries > xmin among masked cells.
-    sd uses the n−1 denominator (R ``sd``), and falls back to 1 when fewer
-    than 2 positive cells exist.
-    """
-    pos = mask & (vals > xmin)
-    n = jnp.sum(mask, axis=-1).astype(jnp.float32)
-    n_pos = jnp.sum(pos, axis=-1).astype(jnp.float32)
+def _zinorm_loglik_stats(n, n_pos, s, ss):
+    """Seurat bimodLikData from sufficient statistics: n masked cells, n_pos
+    positives, s = Σ positives, ss = Σ positives². sd uses the n−1
+    denominator (R ``sd``) and falls back to 1 below 2 positive cells."""
     n_zero = n - n_pos
     frac = jnp.clip(
         n_pos / jnp.maximum(n, 1.0), _PI_CLIP_LO, 1.0 - _PI_CLIP_LO
     )
-    vp = jnp.where(pos, vals, 0.0)
-    s = jnp.sum(vp, axis=-1)
-    ss = jnp.sum(vp * vp, axis=-1)
     mean = s / jnp.maximum(n_pos, 1.0)
     var = (ss - n_pos * mean * mean) / jnp.maximum(n_pos - 1.0, 1.0)
     sd = jnp.where(n_pos < 2.0, 1.0, jnp.sqrt(jnp.maximum(var, 1e-30)))
@@ -56,6 +50,18 @@ def _zero_inflated_loglik(vals, mask, xmin: float):
     )
     lik_zero = n_zero * jnp.log1p(-frac)
     return lik_zero + lik_pos
+
+
+def _zero_inflated_loglik(vals, mask, xmin: float):
+    """Per-cell-tile form of ``_zinorm_loglik_stats`` (vals/mask (..., W);
+    positives are entries > xmin among masked cells)."""
+    pos = mask & (vals > xmin)
+    n = jnp.sum(mask, axis=-1).astype(jnp.float32)
+    n_pos = jnp.sum(pos, axis=-1).astype(jnp.float32)
+    vp = jnp.where(pos, vals, 0.0)
+    s = jnp.sum(vp, axis=-1)
+    ss = jnp.sum(vp * vp, axis=-1)
+    return _zinorm_loglik_stats(n, n_pos, s, ss)
 
 
 def bimod_lrt_tile(
@@ -117,6 +123,64 @@ def welch_t_tile(
         1e-30,
     )
     # two-sided p = I_{df/(df+t²)}(df/2, 1/2)
+    x = df / (df + t * t)
+    log_p = jnp.log(jnp.maximum(jsp.betainc(df / 2.0, 0.5, x), 1e-38))
+    bad = (n1 < 2) | (n2 < 2) | (se <= 0.0)
+    return jnp.where(bad, jnp.nan, log_p)
+
+
+@jax.jit
+def bimod_lrt_pairs(agg, pair_i: jnp.ndarray, pair_j: jnp.ndarray) -> jnp.ndarray:
+    """All-pairs bimod LRT straight from per-cluster aggregates.
+
+    The zero-inflated-normal fit needs only {n, n_pos, Σx, Σx²} per group,
+    and the pooled group's statistics are the sums of the two clusters' —
+    so every pair's test is a gather over the (G, K) aggregate tensors
+    (xmin = 0 semantics: positives are x > 0, hence n_pos = nnz and the
+    positive sums equal the full sums for non-negative log data).
+    Returns (P, G) log p-values.
+    """
+    def stats(k):  # -> each (P, G)
+        return (
+            agg.counts[k][:, None],
+            agg.nnz[:, k].T,
+            agg.sum_log[:, k].T,
+            agg.sum_sq[:, k].T,
+        )
+
+    n1, p1, s1, ss1 = stats(pair_i)
+    n2, p2, s2, ss2 = stats(pair_j)
+    ll1 = _zinorm_loglik_stats(n1, p1, s1, ss1)
+    ll2 = _zinorm_loglik_stats(n2, p2, s2, ss2)
+    ll_pooled = _zinorm_loglik_stats(n1 + n2, p1 + p2, s1 + s2, ss1 + ss2)
+    lrt = jnp.maximum(2.0 * (ll1 + ll2 - ll_pooled), 0.0)
+    log_p = jnp.log(jnp.maximum(jsp.gammaincc(1.5, lrt / 2.0), 1e-38))
+    return jnp.where((n1 < 1) | (n2 < 1), jnp.nan, log_p)
+
+
+@jax.jit
+def welch_t_pairs(agg, pair_i: jnp.ndarray, pair_j: jnp.ndarray) -> jnp.ndarray:
+    """All-pairs two-sided Welch t from per-cluster aggregates (mean and
+    variance per group from {n, Σx, Σx²}). Returns (P, G) log p-values."""
+    def moments(k):
+        n = agg.counts[k][:, None]                       # (P, 1)
+        s = agg.sum_log[:, k].T                          # (P, G)
+        ss = agg.sum_sq[:, k].T
+        mean = s / jnp.maximum(n, 1.0)
+        var = (ss - n * mean * mean) / jnp.maximum(n - 1.0, 1.0)
+        return n, mean, jnp.maximum(var, 0.0)
+
+    n1, mu1, v1 = moments(pair_i)
+    n2, mu2, v2 = moments(pair_j)
+    se1 = v1 / jnp.maximum(n1, 1.0)
+    se2 = v2 / jnp.maximum(n2, 1.0)
+    se = se1 + se2
+    t = (mu1 - mu2) / jnp.sqrt(jnp.maximum(se, 1e-30))
+    df = se * se / jnp.maximum(
+        se1 * se1 / jnp.maximum(n1 - 1.0, 1.0)
+        + se2 * se2 / jnp.maximum(n2 - 1.0, 1.0),
+        1e-30,
+    )
     x = df / (df + t * t)
     log_p = jnp.log(jnp.maximum(jsp.betainc(df / 2.0, 0.5, x), 1e-38))
     bad = (n1 < 2) | (n2 < 2) | (se <= 0.0)

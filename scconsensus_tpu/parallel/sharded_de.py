@@ -30,18 +30,31 @@ from scconsensus_tpu.parallel.mesh import (
     require_dense,
 )
 
-__all__ = ["sharded_aggregates", "sharded_wilcox_logp"]
+__all__ = [
+    "sharded_aggregates", "sharded_wilcox_logp", "sharded_allpairs_ranksum",
+]
 
 
 def _agg_local(data_loc, onehot_loc, axis_name: str):
-    """data_loc (G, Nl), onehot_loc (Nl, K): partial reductions + psum."""
+    """data_loc (G, Nl), onehot_loc (Nl, K): partial reductions + psum.
+    HIGHEST precision — the sums feed variance cancellations downstream."""
+    hi = jax.lax.Precision.HIGHEST
     counts = jax.lax.psum(jnp.sum(onehot_loc, axis=0), axis_name)
-    sum_log = jax.lax.psum(data_loc @ onehot_loc, axis_name)
-    sum_expm1 = jax.lax.psum(jnp.expm1(data_loc) @ onehot_loc, axis_name)
-    nnz = jax.lax.psum(
-        (data_loc > 0).astype(data_loc.dtype) @ onehot_loc, axis_name
+    sum_log = jax.lax.psum(
+        jnp.dot(data_loc, onehot_loc, precision=hi), axis_name
     )
-    return sum_log, sum_expm1, nnz, counts
+    sum_expm1 = jax.lax.psum(
+        jnp.dot(jnp.expm1(data_loc), onehot_loc, precision=hi), axis_name
+    )
+    sum_sq = jax.lax.psum(
+        jnp.dot(data_loc * data_loc, onehot_loc, precision=hi), axis_name
+    )
+    nnz = jax.lax.psum(
+        jnp.dot((data_loc > 0).astype(data_loc.dtype), onehot_loc,
+                precision=hi),
+        axis_name,
+    )
+    return sum_log, sum_expm1, sum_sq, nnz, counts
 
 
 def sharded_aggregates(
@@ -60,10 +73,9 @@ def sharded_aggregates(
     n_shards = mesh.devices.size
     dp, _ = pad_axis_to_multiple(np.asarray(data, np.float32), 1, n_shards)
     op, _ = pad_axis_to_multiple(np.asarray(onehot, np.float32), 0, n_shards)
-    sum_log, sum_expm1, nnz, counts = _jitted_aggregates(mesh, axis_name)(
-        jnp.asarray(dp), jnp.asarray(op)
+    return ClusterAggregates(
+        *_jitted_aggregates(mesh, axis_name)(jnp.asarray(dp), jnp.asarray(op))
     )
-    return ClusterAggregates(sum_log, sum_expm1, nnz, counts)
 
 
 @lru_cache(maxsize=32)
@@ -74,7 +86,7 @@ def _jitted_aggregates(mesh: Mesh, axis_name: str):
             partial(_agg_local, axis_name=axis_name),
             mesh=mesh,
             in_specs=(P(None, axis_name), P(axis_name)),
-            out_specs=(P(None), P(None), P(None), P(None)),
+            out_specs=(P(None),) * 5,
         )
     )
 
@@ -84,6 +96,52 @@ def _wilcox_local(chunk_loc, idx, m1, m2, n1, n2):
     tensors replicated. Pure local compute — genes never talk to each other."""
     log_p, _u, _ties = wilcoxon_pairs_tile(chunk_loc, idx, m1, m2, n1, n2)
     return log_p  # (B, Gl)
+
+
+def sharded_allpairs_ranksum(
+    chunk: jnp.ndarray,
+    cid: jnp.ndarray,
+    n_of: jnp.ndarray,
+    pair_i: jnp.ndarray,
+    pair_j: jnp.ndarray,
+    n_clusters: int,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = CELL_AXIS,
+):
+    """Gene-sharded all-pairs rank-sum (ops.ranksum_allpairs.ranksum_body
+    shard_mapped over the gene-chunk axis; cid/pair tensors replicated).
+
+    chunk: (Gc, N); returns (log_p, u, tie_sum), each (Gc, P) — identical to
+    the single-device ``allpairs_ranksum_chunk``. The gene axis is padded to
+    the shard count; padded all-zero rows produce NaN and are sliced off.
+    """
+    mesh = mesh or make_mesh(axis_name=axis_name)
+    n_shards = int(mesh.devices.size)
+    gc = chunk.shape[0]
+    pad = (-gc) % n_shards
+    if pad:
+        chunk = jnp.pad(chunk, ((0, pad), (0, 0)))
+    lp, u, ts = _jitted_allpairs(mesh, axis_name, n_clusters)(
+        chunk, cid, n_of, pair_i, pair_j
+    )
+    return lp[:gc], u[:gc], ts[:gc]
+
+
+@lru_cache(maxsize=32)
+def _jitted_allpairs(mesh: Mesh, axis_name: str, n_clusters: int):
+    from scconsensus_tpu.ops.ranksum_allpairs import ranksum_body
+
+    def local(chunk_loc, cid, n_of, pair_i, pair_j):
+        return ranksum_body(chunk_loc, cid, n_of, pair_i, pair_j, n_clusters)
+
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis_name, None), P(None), P(None), P(None), P(None)),
+            out_specs=(P(axis_name, None),) * 3,
+        )
+    )
 
 
 def sharded_wilcox_logp(

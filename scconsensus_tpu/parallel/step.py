@@ -120,7 +120,7 @@ def distributed_refine_step(
         partial(_agg_local, axis_name=axis_name),
         mesh=mesh,
         in_specs=(P(None, axis_name), P(axis_name)),
-        out_specs=(P(None), P(None), P(None), P(None)),
+        out_specs=(P(None),) * 5,
     )
     wilcox_fn = jax.shard_map(
         _wilcox_local,

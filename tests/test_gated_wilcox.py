@@ -1,57 +1,89 @@
-"""Gate-filtered rank-sum path: identical statistics on tested entries,
-NaN elsewhere, same DE calls as the full-tile path."""
+"""All-pairs sorted-cumsum rank-sum engine: identical statistics to the
+per-pair midrank formulation, R exact-branch parity on small clusters."""
 
 import numpy as np
 
-from scconsensus_tpu.de.engine import (
-    _run_wilcox,
-    _run_wilcox_gated,
-    filter_clusters,
-)
+from scconsensus_tpu.de.engine import _run_wilcox, filter_clusters
+from scconsensus_tpu.ops.ranks import rank_sum_groups
+from scconsensus_tpu.ops.wilcoxon import wilcoxon_from_ranks
 from scconsensus_tpu.utils.synthetic import synthetic_scrna
 
 
-def test_gated_matches_full_on_tested(rng):
-    data, labels, _ = synthetic_scrna(n_genes=150, n_cells=200, n_clusters=3, seed=13)
+def _groups(data, labels, min_size):
     lab = np.array([f"c{v}" for v in labels])
-    names, cell_idx = filter_clusters(lab, 10)
+    names, cell_idx = filter_clusters(lab, min_size)
     cell_idx_of = [
         np.nonzero(cell_idx == k)[0].astype(np.int32) for k in range(len(names))
     ]
+    return names, cell_idx_of
+
+
+def test_allpairs_matches_per_pair_midranks():
+    import jax.numpy as jnp
+
+    data, labels, _ = synthetic_scrna(n_genes=150, n_cells=200, n_clusters=3, seed=13)
+    data = data.astype(np.float32)
+    names, cell_idx_of = _groups(data, labels, 10)
     pi, pj = np.triu_indices(len(names), k=1)
     pi, pj = pi.astype(np.int32), pj.astype(np.int32)
-    tested = rng.random((pi.size, 150)) < 0.3
 
-    full_lp, full_u = _run_wilcox(data.astype(np.float32), cell_idx_of, pi, pj)
-    gated_lp, gated_u = _run_wilcox_gated(
-        data.astype(np.float32), cell_idx_of, pi, pj, tested
-    )
-    np.testing.assert_allclose(
-        gated_lp[tested], full_lp[tested], rtol=1e-5, atol=1e-5
-    )
-    np.testing.assert_allclose(
-        gated_u[tested], full_u[tested], rtol=1e-5, atol=1e-5
-    )
-    assert np.isnan(gated_lp[~tested]).all()
+    lp, u = _run_wilcox(data, cell_idx_of, pi, pj, exact="never")
+
+    # Per-pair reference: pooled midranks per gene, one pair at a time.
+    for p in range(pi.size):
+        ci, cj = cell_idx_of[pi[p]], cell_idx_of[pj[p]]
+        pooled = np.concatenate([ci, cj])
+        vals = jnp.asarray(data[:, pooled])
+        m1 = jnp.asarray(np.arange(pooled.size) < ci.size)
+        m2 = ~m1
+        rs1, ties = rank_sum_groups(vals, m1, m2)
+        ref_lp, ref_u = wilcoxon_from_ranks(
+            rs1, ties, jnp.float32(ci.size), jnp.float32(cj.size)
+        )
+        np.testing.assert_allclose(u[p], np.asarray(ref_u), rtol=1e-5, atol=1e-3)
+        np.testing.assert_allclose(
+            lp[p], np.asarray(ref_lp), rtol=1e-4, atol=1e-4
+        )
 
 
-def test_gated_exact_branch_small_clusters(rng):
-    # clusters below the exact-N limit exercise the host exact path per task
-    data, labels, _ = synthetic_scrna(n_genes=100, n_cells=80, n_clusters=2, seed=3)
-    lab = np.array([f"c{v}" for v in labels])
-    names, cell_idx = filter_clusters(lab, 5)
+def test_allpairs_exact_branch_small_clusters():
+    from scipy.stats import mannwhitneyu
+
+    # Continuous data (no ties) + clusters below the exact-N limit: R takes
+    # the exact branch; scipy's method="exact" is the same distribution.
+    rng = np.random.default_rng(3)
+    n1, n2 = 18, 25
+    data = rng.normal(size=(40, n1 + n2)).astype(np.float32)
     cell_idx_of = [
-        np.nonzero(cell_idx == k)[0].astype(np.int32) for k in range(len(names))
+        np.arange(n1, dtype=np.int32),
+        np.arange(n1, n1 + n2, dtype=np.int32),
     ]
     pi = np.array([0], np.int32)
     pj = np.array([1], np.int32)
-    tested = np.ones((1, 100), bool)
-    full_lp, _ = _run_wilcox(data.astype(np.float32), cell_idx_of, pi, pj)
-    gated_lp, _ = _run_wilcox_gated(
-        data.astype(np.float32), cell_idx_of, pi, pj, tested
+    lp, u = _run_wilcox(data, cell_idx_of, pi, pj, exact="auto")
+    for g in range(40):
+        ref = mannwhitneyu(
+            data[g, :n1], data[g, n1:], alternative="two-sided", method="exact"
+        )
+        np.testing.assert_allclose(np.exp(lp[0, g]), ref.pvalue, rtol=1e-5)
+        np.testing.assert_allclose(u[0, g], ref.statistic, rtol=1e-6)
+
+
+def test_allpairs_excluded_cells_ignored():
+    # Cells of dropped clusters must not perturb any pair's statistics.
+    data, labels, _ = synthetic_scrna(
+        n_genes=150, n_cells=150, n_clusters=3, n_markers_per_cluster=20, seed=5
     )
-    np.testing.assert_allclose(gated_lp[0], full_lp[0], rtol=1e-5, atol=1e-5)
+    data = data.astype(np.float32)
+    names, cell_idx_of = _groups(data, labels, 5)
+    pi, pj = np.triu_indices(len(names), k=1)
+    pi, pj = pi.astype(np.int32), pj.astype(np.int32)
+    lp_all, _ = _run_wilcox(data, cell_idx_of, pi, pj)
 
-
-# Dense(gated) vs sparse(full-tile) engine equivalence is covered by
-# tests/test_io.py::test_engine_sparse_equals_dense (log_p/log_q/de_mask).
+    # Restrict the matrix to the kept cells only: same answers.
+    kept = np.concatenate(cell_idx_of)
+    remap = -np.ones(data.shape[1], np.int64)
+    remap[kept] = np.arange(kept.size)
+    cell_idx_sub = [remap[ci].astype(np.int32) for ci in cell_idx_of]
+    lp_sub, _ = _run_wilcox(data[:, kept], cell_idx_sub, pi, pj)
+    np.testing.assert_allclose(lp_all, lp_sub, rtol=1e-5, atol=1e-5)

@@ -91,8 +91,9 @@ def test_ring_knn_matches_bruteforce(rng, mesh):
 
 
 def test_sharded_wilcox_matches_serial(rng, mesh):
-    from scconsensus_tpu.de.engine import _wilcox_chunk
+    from scconsensus_tpu.ops.wilcoxon import wilcoxon_pairs_tile
 
+    _wilcox_chunk = jax.jit(wilcoxon_pairs_tile)
     data, labels, _ = _synthetic(rng, n=64, g=24, k=2)
     ci = np.nonzero(labels == 0)[0].astype(np.int32)
     cj = np.nonzero(labels == 1)[0].astype(np.int32)
@@ -109,6 +110,24 @@ def test_sharded_wilcox_matches_serial(rng, mesh):
     )
     got = sharded_wilcox_logp(data, idx, m1, m2, n1, n2, mesh)
     np.testing.assert_allclose(got[0], np.asarray(ref)[0], rtol=1e-4, atol=1e-4)
+
+
+def test_sharded_allpairs_ranksum_matches_serial(rng, mesh):
+    from scconsensus_tpu.ops.ranksum_allpairs import allpairs_ranksum_chunk
+    from scconsensus_tpu.parallel.sharded_de import sharded_allpairs_ranksum
+
+    k = 4
+    data, labels, _ = _synthetic(rng, n=90, g=26, k=k)  # g % 8 != 0: pad path
+    cid = labels.astype(np.int32)
+    n_of = np.array([(cid == c).sum() for c in range(k)], np.int32)
+    pi, pj = np.triu_indices(k, k=1)
+    args = (jnp.asarray(cid), jnp.asarray(n_of),
+            jnp.asarray(pi.astype(np.int32)), jnp.asarray(pj.astype(np.int32)))
+    ref = allpairs_ranksum_chunk(jnp.asarray(data), *args, k)
+    got = sharded_allpairs_ranksum(jnp.asarray(data), *args, k, mesh=mesh)
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=1e-5,
+                                   atol=1e-5)
 
 
 def test_distributed_refine_step_runs(mesh):
