@@ -239,8 +239,10 @@ def _time_reps(fn, args, min_reps=3) -> float:
 
 
 def mfu_probes(platform: str) -> dict:
-    """Achieved-FLOPs probes for the two hot DE kernels (VERDICT r1 #1):
-    the rank-sum tile and the NB pass-2 (conditional-LL grid) kernel, at
+    """Achieved-FLOPs probes for the two hot DE kernels (VERDICT r1 #1,
+    retargeted to the round-3 engines): the all-pairs sorted-cumsum rank-sum
+    chunk and the NB node-table contraction (the rewritten edgeR engine's
+    pass-2 equivalent — it prices every tagwise/common grid evaluation), at
     flagship-representative shapes. FLOPs are XLA cost-analysis estimates;
     MFU is quoted against the 197 TFLOP/s bf16 peak (conservative: the
     kernels run f32)."""
@@ -248,30 +250,29 @@ def mfu_probes(platform: str) -> dict:
     import jax.numpy as jnp
     import numpy as np
 
-    from scconsensus_tpu.de.edger import _pass2_kernel
-    from scconsensus_tpu.ops.wilcoxon import wilcoxon_pairs_tile
+    from scconsensus_tpu.de.edger import _table_chunk, _NODE_COUNT
+    from scconsensus_tpu.ops.ranksum_allpairs import allpairs_ranksum_chunk
 
     rng = np.random.default_rng(0)
     out = {}
 
-    # rank-sum tile: B pairs × Gc genes × W pooled cells
-    B, Gc, W, N = 8, 512, 2048, 8192
-    data = jnp.asarray(rng.gamma(2.0, size=(Gc, N)).astype(np.float32))
-    idx = jnp.asarray(rng.integers(0, N, (B, W)).astype(np.int32))
-    half = W // 2
-    m1 = jnp.asarray(np.tile(np.arange(W) < half, (B, 1)))
-    m2 = jnp.asarray(np.tile(np.arange(W) >= half, (B, 1)))
-    n1 = jnp.full((B,), half, jnp.int32)
-    n2 = jnp.full((B,), W - half, jnp.int32)
-    f = jax.jit(wilcoxon_pairs_tile)
-    args = (data, idx, m1, m2, n1, n2)
+    # all-pairs rank-sum chunk: Gc genes × N cells × K clusters, all pairs
+    Gc, N, K = 256, 26000, 44
+    P = K * (K - 1) // 2
+    chunk = jnp.asarray(rng.gamma(2.0, size=(Gc, N)).astype(np.float32))
+    cid = jnp.asarray(rng.integers(0, K, N).astype(np.int32))
+    n_of = jnp.asarray(np.bincount(np.asarray(cid), minlength=K).astype(np.int32))
+    pi, pj = np.triu_indices(K, k=1)
+    args = (chunk, cid, n_of, jnp.asarray(pi.astype(np.int32)),
+            jnp.asarray(pj.astype(np.int32)))
     try:
-        compiled = f.lower(*args).compile()
+        compiled = allpairs_ranksum_chunk.lower(*args, n_clusters=K).compile()
         flops = _cost_flops(compiled)
+        f = lambda *a: allpairs_ranksum_chunk(*a, n_clusters=K)
         sec = _time_reps(f, args)
         out["ranksum"] = {
-            "tile": [B, Gc, W],
-            "tasks_per_s": round(B * Gc / sec),
+            "chunk": [Gc, N, K],
+            "gene_pairs_per_s": round(Gc * P / sec),
             "achieved_tflops": round(flops / sec / 1e12, 3),
         }
         if platform == "tpu":
@@ -281,18 +282,24 @@ def mfu_probes(platform: str) -> dict:
     except Exception as e:  # pragma: no cover - probe must never kill bench
         out["ranksum"] = {"error": repr(e)[:200]}
 
-    # NB pass-2 kernel: the edgeR-equivalent hot loop
+    # NB node-table contraction: the edgeR-equivalent grid hot loop
     try:
-        lib_tile = jnp.sum(data, axis=0)[idx]
-        common_lib = jnp.mean(lib_tile, axis=1)
-        common_disp = jnp.full((B,), 0.1, jnp.float32)
-        nb_args = (data, idx, m1, m2, lib_tile, common_lib, common_disp)
-        compiled = _pass2_kernel.lower(*nb_args).compile()
+        Gt, Ns = 1024, K * 64
+        psub = jnp.asarray(rng.gamma(2.0, size=(Gt, Ns)).astype(np.float32))
+        sub_onehot = jnp.asarray(
+            np.eye(K, dtype=np.float32)[rng.integers(0, K, Ns)]
+        )
+        r_nodes = jnp.asarray(
+            np.exp(np.linspace(-4.0, 9.0, _NODE_COUNT)).astype(np.float32)
+        )
+        nb_args = (psub, sub_onehot, r_nodes)
+        compiled = _table_chunk.lower(*nb_args).compile()
         flops = _cost_flops(compiled)
-        sec = _time_reps(_pass2_kernel, nb_args)
+        sec = _time_reps(_table_chunk, nb_args)
         out["nb_pass2"] = {
-            "tile": [B, Gc, W],
-            "gene_pairs_per_s": round(B * Gc / sec),
+            "kernel": "node_table_contraction",
+            "chunk": [Gt, Ns, _NODE_COUNT],
+            "gene_grid_evals_per_s": round(Gt * _NODE_COUNT / sec),
             "achieved_tflops": round(flops / sec / 1e12, 3),
         }
         if platform == "tpu":

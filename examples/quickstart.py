@@ -1,0 +1,153 @@
+"""Worked scConsensus session — the TPU-native mirror of the reference's
+README workflow (reference README.md:38-162), runnable end to end on CPU or
+a TPU chip with no external data (synthetic 26k-PBMC-shaped input stands in
+for the Zenodo dataset; no network egress in this environment).
+
+Steps, in the reference's order:
+  1. load a (genes × cells) log-normalized matrix + two labelings
+     (supervised "celltype" names × unsupervised cluster ids — the
+     Seurat × RCA pair of the reference),
+  2. gene filter  rowSums(data > 0) > threshold      (README.md:116),
+  3. plot_contingency_table → automated consensus    (README.md:85),
+  4. MANUAL consensus override — the user-in-the-loop relabeling step the
+     reference performs between consensus and refinement (README.md:91-101),
+  5. recluster_de_consensus(method="edgeR", ...)     (README.md:118) — the
+     flagship slow path — and the fast Wilcoxon path,
+  6. per-deepSplit colors → cell-type annotation     (README.md:127-138),
+  7. both plots (contingency heatmap + DE heatmap PDFs),
+  8. resume: re-running refine() with an artifact_dir skips completed
+     stages (the capability the reference's write-only saveRDS dumps never
+     had, SURVEY.md §5.4).
+
+Run:  python examples/quickstart.py [--cells 2000] [--genes 800] [--outdir .]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import sys
+import tempfile
+
+import numpy as np
+
+if os.environ.get("JAX_PLATFORMS"):
+    # Honor JAX_PLATFORMS even where a site plugin force-registers an
+    # accelerator backend (the env var alone loses that race; the config
+    # update must land before the first backend init).
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+try:
+    import scconsensus_tpu as scc
+except ModuleNotFoundError:  # running from a checkout without installation
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    import scconsensus_tpu as scc
+from scconsensus_tpu.utils.synthetic import noisy_labeling, synthetic_scrna
+
+
+def main(n_cells: int = 2000, n_genes: int = 800, outdir: str = ".") -> dict:
+    out = pathlib.Path(outdir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    # -- 1. inputs: matrix + two labelings ------------------------------
+    data, truth, _ = synthetic_scrna(
+        n_genes=n_genes, n_cells=n_cells, n_clusters=6,
+        n_markers_per_cluster=min(40, n_genes // 8), seed=7,
+    )
+    gene_names = np.array([f"gene{i}" for i in range(data.shape[0])])
+    celltypes = ["T_Naive", "T_Cytotoxic", "B_Cells", "NK_Cells",
+                 "Monocytes", "pDC"]
+    supervised = np.array([celltypes[v] for v in noisy_labeling(
+        truth, 0.05, seed=1, prefix=""
+    ).astype(int)])
+    unsupervised = noisy_labeling(truth, 0.10, seed=2, prefix="uns")
+
+    # -- 2. gene filter: rowSums(data > 0) > threshold ------------------
+    keep = (data > 0).sum(axis=1) > max(10, n_cells // 250)
+    data, gene_names = data[keep], gene_names[keep]
+    print(f"[quickstart] gene filter kept {keep.sum()}/{keep.size} genes")
+
+    # -- 3. contingency table + automated consensus ---------------------
+    consensus = scc.plot_contingency_table(
+        supervised, unsupervised,
+        filename=str(out / "Contingency_Table.pdf"),
+    )
+    print(f"[quickstart] consensus labels: {len(set(consensus))} clusters")
+
+    # -- 4. manual consensus override (user-in-the-loop) ----------------
+    # The reference hand-merges labels after inspecting the table
+    # (README.md:91-101). Consensus labels are a plain vector — override
+    # them with ordinary numpy indexing:
+    consensus = np.asarray(consensus, dtype=object)
+    rare = [lab for lab in set(consensus)
+            if (consensus == lab).sum() < max(20, n_cells // 100)]
+    for lab in rare:
+        base = str(lab).split("_")[0]
+        consensus[consensus == lab] = base
+    consensus = consensus.astype(str)
+    print(f"[quickstart] after manual override: {len(set(consensus))} clusters")
+
+    # -- 5. DE refinement: flagship edgeR slow path + fast Wilcoxon -----
+    de_obj = scc.recluster_de_consensus(
+        data, consensus,
+        method="edgeR", q_val_thrs=0.01, fc_thrs=2.0,
+        mean_scaling_factor=0.5, deep_split_values=(1, 2, 3, 4),
+        min_cluster_size=10, gene_names=gene_names,
+        plot_name=str(out / "Reclustered_DE_edgeR_Heatmap.pdf"),
+    )
+    print(f"[quickstart] edgeR DE union: {de_obj.de_gene_union.size} genes; "
+          f"deep_split_info: {de_obj.deep_split_info}")
+
+    fast_obj = scc.recluster_de_consensus_fast(
+        data, consensus, method="wilcox", q_val_thrs=0.1,
+        deep_split_values=(1, 2), gene_names=gene_names,
+    )
+    print(f"[quickstart] wilcox DE union: {fast_obj.de_gene_union.size} genes")
+
+    # -- 6. annotate refined clusters by color --------------------------
+    # (README.md:127-138: map per-deepSplit colors to cell-type names)
+    colors = de_obj.dynamic_colors["deepsplit: 3"]
+    annotation = {}
+    for color in dict.fromkeys(colors):        # stable order
+        members = colors == color
+        if color == "grey":
+            annotation[color] = "Unknown"
+            continue
+        vals, counts = np.unique(consensus[members], return_counts=True)
+        annotation[color] = str(vals[np.argmax(counts)])
+    de_celltypes = np.array([annotation[c] for c in colors])
+    print(f"[quickstart] annotated {len(annotation)} refined clusters: "
+          f"{sorted(set(de_celltypes))}")
+
+    # -- 8. resume from the artifact store ------------------------------
+    with tempfile.TemporaryDirectory() as tmp:
+        kw = dict(
+            method="wilcox", q_val_thrs=0.1, deep_split_values=(1, 2),
+            artifact_dir=tmp,
+        )
+        scc.recluster_de_consensus_fast(data, consensus, **kw)
+        resumed = scc.recluster_de_consensus_fast(data, consensus, **kw)
+        stages = [s["stage"] for s in resumed.metrics.get("stages", [])]
+        assert "wilcox_test" not in stages, "resume should skip the DE stage"
+        print("[quickstart] resume: DE stage skipped via artifact store")
+
+    return {
+        "consensus_k": len(set(consensus)),
+        "edger_union": int(de_obj.de_gene_union.size),
+        "wilcox_union": int(fast_obj.de_gene_union.size),
+        "annotation": annotation,
+        "outputs": sorted(p.name for p in out.glob("*.pdf")),
+    }
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cells", type=int, default=2000)
+    ap.add_argument("--genes", type=int, default=800)
+    ap.add_argument("--outdir", default=".")
+    args = ap.parse_args()
+    summary = main(args.cells, args.genes, args.outdir)
+    print(f"[quickstart] done: {summary}")

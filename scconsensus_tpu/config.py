@@ -81,8 +81,10 @@ class ReclusterConfig:
     pam_stage: bool = False
 
     # --- scale-out ---
-    approx_threshold: int = 100_000  # above this many cells, use centroid pre-pooling
+    approx_threshold: int = 100_000  # above this many cells, approximate linkage
+    approx_method: str = "pool"  # pool (centroid pre-pooling) | knn (ring-kNN graph Ward)
     n_pool_centroids: int = 4096
+    knn_graph_k: int = 15  # neighbors per cell for approx_method="knn"
 
     # --- misc ---
     compat: CompatFlags = dataclasses.field(default_factory=CompatFlags)

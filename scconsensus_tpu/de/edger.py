@@ -252,6 +252,8 @@ def run_edger_pairs(
         counts = as_csr(counts)
     else:
         counts = np.ascontiguousarray(counts, np.float32)
+    # Dense input crosses host→device exactly once; both chunk loops reuse it.
+    jcounts = None if sparse else jnp.asarray(counts)
 
     # ---- host geometry -------------------------------------------------
     cid = _cid_from_groups(cell_idx_of, N)
@@ -306,7 +308,7 @@ def run_edger_pairs(
     # ---- pass A: raw cluster sums, rates -------------------------------
     Zy_parts = [
         (g0, g1, _raw_sums_chunk(chunk, j_onehot))
-        for g0, g1, chunk in _gene_chunks(counts, gc)
+        for g0, g1, chunk in _gene_chunks(counts, gc, jdata=jcounts)
     ]
     Zy = np.zeros((G, K), np.float32)
     for g0, g1, part in Zy_parts:
@@ -386,7 +388,7 @@ def run_edger_pairs(
     phi_req = float(np.median(common))
     table1, zs1 = _build_table(phi_req)
     Z1 = np.zeros((G, K), np.float32)
-    for g0, g1, chunk in _gene_chunks(counts, gc):
+    for g0, g1, chunk in _gene_chunks(counts, gc, jdata=jcounts):
         part = _pseudo_sums_chunk(
             chunk, j_onehot, j_lib, j_cid_safe, j_kept,
             jnp.asarray(rates[g0:g1] if g1 - g0 == chunk.shape[0]

@@ -275,7 +275,7 @@ def _run_wilcox_device(
     jpi = jnp.asarray(pair_i)
     jpj = jnp.asarray(pair_j)
     gc = chunk_genes_for_budget(N, K)
-    gc = min(gc, 1 << (int(G) - 1).bit_length())
+    gc = min(gc, _next_pow2(G))
     if mesh is not None:
         from scconsensus_tpu.parallel.sharded_de import sharded_allpairs_ranksum
 
@@ -455,12 +455,13 @@ def pairwise_de(
             "wilcox_test" if method in ("wilcox", "wilcoxon") else f"{method}_test"
         )
 
-        # The statistical tests run on the post-subsampling groups
+        # The moment tests run on the post-subsampling groups
         # (max_cells_per_ident, reference R/reclusterDEConsensusFast.R:293-303
         # — applied after the gates, which use the full-cluster aggregates).
-        # Skipped when no cluster actually exceeded the cap (identical agg).
+        # Skipped when no cluster exceeded the cap (identical agg) and for
+        # the rank tests, which consume cell_idx_of directly.
         test_agg = agg
-        if subsampled:
+        if subsampled and method in ("bimod", "t"):
             sub_onehot = np.zeros((N, K), np.float32)
             for k, ci in enumerate(cell_idx_of):
                 sub_onehot[ci, k] = 1.0

@@ -61,6 +61,7 @@ def refine(
     config: ReclusterConfig,
     gene_names: Optional[Sequence[str]] = None,
     timer: Optional[StageTimer] = None,
+    mesh="auto",
 ) -> ReclusterResult:
     """Full DE → embed → recluster refinement.
 
@@ -69,6 +70,12 @@ def refine(
         (the reference's input contract, R/reclusterDEConsensus.R:5).
       labels: per-cell consensus cluster labels (e.g. from
         ``plot_contingency_table``).
+      mesh: "auto" (1-D mesh over all visible devices when >1 — the mesh
+        equivalent of the reference's doParallel fan-out,
+        R/reclusterDEConsensusFast.R:61-65), an explicit
+        ``jax.sharding.Mesh``, or None for the serial single-device path.
+        Mesh runs shard the rank-test gene chunks and the silhouette ring;
+        results are identical to serial (asserted in tests/test_parallel.py).
     """
     from scconsensus_tpu.io.sparsemat import (
         as_csr,
@@ -80,6 +87,15 @@ def refine(
     logger = get_logger()
     timer = timer or StageTimer(logger)
     store = ArtifactStore(config.artifact_dir)
+    if mesh == "auto":
+        from scconsensus_tpu.parallel.mesh import auto_mesh
+
+        mesh = auto_mesh()
+    if mesh is not None:
+        from scconsensus_tpu.io.sparsemat import is_sparse as _isp
+
+        if _isp(data):
+            mesh = None  # sparse input rides the serial chunked engine
     if is_sparse(data):
         data = as_csr(data)
     else:
@@ -104,7 +120,7 @@ def refine(
         except ValueError as e:
             logger.warning("stage de: artifact unusable (%s); recomputing", e)
     if de_res is None:
-        de_res = pairwise_de(data, labels, config, timer=timer)
+        de_res = pairwise_de(data, labels, config, timer=timer, mesh=mesh)
         store.save("de", *de_res.to_store())
 
     with timer.stage("union") as rec:
@@ -149,8 +165,22 @@ def refine(
     with timer.stage("tree", n_cells=N) as rec:
         approx = N > config.approx_threshold
         rec["approx"] = approx
+        if config.approx_method not in ("pool", "knn"):
+            raise ValueError(
+                f"approx_method must be 'pool' or 'knn', got "
+                f"{config.approx_method!r}"
+            )
 
         def _tree():
+            if approx and config.approx_method == "knn":
+                # Leaf-level approximate path: ring-kNN graph (device) +
+                # graph-restricted Ward agglomeration (host). Keeps per-cell
+                # resolution, unlike pooling.
+                from scconsensus_tpu.ops.knn_linkage import knn_ward_linkage
+
+                t = knn_ward_linkage(embedding, k=config.knn_graph_k,
+                                     mesh=mesh)
+                return {"merge": t.merge, "height": t.height, "order": t.order}
             if approx:
                 from scconsensus_tpu.ops.pooling import pooled_ward_linkage
 
@@ -216,7 +246,7 @@ def refine(
                 key = f"deepsplit: {dsv}"
                 lab = dynamic_labels[key]
                 si, _per = mean_cluster_silhouette(
-                    embedding, np.where(lab > 0, lab, -1)
+                    embedding, np.where(lab > 0, lab, -1), mesh=mesh
                 )
                 info["silhouette"] = si
 
@@ -270,6 +300,7 @@ def recluster_de_consensus(
     gene_names: Optional[Sequence[str]] = None,
     plot_name: Optional[str] = None,
     compat: Optional[CompatFlags] = None,
+    mesh="auto",
     **kw,
 ) -> ReclusterResult:
     """Reference-shaped slow path (R/reclusterDEConsensus.R:20-29).
@@ -292,7 +323,8 @@ def recluster_de_consensus(
         compat=compat or CompatFlags(),
         **kw,
     )
-    return refine(data_matrix, consensus_cluster_labels, config, gene_names)
+    return refine(data_matrix, consensus_cluster_labels, config, gene_names,
+                  mesh=mesh)
 
 
 def recluster_de_consensus_fast(
@@ -308,6 +340,7 @@ def recluster_de_consensus_fast(
     gene_names: Optional[Sequence[str]] = None,
     plot_name: Optional[str] = None,
     compat: Optional[CompatFlags] = None,
+    mesh="auto",
     **kw,
 ) -> ReclusterResult:
     """Reference-shaped fast path (R/reclusterDEConsensusFast.R:22-33).
@@ -328,4 +361,5 @@ def recluster_de_consensus_fast(
         compat=compat or CompatFlags(),
         **kw,
     )
-    return refine(data_matrix, consensus_cluster_labels, config, gene_names)
+    return refine(data_matrix, consensus_cluster_labels, config, gene_names,
+                  mesh=mesh)

@@ -119,7 +119,7 @@ def pair_gates_fast(
     return gate, log_fc, pct1, pct2
 
 
-@partial(jax.jit, static_argnames=("mean_exprs_thrs", "mixed_spaces"))
+@partial(jax.jit, static_argnames=("mixed_spaces",))
 def pair_gates_slow(
     agg: ClusterAggregates,
     pair_i: jnp.ndarray,

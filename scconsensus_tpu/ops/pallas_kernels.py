@@ -8,6 +8,13 @@ whole pipeline — norms, cross matmul (MXU), sqrt (VPU), and the ×onehot
 reduction matmul (MXU) — so the distance tile lives only in VMEM and HBM
 traffic drops from O(N²) to O(N·(d+K)) per sweep.
 
+Measured verdict (v5e, 26k×15, K=22, round 2→3): the fused kernel runs at
+0.92× the XLA fallback — XLA's own fusion already keeps the tile pipeline
+HBM-efficient at this shape, and the kernel's fixed 256-tile grid leaves MXU
+idle on the skinny (d=15, K≈22) operands. ``backend="auto"`` therefore
+selects **XLA everywhere**; the Pallas kernel remains an explicit opt-in
+(``backend="pallas"``) for revisiting on fatter feature/cluster axes.
+
 Grid: (N/TM, N/TN); the (TM, K) output block is revisited across the j axis
 and accumulated in place (zeroed at j == 0) — the standard Pallas reduction
 pattern. Feature and cluster axes are zero-padded to the 128-lane tile
@@ -113,20 +120,17 @@ def distance_cluster_sums(
 ) -> np.ndarray:
     """(N, K) Σ distances from every point to every cluster's members.
 
-    backend: 'pallas' (TPU fused kernel), 'pallas_interpret' (CPU-debuggable
-    kernel, slow — tests only), 'xla' (blocked matmul fallback), or 'auto'
-    (pallas on TPU, xla elsewhere).
+    backend: 'pallas' (TPU fused kernel — explicit opt-in; measured 0.92×
+    the fallback at the flagship shape, see module docstring),
+    'pallas_interpret' (CPU-debuggable kernel, slow — tests only), 'xla'
+    (blocked matmul fallback), or 'auto' (xla: the measured winner).
     """
     x = np.ascontiguousarray(x, np.float32)
     onehot = np.ascontiguousarray(onehot, np.float32)
     n, _d = x.shape
     k = onehot.shape[1]
     if backend == "auto":
-        backend = (
-            "pallas"
-            if pallas_available() and jax.devices()[0].platform == "tpu"
-            else "xla"
-        )
+        backend = "xla"
 
     if backend in ("pallas", "pallas_interpret"):
         tile = max(_TM, _TN)

@@ -80,11 +80,20 @@ def silhouette_widths(
 
 
 def mean_cluster_silhouette(
-    x: np.ndarray, labels: np.ndarray, block: int = 4096, backend: str = "auto"
+    x: np.ndarray, labels: np.ndarray, block: int = 4096,
+    backend: str = "auto", mesh=None,
 ) -> Tuple[float, Dict[int, float]]:
     """Mean of per-cluster average widths (reference's reported SI,
-    R/reclusterDEConsensusFast.R:433) plus the per-cluster breakdown."""
-    w = silhouette_widths(x, labels, block, backend=backend)
+    R/reclusterDEConsensusFast.R:433) plus the per-cluster breakdown.
+
+    ``mesh``: optional device mesh — widths come from the ring engine
+    (parallel.ring), each device holding 1/n_shards of the distance work."""
+    if mesh is not None:
+        from scconsensus_tpu.parallel.ring import sharded_silhouette_widths
+
+        w = sharded_silhouette_widths(x, labels, mesh)
+    else:
+        w = silhouette_widths(x, labels, block, backend=backend)
     labels = np.asarray(labels)
     per: Dict[int, float] = {}
     for u in np.unique(labels[labels >= 0]):

@@ -15,9 +15,22 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "pad_axis_to_multiple", "require_dense", "CELL_AXIS"]
+__all__ = [
+    "make_mesh", "auto_mesh", "pad_axis_to_multiple", "require_dense",
+    "CELL_AXIS",
+]
 
 CELL_AXIS = "cells"
+
+
+def auto_mesh(axis_name: str = CELL_AXIS) -> Optional[Mesh]:
+    """The product pipeline's mesh policy: a 1-D mesh over every visible
+    device when there is more than one, else None (serial single-device
+    path). ``refine(mesh="auto")`` resolves through this."""
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None
+    return Mesh(np.asarray(devs), (axis_name,))
 
 
 def make_mesh(

@@ -1,0 +1,29 @@
+"""Mesh-vs-serial equivalence contract, shared by the CI test
+(tests/test_parallel.py) and the driver dry run (__graft_entry__) so the
+two checks cannot drift apart."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["assert_mesh_equals_serial"]
+
+
+def assert_mesh_equals_serial(mesh_res, serial_res) -> None:
+    """Assert a mesh `refine()` result matches the serial run: test
+    statistics to float tolerance, every discrete decision exactly."""
+    np.testing.assert_allclose(
+        mesh_res.de.log_p, serial_res.de.log_p, rtol=1e-4, atol=1e-4
+    )
+    assert np.array_equal(mesh_res.de.de_mask, serial_res.de.de_mask)
+    assert np.array_equal(
+        mesh_res.de_gene_union_idx, serial_res.de_gene_union_idx
+    )
+    for key in mesh_res.dynamic_labels:
+        assert np.array_equal(
+            mesh_res.dynamic_labels[key], serial_res.dynamic_labels[key]
+        )
+    # silhouette rode the ring engine on the mesh run; values must agree
+    for a, b in zip(mesh_res.deep_split_info, serial_res.deep_split_info):
+        if "silhouette" in a:
+            assert abs(a["silhouette"] - b["silhouette"]) < 1e-4

@@ -1,0 +1,73 @@
+"""kNN-graph-restricted Ward linkage (the ring_knn consumer, SURVEY.md §7
+stage 6): agreement with exact Ward where the graph covers the structure,
+completeness of the tree, and the pipeline's approx_method="knn" path."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import adjusted_rand_score
+
+from scconsensus_tpu.ops.knn_linkage import knn_ward_linkage
+from scconsensus_tpu.ops.linkage import cut_tree_k, ward_linkage
+
+
+def _blobs(rng, n_per=60, centers=((0, 0), (12, 0), (0, 12)), scale=1.0):
+    pts = np.concatenate([
+        rng.normal(loc=c, scale=scale, size=(n_per, 2)) for c in centers
+    ]).astype(np.float32)
+    lab = np.repeat(np.arange(len(centers)), n_per)
+    return pts, lab
+
+
+def test_knn_tree_is_complete_hclust(rng):
+    x, _ = _blobs(rng)
+    t = knn_ward_linkage(x, k=8)
+    n = x.shape[0]
+    assert t.merge.shape == (n - 1, 2)
+    assert sorted(t.order.tolist()) == list(range(n))
+    # every singleton appears exactly once in the merge matrix
+    negs = t.merge[t.merge < 0]
+    assert sorted((-negs).tolist()) == list(range(1, n + 1))
+
+
+def test_knn_cut_matches_exact_ward(rng):
+    x, truth = _blobs(rng)
+    exact = cut_tree_k(ward_linkage(x), 3)
+    approx = cut_tree_k(knn_ward_linkage(x, k=10), 3)
+    assert adjusted_rand_score(exact, approx) == 1.0
+    assert adjusted_rand_score(truth, approx) == 1.0
+
+
+def test_knn_heights_match_exact_on_covered_merges(rng):
+    # With k large enough to cover everything, the trees coincide exactly.
+    x, _ = _blobs(rng, n_per=12)
+    exact = ward_linkage(x)
+    approx = knn_ward_linkage(x, k=x.shape[0] - 1)
+    np.testing.assert_allclose(approx.height, exact.height, rtol=1e-8)
+
+
+def test_disconnected_components_completed(rng):
+    # Two far-apart tight blobs with tiny k: graph is disconnected; the
+    # fallback must still produce a single complete tree whose top merge
+    # joins the blobs.
+    x, truth = _blobs(rng, n_per=30, centers=((0, 0), (500, 0)), scale=0.5)
+    t = knn_ward_linkage(x, k=3)
+    lab = cut_tree_k(t, 2)
+    assert adjusted_rand_score(truth, lab) == 1.0
+
+
+def test_pipeline_knn_approx_path(rng):
+    from scconsensus_tpu import recluster_de_consensus_fast
+    from scconsensus_tpu.utils.synthetic import synthetic_scrna
+
+    data, labels, _ = synthetic_scrna(
+        n_genes=200, n_cells=400, n_clusters=3, seed=21,
+        n_markers_per_cluster=30,
+    )
+    res = recluster_de_consensus_fast(
+        data, np.array([f"c{v}" for v in labels]), q_val_thrs=0.1,
+        deep_split_values=(1,), approx_threshold=100, approx_method="knn",
+        knn_graph_k=12,
+    )
+    lab = res.dynamic_labels["deepsplit: 1"]
+    m = lab > 0
+    assert adjusted_rand_score(labels[m], lab[m]) > 0.8

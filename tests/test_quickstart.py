@@ -1,0 +1,23 @@
+"""The L6 worked workflow (examples/quickstart.py) runs end to end in CI
+(SURVEY.md §1 L6; mirrors reference README.md:38-162 including the manual
+consensus-override step and artifact-store resume)."""
+
+import pathlib
+import subprocess
+import sys
+
+
+def test_quickstart_runs(tmp_path):
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        [sys.executable, str(repo / "examples" / "quickstart.py"),
+         "--cells", "600", "--genes", "400", "--outdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=900,
+        cwd=tmp_path,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "[quickstart] done:" in proc.stdout
+    assert "resume: DE stage skipped" in proc.stdout
+    assert (tmp_path / "Contingency_Table.pdf").exists()
+    assert (tmp_path / "Reclustered_DE_edgeR_Heatmap.pdf").exists()
