@@ -65,6 +65,8 @@ reproduces that literal arithmetic; fixed mode tests on expm1(data).
 from __future__ import annotations
 
 import dataclasses
+import os
+import time
 from functools import partial
 from typing import List, Tuple
 
@@ -105,6 +107,24 @@ class EdgerPairResult:
     log_fc: np.ndarray       # (P, G) natural-log fold change group1 vs group2
     common_disp: np.ndarray  # (P,)
     tagwise_disp: np.ndarray  # (P, G)
+
+
+class _PhaseProfiler:
+    """SCC_EDGER_PROFILE=1: per-phase wall-clocks for the NB driver, with a
+    device sync at each boundary (so async dispatch can't smear phases).
+    Zero overhead when disabled — no syncs, no timing."""
+
+    def __init__(self) -> None:
+        self.enabled = bool(os.environ.get("SCC_EDGER_PROFILE"))
+        self._t = time.perf_counter() if self.enabled else 0.0
+
+    def mark(self, label: str) -> None:
+        if not self.enabled:
+            return
+        (jax.device_put(0.0) + 0).block_until_ready()  # drain the queue
+        now = time.perf_counter()
+        print(f"[edger-profile] {label}: {now - self._t:.3f}s", flush=True)
+        self._t = now
 
 
 # --------------------------------------------------------------------------
@@ -228,6 +248,7 @@ def run_edger_pairs(
     pair_j: np.ndarray,
     n_genes: int,
     seed: int = 0,
+    jcounts=None,
 ) -> EdgerPairResult:
     """Run the NB pipeline for every cluster pair.
 
@@ -235,6 +256,13 @@ def run_edger_pairs(
     mode — the reference's literal behavior — or expm1 of it); dense or
     scipy-sparse. cell_idx_of: per-cluster cell index lists (post
     subsampling); pair_i/pair_j: (P,) cluster indices per pair.
+    ``jcounts``: optional already-on-device (G, N) copy of ``counts`` (the
+    engine re-uses its aggregate upload) — without it a dense matrix is
+    uploaded here, once.
+
+    The returned (P, G) arrays are DEVICE arrays when the input was dense:
+    through a slow device→host link only the consumer-touched fields should
+    ever cross (engine.PairwiseDEResult materializes per field, lazily).
     """
     from scconsensus_tpu.de.engine import (
         _cid_from_groups,
@@ -243,6 +271,7 @@ def run_edger_pairs(
     )
     from scconsensus_tpu.io.sparsemat import as_csr, is_sparse
 
+    prof = _PhaseProfiler()
     G = n_genes
     N = counts.shape[1]
     K = len(cell_idx_of)
@@ -252,8 +281,10 @@ def run_edger_pairs(
         counts = as_csr(counts)
     else:
         counts = np.ascontiguousarray(counts, np.float32)
-    # Dense input crosses host→device exactly once; both chunk loops reuse it.
-    jcounts = None if sparse else jnp.asarray(counts)
+        # Dense input crosses host→device exactly once (or zero times, when
+        # the engine hands over its aggregate upload); chunk loops reuse it.
+        if jcounts is None:
+            jcounts = jnp.asarray(counts)
 
     # ---- host geometry -------------------------------------------------
     cid = _cid_from_groups(cell_idx_of, N)
@@ -262,7 +293,8 @@ def run_edger_pairs(
     if sparse:
         lib_all = np.asarray(counts.sum(axis=0), np.float32).ravel()
     else:
-        lib_all = counts.sum(axis=0, dtype=np.float64).astype(np.float32)
+        # (N,) library sizes: reduce on device, fetch 4N bytes.
+        lib_all = np.asarray(jnp.sum(jcounts, axis=0))
     libsum_c = np.array(
         [lib_all[ci].sum() for ci in cell_idx_of], np.float32
     )
@@ -284,12 +316,6 @@ def run_edger_pairs(
     )
     sub_onehot = np.zeros((sub_cells.size, K), np.float32)
     sub_onehot[np.arange(sub_cells.size), cid_sub] = 1.0
-    if sparse:
-        sub_counts = np.asarray(
-            counts[:, sub_cells].todense(), np.float32
-        )
-    else:
-        sub_counts = counts[:, sub_cells]
 
     onehot = np.zeros((N, K), np.float32)
     onehot[kept, cid[kept]] = 1.0
@@ -300,8 +326,15 @@ def run_edger_pairs(
     j_sub_onehot = jnp.asarray(sub_onehot)
     j_lib_sub = jnp.asarray(lib_all[sub_cells])
     j_cid_sub = jnp.asarray(cid_sub)
-    j_sub_counts = jnp.asarray(sub_counts)
+    if sparse:
+        j_sub_counts = jnp.asarray(
+            np.asarray(counts[:, sub_cells].todense(), np.float32)
+        )
+    else:
+        # Column gather on device — the host copy is never touched again.
+        j_sub_counts = jnp.take(jcounts, jnp.asarray(sub_cells), axis=1)
 
+    prof.mark("setup")
     gc = max(256, _next_pow2(_CHUNK_ELEMS // max(N, 1)) >> 1)
     gc = min(gc, _next_pow2(G))  # never pad beyond the gene count
 
@@ -313,6 +346,7 @@ def run_edger_pairs(
     Zy = np.zeros((G, K), np.float32)
     for g0, g1, part in Zy_parts:
         Zy[g0:g1] = np.asarray(part)[: g1 - g0]
+    prof.mark("pass_a_raw_sums")
     rates = Zy / np.maximum(libsum_c, 1e-30)[None, :]  # Poisson MLE (G, K)
     j_rates = jnp.asarray(rates)
 
@@ -351,6 +385,7 @@ def run_edger_pairs(
         return jnp.concatenate(tabs, axis=0), jnp.concatenate(zss, axis=0)
 
     table0, zs0 = _build_table(_PILOT_DISPERSION)
+    prof.mark("pilot_table")
 
     w_grid = jnp.asarray(_dense_weights(
         np.log(r_grid).astype(np.float32), rho_nodes[0], h, _NODE_COUNT
@@ -371,8 +406,8 @@ def run_edger_pairs(
                         mode="edge")
             yield p0, p1, pi, pj
 
-    common = np.zeros(P, np.float32)
     j_deltas = jnp.asarray(deltas)
+    common_parts = []
     for p0, p1, pi, pj in _pair_chunks():
         keep = (j_Zy[:, pi] + j_Zy[:, pj]) > _ROWSUM_FILTER
         cl = _cl_grid_pairs(
@@ -380,14 +415,17 @@ def run_edger_pairs(
             j_zs0[:, pi], j_zs0[:, pj], j_ns[pi], j_ns[pj],
             keep, j_r_grid,
         )
-        common[p0:p1] = np.asarray(
-            common_dispersion_grid(cl, j_deltas)
-        )[: p1 - p0]
+        common_parts.append(common_dispersion_grid(cl, j_deltas)[: p1 - p0])
+    # chunks dispatch async; ONE (P,) fetch instead of a sync per chunk
+    common = np.asarray(jnp.concatenate(common_parts))
+
+    prof.mark("common_grid")
 
     # ---- re-equalize at the median common dispersion --------------------
     phi_req = float(np.median(common))
     table1, zs1 = _build_table(phi_req)
-    Z1 = np.zeros((G, K), np.float32)
+    prof.mark("table1")
+    z1_parts = []
     for g0, g1, chunk in _gene_chunks(counts, gc, jdata=jcounts):
         part = _pseudo_sums_chunk(
             chunk, j_onehot, j_lib, j_cid_safe, j_kept,
@@ -396,14 +434,18 @@ def run_edger_pairs(
                                     ((0, chunk.shape[0] - (g1 - g0)), (0, 0)))),
             jnp.float32(common_lib), jnp.float32(phi_req),
         )
-        Z1[g0:g1] = np.asarray(part)[: g1 - g0]
+        z1_parts.append(part[: g1 - g0])
+    j_Z1 = jnp.concatenate(z1_parts, axis=0)  # (G, K) stays on device
+    Z1 = np.asarray(j_Z1)  # one small (G, K) fetch drives host task geometry
+
+    prof.mark("z1_sweep")
 
     # ---- tagwise dispersions -------------------------------------------
     prior_n = (_PRIOR_DF / np.maximum(
         ns_of[pair_i] + ns_of[pair_j] - 2.0, 1.0
     )).astype(np.float32)
     expo = np.asarray(TAGWISE_GRID_EXPONENTS)
-    tagwise = np.zeros((P, G), np.float32)
+    tw_parts = []
     for p0, p1, pi, pj in _pair_chunks():
         common_c = np.pad(common[p0:p1], (0, _PAIR_CHUNK - (p1 - p0)),
                           constant_values=1.0)
@@ -421,44 +463,85 @@ def run_edger_pairs(
             keep, jnp.asarray((1.0 / phi_t).astype(np.float32)),
             jnp.asarray(common_c), jnp.asarray(prior_c),
         )
-        tagwise[p0:p1] = np.asarray(tw)[: p1 - p0]
+        tw_parts.append(tw[: p1 - p0])
+    # (P, G) tagwise dispersions stay on device: the exact test gathers its
+    # per-task dispersions here, and the caller exposes the full array only
+    # through a lazy fetch.
+    j_tagwise = jnp.concatenate(tw_parts, axis=0)
 
-    # ---- exact test -----------------------------------------------------
-    s1 = Z1[:, pair_i].T  # (P, G)
+    prof.mark("tagwise")
+
+    # ---- exact test (device end-to-end) ---------------------------------
+    # Host side only builds the task geometry from the tiny (G, K) Z1 fetch;
+    # statistics never cross to host (the old per-chunk fetch pattern cost
+    # ~47 s at flagship scale through the 10 MB/s device→host tunnel).
+    s1 = Z1[:, pair_i].T  # (P, G) host copies: task bucketing + logFC only
     s2 = Z1[:, pair_j].T
-    n1 = n_of[pair_i][:, None]
-    n2 = n_of[pair_j][:, None]
-    s1r = np.round(s1)
-    s2r = np.round(s2)
-    tot = s1r + s2r
+    tot = np.round(s1) + np.round(s2)
     max_total = float(tot.max(initial=0.0))
     s_max = int(min(_EXACT_SMAX, _next_pow2(max(int(max_total) + 2, 64))))
-    small = tot < s_max
+
+    j_pair_i = jnp.asarray(pair_i.astype(np.int32))
+    j_pair_j = jnp.asarray(pair_j.astype(np.int32))
+    j_n_of = jnp.asarray(n_of)
+    j_s1 = jnp.take(j_Z1, j_pair_i, axis=1).T  # (P, G)
+    j_s2 = jnp.take(j_Z1, j_pair_j, axis=1).T
+    j_n1 = j_n_of[j_pair_i][:, None]
+    j_n2 = j_n_of[j_pair_j][:, None]
 
     # normal branch for everything, vectorized…
-    log_p = np.array(nb_exact_test_logp_normal(
-        jnp.asarray(s1), jnp.asarray(s2),
-        jnp.asarray(n1), jnp.asarray(n2),
-        jnp.asarray(tagwise),
-    ))
-    # …then the exact kernel on the host-compacted small-total task list.
-    rows, cols = np.nonzero(small)
-    if rows.size:
-        tb = max(1024, _EXACT_TASK_ELEMS // s_max)
+    j_log_p = nb_exact_test_logp_normal(j_s1, j_s2, j_n1, j_n2, j_tagwise)
+    prof.mark("exact_normal")
+
+    # …then the exact kernel on host-compacted small-total task lists,
+    # bucketed by each task's own total: a task only pays for the support
+    # width it needs (pow-4 ladder up to s_max), instead of every task
+    # paying the global worst case. Results scatter back ON DEVICE.
+    n1_host = n_of[pair_i]
+    n2_host = n_of[pair_j]
+    s_buckets = []
+    sb = 64
+    while sb < s_max:
+        s_buckets.append(sb)
+        sb *= 4
+    s_buckets.append(s_max)
+    lower = 0.5  # tot == 0 is a point mass (p = 1): the normal branch's value
+    all_rows, all_vals = [], []
+    for sb in s_buckets:
+        mask = (tot >= lower) & (tot < float(sb))
+        lower = float(sb)
+        rows, cols = np.nonzero(mask)
+        if not rows.size:
+            continue
+        flat = jnp.asarray(rows.astype(np.int32) * G + cols.astype(np.int32))
+        tag_b = jnp.take(j_tagwise.reshape(-1), flat)
+        s1_b = jnp.asarray(s1[rows, cols])
+        s2_b = jnp.asarray(s2[rows, cols])
+        n1_b = jnp.asarray(n1_host[rows])
+        n2_b = jnp.asarray(n2_host[rows])
+        tb = max(1024, _EXACT_TASK_ELEMS // sb)
+        outs = []
         for t0 in range(0, rows.size, tb):
-            r = rows[t0: t0 + tb]
-            c = cols[t0: t0 + tb]
-            pad = tb - r.size if r.size < tb else 0
-            pw = (0, pad)
+            t1 = min(t0 + tb, rows.size)
+            pad = tb - (t1 - t0)
+            pw = [(0, pad)]
             lp = nb_exact_test_logp(
-                jnp.asarray(np.pad(s1[r, c], pw)),
-                jnp.asarray(np.pad(s2[r, c], pw)),
-                jnp.asarray(np.pad(n_of[pair_i[r]], pw)),
-                jnp.asarray(np.pad(n_of[pair_j[r]], pw)),
-                jnp.asarray(np.pad(tagwise[r, c], pw, constant_values=1.0)),
-                s_max=s_max,
+                jnp.pad(s1_b[t0:t1], pw),
+                jnp.pad(s2_b[t0:t1], pw),
+                jnp.pad(n1_b[t0:t1], pw),
+                jnp.pad(n2_b[t0:t1], pw),
+                jnp.pad(tag_b[t0:t1], pw, constant_values=1.0),
+                s_max=sb,
             )
-            log_p[r, c] = np.asarray(lp)[: r.size]
+            outs.append(lp[: t1 - t0])
+        all_rows.append(flat)
+        all_vals.append(jnp.concatenate(outs) if len(outs) > 1 else outs[0])
+    if all_rows:
+        j_log_p = j_log_p.reshape(-1).at[
+            jnp.concatenate(all_rows)
+        ].set(jnp.concatenate(all_vals)).reshape(P, G)
+
+    prof.mark("exact_small")
 
     # ---- logFC from equalized abundances --------------------------------
     ab1 = s1 / np.maximum(n_of[pair_i][:, None], 1.0) + _LOGFC_PRIOR_COUNT
@@ -466,8 +549,8 @@ def run_edger_pairs(
     log_fc = np.log(ab1) - np.log(ab2)
 
     return EdgerPairResult(
-        log_p=log_p.astype(np.float32),
+        log_p=j_log_p,          # device (dense input); lazy-fetched upstream
         log_fc=log_fc.astype(np.float32),
         common_disp=common,
-        tagwise_disp=tagwise,
+        tagwise_disp=j_tagwise,  # device; lazy-fetched upstream
     )

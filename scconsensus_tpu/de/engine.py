@@ -48,7 +48,17 @@ __all__ = ["PairwiseDEResult", "pairwise_de", "filter_clusters", "de_gene_union"
 
 @dataclasses.dataclass
 class PairwiseDEResult:
-    """Dense all-pairs DE summary (host arrays; P = #pairs, G = #genes)."""
+    """Dense all-pairs DE summary (P = #pairs, G = #genes).
+
+    The big (P, G) fields may be constructed as DEVICE arrays: each one
+    materializes to numpy on first attribute access, per field. Through a
+    slow device→host link (the axon tunnel moves ~36 MB/s) this matters:
+    the refinement pipeline only ever touches ``de_mask`` and ``log_fc``
+    (~70 MB at flagship scale), while an eager fetch of all seven arrays is
+    ~310 MB — measured 38 s of the round-3 flagship wilcox wall-clock.
+    Consumers always see plain numpy; persistence (``to_store``) touches
+    every field and therefore materializes everything, as resume requires.
+    """
 
     cluster_names: List[str]
     pair_i: np.ndarray  # (P,) index into cluster_names
@@ -64,6 +74,27 @@ class PairwiseDEResult:
     aux: Optional[Dict[str, np.ndarray]] = None  # extra per-test stats (AUC...)
     skip_reasons: Optional[List[str]] = None  # one per skipped pair
 
+    # Fields allowed to arrive as device arrays (lazily fetched, see above).
+    _LAZY_FIELDS = frozenset(
+        {"log_p", "log_q", "log_fc", "tested", "de_mask", "pct1", "pct2"}
+    )
+
+    def __getattribute__(self, name):
+        v = object.__getattribute__(self, name)
+        if (
+            name in PairwiseDEResult._LAZY_FIELDS
+            and v is not None
+            and not isinstance(v, np.ndarray)
+        ):
+            v = np.asarray(jax.device_get(v))
+            object.__setattr__(self, name, v)
+        elif name == "aux" and v is not None and any(
+            not isinstance(a, np.ndarray) for a in v.values()
+        ):
+            v = {k: np.asarray(a) for k, a in jax.device_get(v).items()}
+            object.__setattr__(self, name, v)
+        return v
+
     @property
     def n_pairs(self) -> int:
         return int(self.pair_i.shape[0])
@@ -71,7 +102,11 @@ class PairwiseDEResult:
     def de_counts(self) -> np.ndarray:
         """Per-pair DE gene counts (the reference's progress printout,
         R/reclusterDEConsensus.R:172-178 — here a returned metric)."""
-        return self.de_mask.sum(axis=1)
+        raw = object.__getattribute__(self, "de_mask")
+        if not isinstance(raw, np.ndarray):
+            # reduce on device: fetch P ints, not the (P, G) mask
+            return np.asarray(jnp.sum(raw, axis=1))
+        return raw.sum(axis=1)
 
     _ARRAY_FIELDS = ("pair_i", "pair_j", "log_p", "log_q", "log_fc",
                      "tested", "de_mask", "pair_skipped")
@@ -81,9 +116,23 @@ class PairwiseDEResult:
         if self.pair_skipped is None:
             self.pair_skipped = np.zeros(self.pair_i.shape[0], bool)
 
+    def _materialize_all(self) -> None:
+        """Fetch every still-on-device lazy field in ONE batched device_get
+        (per-field getattr would pay a blocking link round-trip each)."""
+        pending = {
+            f: object.__getattribute__(self, f)
+            for f in self._LAZY_FIELDS
+            if object.__getattribute__(self, f) is not None
+            and not isinstance(object.__getattribute__(self, f), np.ndarray)
+        }
+        if pending:
+            for f, v in jax.device_get(pending).items():
+                object.__setattr__(self, f, np.asarray(v))
+
     def to_store(self) -> Tuple[Dict[str, np.ndarray], Dict]:
         """(arrays, meta) for ArtifactStore — the single serialization point,
         so the field list cannot drift from the dataclass."""
+        self._materialize_all()
         arrays = {f: getattr(self, f) for f in self._ARRAY_FIELDS}
         for f in self._OPT_ARRAY_FIELDS:
             v = getattr(self, f)
@@ -158,6 +207,18 @@ def _expand_rows(sub: np.ndarray, ok_rows: np.ndarray, n_rows: int) -> np.ndarra
     out = np.full((n_rows,) + sub.shape[1:], fill, sub.dtype)
     out[ok_rows] = sub
     return out
+
+
+def _expand_rows_any(sub, ok_rows: np.ndarray, n_rows: int):
+    """``_expand_rows`` for host OR device arrays (device scatter keeps the
+    result on device for the lazy-fetch result fields)."""
+    if isinstance(sub, np.ndarray):
+        return _expand_rows(sub, ok_rows, n_rows)
+    if ok_rows.size == n_rows:
+        return sub
+    fill = False if sub.dtype == bool else np.nan
+    out = jnp.full((n_rows,) + sub.shape[1:], fill, sub.dtype)
+    return out.at[jnp.asarray(ok_rows)].set(sub)
 
 
 @dataclasses.dataclass
@@ -536,31 +597,23 @@ def pairwise_de(
             else:
                 de = tested & (log_q < log_thr)
             de = de & ~jnp.isnan(log_q)
-            fetch = {
-                "log_p": log_p, "log_q": log_q, "log_fc": log_fc,
-                "tested": tested, "de": de,
-            }
-            if pct1 is not None:
-                fetch["pct1"], fetch["pct2"] = pct1, pct2
-            if aux is not None:
-                fetch.update(aux)
-            host = jax.device_get(fetch)
+        # The (P, G) statistics stay DEVICE arrays inside the result and
+        # materialize per field on first access (class docstring) — the
+        # pipeline consumes only de_mask + log_fc; nothing forces the other
+        # five through the slow device→host link unless someone reads them.
         return PairwiseDEResult(
             cluster_names=names,
             pair_i=pair_i,
             pair_j=pair_j,
-            log_p=host["log_p"],
-            log_q=host["log_q"],
-            log_fc=host["log_fc"],
-            tested=host["tested"],
-            de_mask=host["de"],
+            log_p=log_p,
+            log_q=log_q,
+            log_fc=log_fc,
+            tested=tested,
+            de_mask=de,
             pair_skipped=~pair_ok,
-            pct1=host.get("pct1"),
-            pct2=host.get("pct2"),
-            aux=(
-                {"auc": host["auc"], "power": host["power"]}
-                if aux is not None else None
-            ),
+            pct1=pct1,
+            pct2=pct2,
+            aux=aux,
             skip_reasons=skip_reasons or None,
         )
 
@@ -575,13 +628,15 @@ def pairwise_de(
         if config.compat.edger_log_counts:
             counts = data
             gate_mean = mean_expm1(data)
+            jnb = jdata  # engine's aggregate upload doubles as NB input
         else:
             counts = expm1_sparse(data)
             gate_mean = mean_value(counts)  # counts IS expm1(data): reuse it
+            jnb = None if jdata is None else jnp.expm1(jdata)
         with timer.stage("edger_nb"):
             nb = run_edger_pairs(
                 counts, cell_idx_of, run_i, run_j, G,
-                seed=config.random_seed,
+                seed=config.random_seed, jcounts=jnb,
             )
         with timer.stage("gates"):
             mean_gate, _slow_fc = pair_gates_slow(
@@ -589,29 +644,31 @@ def pairwise_de(
                 mean_exprs_thrs=config.mean_scaling_factor * gate_mean,
                 mixed_spaces=config.compat.mean_gate_mixed_spaces,
             )
-        log_p = _expand_rows(nb.log_p, ok_rows, P)
+        # (P, G) statistics stay device arrays end to end (sparse inputs ride
+        # the host path and arrive numpy — both shapes work below).
+        log_p = _expand_rows_any(nb.log_p, ok_rows, P)
         log_fc = _expand_rows(nb.log_fc, ok_rows, P)
         with timer.stage("bh_adjust"):
-            log_q = np.asarray(
+            log_q = (
                 bh_adjust(jnp.asarray(log_p), n=jnp.asarray(float(G)))
                 if config.compat.bh_reference_n
                 else bh_adjust(jnp.asarray(log_p))
             )
         with timer.stage("de_call"):
-            log_thr = np.log(np.float32(config.q_val_thrs))
+            log_thr = float(np.log(np.float32(config.q_val_thrs)))
             if config.compat.edger_drop_logfc:
                 # §2d-1: the reference stores edgeR's fold-changes into a dead
                 # variable; the criterion reads scalar-NA `logfc`, so the
                 # whole mask is NA → no gene is ever *selected*. Reproduced
                 # as an all-false DE mask (NA indexes select nothing usable).
-                de = np.zeros((P, G), bool)
+                de = jnp.zeros((P, G), bool)
             else:
                 de = (
                     (log_q < log_thr)
-                    & (np.abs(log_fc) > config.log_fc_thrs)
-                    & np.asarray(mean_gate)
+                    & (jnp.abs(jnp.asarray(log_fc)) > config.log_fc_thrs)
+                    & mean_gate
                 )
-                de &= ~np.isnan(log_q)
+                de = de & ~jnp.isnan(log_q)
         tested = np.ones((P, G), bool)
         tested[~pair_ok] = False
         return PairwiseDEResult(
@@ -626,7 +683,9 @@ def pairwise_de(
             pair_skipped=~pair_ok,
             aux={
                 "common_dispersion": _expand_rows(nb.common_disp, ok_rows, P),
-                "tagwise_dispersion": _expand_rows(nb.tagwise_disp, ok_rows, P),
+                "tagwise_dispersion": _expand_rows_any(
+                    nb.tagwise_disp, ok_rows, P
+                ),
             },
             skip_reasons=skip_reasons or None,
         )
@@ -641,6 +700,18 @@ def de_gene_union(
     (R/reclusterDEConsensus.R:209-227; fast path :386-392).
 
     Returns sorted unique gene indices."""
+    raw_mask = object.__getattribute__(result, "de_mask")
+    raw_fc = object.__getattribute__(result, "log_fc")
+    if not (isinstance(raw_mask, np.ndarray) and isinstance(raw_fc, np.ndarray)):
+        # Device fast path: per-pair top-k on device, fetch (P, n_top) ints
+        # instead of materializing two (P, G) arrays through the slow link.
+        masked = jnp.where(
+            jnp.asarray(raw_mask), jnp.abs(jnp.asarray(raw_fc)), -jnp.inf
+        )
+        k = min(n_top, masked.shape[1])
+        vals, idx = jax.lax.top_k(masked, k)
+        vals, idx = jax.device_get((vals, idx))
+        return np.unique(idx[vals > -np.inf]).astype(np.int64)
     union: set = set()
     for p in range(result.n_pairs):
         de_idx = np.nonzero(result.de_mask[p])[0]

@@ -121,7 +121,8 @@ def refine(
             logger.warning("stage de: artifact unusable (%s); recomputing", e)
     if de_res is None:
         de_res = pairwise_de(data, labels, config, timer=timer, mesh=mesh)
-        store.save("de", *de_res.to_store())
+        if store.enabled:  # to_store() materializes every lazy device field
+            store.save("de", *de_res.to_store())
 
     with timer.stage("union") as rec:
         union = store.cached(
@@ -242,13 +243,27 @@ def refine(
 
     if config.compat.return_silhouette:
         with timer.stage("silhouette"):
-            for info, dsv in zip(deep_split_info, config.deep_split_values):
-                key = f"deepsplit: {dsv}"
-                lab = dynamic_labels[key]
-                si, _per = mean_cluster_silhouette(
-                    embedding, np.where(lab > 0, lab, -1), mesh=mesh
-                )
-                info["silhouette"] = si
+            if mesh is not None:
+                for info, dsv in zip(deep_split_info, config.deep_split_values):
+                    key = f"deepsplit: {dsv}"
+                    lab = dynamic_labels[key]
+                    si, _per = mean_cluster_silhouette(
+                        embedding, np.where(lab > 0, lab, -1), mesh=mesh
+                    )
+                    info["silhouette"] = si
+            else:
+                # all cuts share one N² distance pass (multi_cut_silhouette)
+                from scconsensus_tpu.ops.silhouette import multi_cut_silhouette
+
+                labs = [
+                    np.where(dynamic_labels[f"deepsplit: {dsv}"] > 0,
+                             dynamic_labels[f"deepsplit: {dsv}"], -1)
+                    for dsv in config.deep_split_values
+                ]
+                for info, (si, _per) in zip(
+                    deep_split_info, multi_cut_silhouette(embedding, labs)
+                ):
+                    info["silhouette"] = si
 
     with timer.stage("nodg"):
         # per-cell number of detected genes; the reference's O(N·G)

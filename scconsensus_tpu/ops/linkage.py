@@ -130,11 +130,43 @@ def ward_linkage(
 
 
 def _to_hclust(raw_pairs: np.ndarray, raw_h: np.ndarray, n: int) -> HClustTree:
-    """Sort raw chain merges by height (stable, so children precede parents on
-    ties) and rewrite slot ids into R hclust merge codes."""
-    order_rows = np.argsort(raw_h, kind="stable")
-    rank_of_raw = np.empty(n - 1, np.int64)
-    rank_of_raw[order_rows] = np.arange(n - 1)
+    """Order raw merges by height and rewrite slot ids into R hclust merge
+    codes.
+
+    The ordering is a height-prioritized topological (Kahn) pass rather than
+    a plain argsort: a merge becomes eligible only once both child rows are
+    placed. For reducible linkages (NN-chain Ward) parent heights dominate
+    children, so this reproduces the stable height sort exactly; for
+    candidate-restricted agglomerations (ops.knn_linkage) a parent can sit
+    BELOW a child (an inversion — legal in hclust trees, cf. centroid
+    linkage), and a plain height sort would emit a row referencing a later
+    row: a structurally invalid tree."""
+    import heapq
+
+    m = n - 1
+    dep_count = np.zeros(m, np.int32)
+    dependents: list = [[] for _ in range(m)]
+    for r in range(m):
+        for slot in (int(raw_pairs[r, 0]), int(raw_pairs[r, 1])):
+            if slot >= n:
+                dep_count[r] += 1
+                dependents[slot - n].append(r)
+    heap = [(float(raw_h[r]), r) for r in range(m) if dep_count[r] == 0]
+    heapq.heapify(heap)
+    order_rows = np.empty(m, np.int64)
+    rank_of_raw = np.empty(m, np.int64)
+    placed = 0
+    while heap:
+        _, r = heapq.heappop(heap)
+        order_rows[placed] = r
+        rank_of_raw[r] = placed
+        placed += 1
+        for d in dependents[r]:
+            dep_count[d] -= 1
+            if dep_count[d] == 0:
+                heapq.heappush(heap, (float(raw_h[d]), d))
+    if placed != m:  # a cycle would mean corrupt input, not a bad sort
+        raise ValueError("merge list is not a forest")
 
     def code(slot: int, _rank=rank_of_raw, _n=n) -> int:
         if slot < _n:

@@ -145,11 +145,14 @@ def distance_cluster_sums(
     if backend == "xla":
         jx = jnp.asarray(x)
         joh = jnp.asarray(onehot)
-        out = np.empty((n, k), np.float32)
-        for s in range(0, n, block):
-            e = min(s + block, n)
-            out[s:e] = np.asarray(_xla_block_sums(jx[s:e], jx, joh))
-        return out
+        # Blocks dispatch async and concatenate on device: ONE host fetch at
+        # the end (per-block np.asarray cost a blocking round-trip each
+        # through the slow device→host tunnel).
+        parts = [
+            _xla_block_sums(jx[s : min(s + block, n)], jx, joh)
+            for s in range(0, n, block)
+        ]
+        return np.asarray(jnp.concatenate(parts, axis=0))
 
     raise ValueError(f"unknown backend {backend!r}")
 

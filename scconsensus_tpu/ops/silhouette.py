@@ -25,6 +25,7 @@ import numpy as np
 __all__ = [
     "silhouette_widths",
     "mean_cluster_silhouette",
+    "multi_cut_silhouette",
     "widths_from_cluster_sums",
 ]
 
@@ -79,6 +80,67 @@ def silhouette_widths(
     return out
 
 
+def multi_cut_silhouette(
+    x: np.ndarray,
+    labels_list,
+    block: int = 4096,
+    backend: str = "auto",
+) -> list:
+    """``mean_cluster_silhouette`` for several labelings of the SAME points
+    in one distance pass.
+
+    The pipeline scores every deepSplit cut against one embedding
+    (R/reclusterDEConsensusFast.R:415-433 recomputes the O(N²) distances per
+    cut); here the per-cut one-hots concatenate along the cluster axis, so
+    the N² distance tiles stream through HBM once for all cuts. Cells with
+    label < 0 in a cut simply have a zero one-hot row there — rows are
+    shared, validity is per cut. Returns [(mean_si, per_cluster_dict), …].
+    """
+    from scconsensus_tpu.ops.pallas_kernels import distance_cluster_sums
+
+    n = x.shape[0]
+    cuts = []
+    blocks = []
+    for labels in labels_list:
+        labels = np.asarray(labels)
+        valid = labels >= 0
+        uniq, inv = np.unique(labels[valid], return_inverse=True)
+        onehot = np.zeros((n, uniq.size), np.float32)
+        onehot[np.nonzero(valid)[0], inv] = 1.0
+        cuts.append((labels, valid, uniq, inv))
+        blocks.append(onehot)
+    onehot_cat = np.concatenate(blocks, axis=1)
+    sums_all = distance_cluster_sums(
+        np.ascontiguousarray(x, np.float32), onehot_cat,
+        backend=backend, block=block,
+    )
+    out = []
+    c0 = 0
+    for (labels, valid, uniq, inv), onehot in zip(cuts, blocks):
+        k = uniq.size
+        sums = sums_all[valid, c0 : c0 + k]
+        c0 += k
+        w = np.full(n, np.nan, np.float32)
+        if k >= 2:
+            counts = onehot.sum(axis=0)
+            w[valid] = widths_from_cluster_sums(sums, counts, inv)
+        out.append(_aggregate_widths(w, labels))
+    return out
+
+
+def _aggregate_widths(w: np.ndarray, labels: np.ndarray
+                      ) -> Tuple[float, Dict[int, float]]:
+    """Per-cluster mean widths + mean-of-means (the reference's reported SI)
+    — shared by the single-cut, multi-cut, and mesh paths so the aggregation
+    convention cannot diverge between them."""
+    per: Dict[int, float] = {}
+    for u in np.unique(labels[labels >= 0]):
+        per[int(u)] = float(np.nanmean(w[labels == u]))
+    if not per:
+        return float("nan"), per
+    return float(np.mean(list(per.values()))), per
+
+
 def mean_cluster_silhouette(
     x: np.ndarray, labels: np.ndarray, block: int = 4096,
     backend: str = "auto", mesh=None,
@@ -94,10 +156,4 @@ def mean_cluster_silhouette(
         w = sharded_silhouette_widths(x, labels, mesh)
     else:
         w = silhouette_widths(x, labels, block, backend=backend)
-    labels = np.asarray(labels)
-    per: Dict[int, float] = {}
-    for u in np.unique(labels[labels >= 0]):
-        per[int(u)] = float(np.nanmean(w[labels == u]))
-    if not per:
-        return float("nan"), per
-    return float(np.mean(list(per.values()))), per
+    return _aggregate_widths(w, np.asarray(labels))

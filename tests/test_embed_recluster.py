@@ -140,6 +140,23 @@ class TestSilhouette:
         w = silhouette_widths(x, lab2)
         assert np.isnan(w[:5]).all()
 
+    def test_multi_cut_matches_per_cut(self, rng):
+        from scconsensus_tpu.ops.silhouette import multi_cut_silhouette
+
+        x, lab = _blobs(rng, n_per=40, k=4)
+        cut1 = lab.copy()
+        cut2 = (lab // 2).astype(lab.dtype)  # coarser labeling
+        cut3 = lab.copy()
+        cut3[:7] = -1  # per-cut exclusions
+        cuts = [cut1, cut2, cut3]
+        fused = multi_cut_silhouette(x, cuts)
+        for labels, (si, per) in zip(cuts, fused):
+            ref_si, ref_per = mean_cluster_silhouette(x, labels)
+            assert si == pytest.approx(ref_si, abs=1e-5)
+            assert set(per) == set(ref_per)
+            for k_, v in per.items():
+                assert v == pytest.approx(ref_per[k_], abs=1e-5)
+
 
 class TestColors:
     def test_zero_is_grey_and_unique(self):

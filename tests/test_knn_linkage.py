@@ -71,3 +71,49 @@ def test_pipeline_knn_approx_path(rng):
     lab = res.dynamic_labels["deepsplit: 1"]
     m = lab > 0
     assert adjusted_rand_score(labels[m], lab[m]) > 0.8
+
+
+def _assert_structurally_valid(tree, n):
+    """Every positive merge code must reference an EARLIER row (hclust
+    contract); leaves appear exactly once; order is a permutation."""
+    seen_leaves = set()
+    for row in range(n - 1):
+        for c in map(int, tree.merge[row]):
+            if c > 0:
+                assert c - 1 < row, f"row {row} references later row {c - 1}"
+            else:
+                assert c not in seen_leaves
+                seen_leaves.add(c)
+    assert len(seen_leaves) == n
+    assert sorted(tree.order.tolist()) == list(range(n))
+
+
+def test_to_hclust_handles_inversions():
+    """A candidate-restricted agglomeration can merge a new cluster at a
+    LOWER height than the merge that created it (inversion). A plain
+    height sort would emit a row referencing a later row."""
+    from scconsensus_tpu.ops.linkage import _to_hclust
+
+    # slots: leaves 0,1,2; merge (0,1) at h=1.0 -> slot 3; (3,2) at h=0.33.
+    raw_pairs = np.array([[0, 1], [3, 2]], np.int64)
+    raw_h = np.array([1.0, 0.33])
+    t = _to_hclust(raw_pairs, raw_h, 3)
+    _assert_structurally_valid(t, 3)
+    # parent row second despite the smaller height
+    assert t.height[1] == pytest.approx(0.33)
+    assert tuple(t.merge[1]) == (-3, 1)  # references row 0, which exists by then
+
+
+def test_knn_tree_valid_under_sparse_graph(rng):
+    """Small k on stringy data exercises inversion-prone merges; the tree
+    must stay structurally valid regardless."""
+    x = np.concatenate([
+        rng.normal(scale=0.3, size=(40, 2)) + [i * 1.2, 0.0]
+        for i in range(6)
+    ]).astype(np.float32)
+    for k in (2, 3, 5):
+        t = knn_ward_linkage(x, k=k)
+        _assert_structurally_valid(t, x.shape[0])
+        # the cut must still be usable downstream
+        lab = cut_tree_k(t, 4)
+        assert set(lab) == {1, 2, 3, 4}
