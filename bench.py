@@ -282,7 +282,12 @@ def mfu_probes(platform: str) -> dict:
     except Exception as e:  # pragma: no cover - probe must never kill bench
         out["ranksum"] = {"error": repr(e)[:200]}
 
-    # NB node-table contraction: the edgeR-equivalent grid hot loop
+    # NB node-table build: the edgeR-equivalent engine's hot kernel. After
+    # the round-3 rewrite the engine is no longer FLOP-dominated — the
+    # (Gc, Ns, R) lgamma sweep feeding one MXU contraction prices every
+    # common/tagwise grid evaluation for every pair at once, so the honest
+    # throughput number is lgamma-site evaluations per second (an MFU quoted
+    # against the matmul peak undercounts transcendental work by design).
     try:
         Gt, Ns = 1024, K * 64
         psub = jnp.asarray(rng.gamma(2.0, size=(Gt, Ns)).astype(np.float32))
@@ -296,18 +301,19 @@ def mfu_probes(platform: str) -> dict:
         compiled = _table_chunk.lower(*nb_args).compile()
         flops = _cost_flops(compiled)
         sec = _time_reps(_table_chunk, nb_args)
-        out["nb_pass2"] = {
-            "kernel": "node_table_contraction",
+        out["nb_table"] = {
+            "kernel": "lgamma_node_table+contraction",
             "chunk": [Gt, Ns, _NODE_COUNT],
-            "gene_grid_evals_per_s": round(Gt * _NODE_COUNT / sec),
+            "lgamma_evals_per_s": round(Gt * Ns * _NODE_COUNT / sec),
+            "grid_points_priced_per_s": round(Gt * _NODE_COUNT / sec),
             "achieved_tflops": round(flops / sec / 1e12, 3),
         }
         if platform == "tpu":
-            out["nb_pass2"]["mfu_vs_bf16_peak"] = round(
+            out["nb_table"]["mfu_vs_bf16_peak"] = round(
                 flops / sec / TPU_PEAK_FLOPS, 4
             )
     except Exception as e:  # pragma: no cover
-        out["nb_pass2"] = {"error": repr(e)[:200]}
+        out["nb_table"] = {"error": repr(e)[:200]}
     return out
 
 

@@ -321,8 +321,15 @@ def _run_wilcox_device(
     fetched); 'never' keeps everything on the normal-approximation device
     path. ``mesh``: optional device mesh — gene chunks are sharded across
     it (genes are embarrassingly parallel).
+
+    Single-device dense inputs take the sparse-window route: genes bucket
+    by their nonzero count onto a pow-4 window ladder and each bucket runs
+    the rank-sum kernel at its own window width (zero-block decomposition,
+    ops.ranksum_allpairs) — expression data is mostly zeros, so most genes
+    pay a fraction of the full N-cell scan.
     """
     from scconsensus_tpu.ops.ranksum_allpairs import (
+        _ALLPAIRS_ELEM_BUDGET,
         allpairs_ranksum_chunk,
         chunk_genes_for_budget,
     )
@@ -343,22 +350,72 @@ def _run_wilcox_device(
         n_dev = int(mesh.devices.size)
         gc = max(gc, n_dev * 8)
 
-    outs = []
-    for g0, g1, chunk in _gene_chunks(data, gc, jdata=jdata):
-        if mesh is not None:
-            outs.append((g0, g1, sharded_allpairs_ranksum(
-                chunk, jcid, jn, jpi, jpj, K, mesh=mesh
-            )))
-        else:
-            outs.append((g0, g1, allpairs_ranksum_chunk(
-                chunk, jcid, jn, jpi, jpj, K
-            )))
-    log_p = jnp.concatenate(
-        [lp[: g1 - g0] for g0, g1, (lp, _, _) in outs], axis=0
-    ).T  # (P, G)
-    u_stat = jnp.concatenate(
-        [u[: g1 - g0] for g0, g1, (_, u, _) in outs], axis=0
-    ).T
+    windowed = mesh is None and jdata is not None
+    if windowed:
+        # nnz over ALL cells (excluded cells still occupy window slots) and
+        # a negativity check (the decomposition needs zeros as the minimum).
+        nnz_g, any_neg = jax.device_get((
+            jnp.sum(jdata > 0, axis=1), jnp.any(jdata < 0)
+        ))
+        windowed = not bool(any_neg)
+
+    if windowed:
+        order = np.argsort(nnz_g, kind="stable").astype(np.int64)
+        nnz_sorted = nnz_g[order]
+        parts = []  # (gene_ids, (log_p, u, ties)) in sorted-gene order
+        g0 = 0
+        while g0 < G:
+            # window floor 1024: bounds the distinct compiled shapes (cold
+            # compiles cross the remote-compile tunnel) and scans below 1k
+            # lanes are dispatch-bound anyway
+            w = int(
+                min(_next_pow2(max(int(nnz_sorted[g0]), 1024)), _next_pow2(N))
+            )
+            gcb = max(8, _ALLPAIRS_ELEM_BUDGET // max(w * K, 1))
+            gcb = 1 << (int(gcb).bit_length() - 1)
+            # every gene in the block must fit the block's window
+            g1 = g0
+            while (g1 < G and g1 - g0 < gcb
+                   and (w >= N or nnz_sorted[g1] <= w)):
+                g1 += 1
+            ids = order[g0:g1]
+            rows = jnp.take(jdata, jnp.asarray(ids), axis=0)
+            if ids.size < gcb:
+                rows = jnp.pad(rows, ((0, gcb - ids.size), (0, 0)))
+            out = allpairs_ranksum_chunk(
+                rows, jcid, jn, jpi, jpj, K,
+                window=(w if w < N else 0),
+            )
+            parts.append((ids, out))
+            g0 = g1
+        inv = np.empty(G, np.int64)
+        inv[np.concatenate([ids for ids, _ in parts])] = np.arange(G)
+        jinv = jnp.asarray(inv)
+        # concat in sorted order, un-permute rows once, transpose to (P, G)
+        log_p = jnp.take(jnp.concatenate(
+            [o[0][: ids.size] for ids, o in parts], axis=0
+        ), jinv, axis=0).T
+        u_stat = jnp.take(jnp.concatenate(
+            [o[1][: ids.size] for ids, o in parts], axis=0
+        ), jinv, axis=0).T
+        outs = None
+    else:
+        outs = []
+        for g0, g1, chunk in _gene_chunks(data, gc, jdata=jdata):
+            if mesh is not None:
+                outs.append((g0, g1, sharded_allpairs_ranksum(
+                    chunk, jcid, jn, jpi, jpj, K, mesh=mesh
+                )))
+            else:
+                outs.append((g0, g1, allpairs_ranksum_chunk(
+                    chunk, jcid, jn, jpi, jpj, K
+                )))
+        log_p = jnp.concatenate(
+            [lp[: g1 - g0] for g0, g1, (lp, _, _) in outs], axis=0
+        ).T  # (P, G)
+        u_stat = jnp.concatenate(
+            [u[: g1 - g0] for g0, g1, (_, u, _) in outs], axis=0
+        ).T
 
     if exact == "auto":
         small = np.nonzero(
@@ -366,9 +423,14 @@ def _run_wilcox_device(
         )[0]
         if small.size:
             # Fetch only the small pairs' rows (u + tie indicator).
-            ties = jnp.concatenate(
-                [ts[: g1 - g0] for g0, g1, (_, _, ts) in outs], axis=0
-            ).T
+            if outs is None:
+                ties = jnp.take(jnp.concatenate(
+                    [o[2][: ids.size] for ids, o in parts], axis=0
+                ), jinv, axis=0).T
+            else:
+                ties = jnp.concatenate(
+                    [ts[: g1 - g0] for g0, g1, (_, _, ts) in outs], axis=0
+                ).T
             rows = jnp.asarray(small)
             u_small, tie_small = jax.device_get(
                 (u_stat[rows], ties[rows])
@@ -395,8 +457,13 @@ def _run_wilcox(
     mesh=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Host-array form of ``_run_wilcox_device`` (tests, small callers)."""
+    from scconsensus_tpu.io.sparsemat import is_sparse
+
+    jdata = None
+    if mesh is None and not is_sparse(data):
+        jdata = jnp.asarray(np.ascontiguousarray(data, np.float32))
     lp, u = _run_wilcox_device(
-        data, cell_idx_of, pair_i, pair_j, exact=exact, mesh=mesh
+        data, cell_idx_of, pair_i, pair_j, exact=exact, mesh=mesh, jdata=jdata
     )
     return np.asarray(lp), np.asarray(u)
 
@@ -468,7 +535,9 @@ def pairwise_de(
     with timer.stage("aggregates", n_clusters=K, n_pairs=int(pair_i.size)):
         # The matrix crosses host→device exactly once per run; every later
         # stage reuses jdata.
-        jdata = None if is_sparse(data) else jnp.asarray(data)
+        from scconsensus_tpu.utils.devcache import device_put_cached
+
+        jdata = None if is_sparse(data) else device_put_cached(data)
         onehot = np.zeros((N, K), np.float32)
         valid = cell_idx >= 0
         onehot[np.nonzero(valid)[0], cell_idx[valid]] = 1.0

@@ -29,11 +29,14 @@ _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _LOAD_ERROR: Optional[Exception] = None
 
-# Generic flags only: the built .so must be valid on any host that clones the
-# repo (no -march=native), and the artifact is never committed — it is keyed
-# by a content hash of the source + compiler so a stale or foreign binary can
-# never be picked up by accident.
-_CFLAGS = ["-O3", "-fopenmp", "-shared", "-fPIC", "-std=c++17"]
+# The artifact is never committed — it is keyed by a content hash of the
+# source + flags + compiler so a stale or foreign binary can never be picked
+# up by accident, and it is always (re)built by the host that loads it, so
+# -march=native is safe; the generic set is the fallback for compilers that
+# reject it (measured 1.27× on the 26k flagship NN-chain scan).
+_CFLAGS = ["-O3", "-march=native", "-funroll-loops", "-fopenmp", "-shared",
+           "-fPIC", "-std=c++17"]
+_CFLAGS_FALLBACK = ["-O3", "-fopenmp", "-shared", "-fPIC", "-std=c++17"]
 
 
 def _compiler_tag() -> str:
@@ -43,7 +46,28 @@ def _compiler_tag() -> str:
         ).stdout.splitlines()[0]
     except Exception:
         out = "g++-unknown"
-    return out
+    return out + "\x00" + _cpu_tag()
+
+
+def _cpu_tag() -> str:
+    """CPU identity folded into the .so cache key: with -march=native a
+    binary cached on a shared filesystem (NFS home, baked container image)
+    must never be dlopened by a host with a different microarchitecture —
+    SIGILL there kills the process before the numpy fallback can catch
+    anything."""
+    import platform
+
+    tag = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("model name", "flags", "Features")):
+                    tag += "\x00" + line.strip()
+                    if line.startswith(("flags", "Features")):
+                        break
+    except OSError:
+        tag += "\x00" + platform.processor()
+    return tag
 
 
 def _so_path() -> str:
@@ -59,9 +83,14 @@ def _build(so: str) -> None:
     # pid-unique tmp: concurrent first builds from separate processes must
     # not interleave writes into one tmp file (os.replace is then atomic).
     tmp = f"{so}.tmp.{os.getpid()}.so"
-    cmd = ["g++", *_CFLAGS, _SRC, "-o", tmp]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        try:
+            subprocess.run(["g++", *_CFLAGS, _SRC, "-o", tmp],
+                           check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError:
+            # e.g. a compiler without -march=native for this target
+            subprocess.run(["g++", *_CFLAGS_FALLBACK, _SRC, "-o", tmp],
+                           check=True, capture_output=True, text=True)
         os.replace(tmp, so)
     finally:
         if os.path.exists(tmp):

@@ -84,6 +84,7 @@ def ranksum_body(
     pair_i: jnp.ndarray,    # (P,) cluster index of group 1 per pair
     pair_j: jnp.ndarray,    # (P,)
     n_clusters: int,
+    window: int = 0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Rank-sum log-p for every (gene, pair) of one gene chunk.
 
@@ -91,21 +92,43 @@ def ranksum_body(
     dropped clusters or subsampled-out cells) occupy sorted positions but
     contribute to no cluster count. Pure local compute (no collectives) —
     safe to shard_map over the gene axis.
+
+    ``window`` > 0 enables the zero-block decomposition for sparse rows
+    (expression data is mostly zeros): values sort DESCENDING so the ≤
+    ``window`` positive entries land in a prefix window, the (Gc, K, ·)
+    scan/contraction machinery runs at the window width instead of N, and
+    the giant all-zero tie block enters through closed-form corrections —
+    with z_k the per-gene zero count of cluster k and U′ the above-or-tied
+    dominance count among window cells,
+
+        U[i,j]  = n_i·n_j − (U′[i,j] + z_i·nnz_j + z_i·z_j/2),
+        B[k,l]  = B′[k,l] + z_k²·z_l        (zero run of the tie moments).
+
+    Requires every gene in the chunk to have ≤ ``window`` positive cells
+    and no negative values (log-normalized expression); callers bucket
+    genes by nnz (see engine._run_wilcox_device).
     """
     Gc, N = chunk.shape
     K = n_clusters
+    sparse_mode = 0 < window < N
     # One variadic sort carries the cluster ids along with the values.
+    # Sparse mode sorts the negated values: positives first, zeros last.
+    key = -chunk if sparse_mode else chunk
     sv, scid = jax.lax.sort(
-        (chunk, jnp.broadcast_to(cid, chunk.shape)), dimension=1, num_keys=1
+        (key, jnp.broadcast_to(cid, chunk.shape)), dimension=1, num_keys=1
     )
-    # (Gc, K, N): cells on the minor (lane) axis.
+    if sparse_mode:
+        sv = sv[:, :window]
+        scid = jnp.where(sv < 0, scid[:, :window], -1)  # window zeros inert
+    W = sv.shape[1]
+    # (Gc, K, W): cells on the minor (lane) axis.
     C = (scid[:, None, :] == jnp.arange(K, dtype=jnp.int32)[None, :, None]
          ).astype(jnp.float32)
     S = jnp.cumsum(C, axis=-1)                              # inclusive
 
     new_run = jnp.concatenate(
         [jnp.ones((Gc, 1), bool), sv[:, 1:] != sv[:, :-1]], axis=1
-    )[:, None, :]                                           # (Gc, 1, N)
+    )[:, None, :]                                           # (Gc, 1, W)
     is_end = jnp.concatenate(
         [new_run[:, :, 1:], jnp.ones((Gc, 1, 1), bool)], axis=2
     )
@@ -117,7 +140,7 @@ def ranksum_body(
     # to +big backward-fills the through-run totals.
     L = jax.lax.cummax(jnp.where(new_run, S - C, -1.0), axis=2)
     T = jax.lax.cummin(
-        jnp.where(is_end, S, jnp.float32(N + 1)), axis=2, reverse=True
+        jnp.where(is_end, S, jnp.float32(W + 1)), axis=2, reverse=True
     )
     E = T - L                                               # equal counts
 
@@ -127,7 +150,7 @@ def ranksum_body(
     # Tie correction Σ_runs(t³−t) per pair from one run-moment contraction:
     # B[k,l] = Σ_runs r_k² r_l = Σ_p C[k,p]·e(p)·E[l,p] with e(p) the cell's
     # own-run count (Σ_p C_k e E_l sums r_k·r_k·r_l over each run's k-cells).
-    own_eq = jnp.sum(C * E, axis=1)                         # (Gc, N)
+    own_eq = jnp.sum(C * E, axis=1)                         # (Gc, W)
     B = jnp.einsum(
         "gkn,gln->gkl", C * own_eq[:, None, :], E, precision=_HIGHEST
     )
@@ -148,6 +171,25 @@ def ranksum_body(
 
     n1 = n_of[pair_i].astype(jnp.float32)                   # (P,)
     n2 = n_of[pair_j].astype(jnp.float32)
+
+    if sparse_mode:
+        # Zero-block corrections. nnz/z per (gene, cluster) from the window
+        # counts; pair columns via the same one-hot contractions.
+        nnz_k = jnp.sum(C, axis=-1)                         # (Gc, K)
+        z_k = jnp.maximum(n_of.astype(jnp.float32)[None, :] - nnz_k, 0.0)
+        nnz_j = jnp.dot(nnz_k, sel_j.T, precision=_HIGHEST)  # (Gc, P)
+        z_i = jnp.dot(z_k, sel_i.T, precision=_HIGHEST)
+        z_j = jnp.dot(z_k, sel_j.T, precision=_HIGHEST)
+        # u currently holds U′ (descending order = above-or-tied dominance)
+        u = n1[None, :] * n2[None, :] - (
+            u + z_i * nnz_j + 0.5 * z_i * z_j
+        )
+        # zero-run tie moments: B_full[k,l] = B′[k,l] + z_k²·z_l
+        d_i = d_i + z_i * z_i * z_i
+        d_j = d_j + z_j * z_j * z_j
+        b_ij = b_ij + z_i * z_i * z_j
+        b_ji = b_ji + z_j * z_j * z_i
+
     tie_sum = d_i + d_j + 3.0 * (b_ij + b_ji) - (n1 + n2)[None, :]
     rs1 = u + n1 * (n1 + 1.0) / 2.0
     log_p, u_out = wilcoxon_from_ranks(rs1, tie_sum, n1, n2)
@@ -156,4 +198,6 @@ def ranksum_body(
 
 # Single-device jitted entry; the sharded form lives in
 # parallel.sharded_de.sharded_allpairs_ranksum and shard_maps the same body.
-allpairs_ranksum_chunk = jax.jit(ranksum_body, static_argnames=("n_clusters",))
+allpairs_ranksum_chunk = jax.jit(
+    ranksum_body, static_argnames=("n_clusters", "window")
+)

