@@ -350,7 +350,7 @@ def _run_wilcox_device(
         n_dev = int(mesh.devices.size)
         gc = max(gc, n_dev * 8)
 
-    windowed = mesh is None and jdata is not None
+    windowed = jdata is not None
     if windowed:
         # nnz over ALL cells (excluded cells still occupy window slots) and
         # a negativity check (the decomposition needs zeros as the minimum).
@@ -371,8 +371,15 @@ def _run_wilcox_device(
             w = int(
                 min(_next_pow2(max(int(nnz_sorted[g0]), 1024)), _next_pow2(N))
             )
-            gcb = max(8, _ALLPAIRS_ELEM_BUDGET // max(w * K, 1))
+            # block size respects BOTH working sets: the (gcb, K, w) scan
+            # tensors and the (gcb, N) full-width sort buffers — w·K alone
+            # ignores N and could pad a small-K run to a >10 GB sort.
+            gcb = max(8, min(
+                _ALLPAIRS_ELEM_BUDGET // max(w * K, 1),
+                (_ALLPAIRS_ELEM_BUDGET // 2) // max(N, 1),
+            ))
             gcb = 1 << (int(gcb).bit_length() - 1)
+            gcb = min(gcb, _next_pow2(G))
             # every gene in the block must fit the block's window
             g1 = g0
             while (g1 < G and g1 - g0 < gcb
@@ -382,10 +389,16 @@ def _run_wilcox_device(
             rows = jnp.take(jdata, jnp.asarray(ids), axis=0)
             if ids.size < gcb:
                 rows = jnp.pad(rows, ((0, gcb - ids.size), (0, 0)))
-            out = allpairs_ranksum_chunk(
-                rows, jcid, jn, jpi, jpj, K,
-                window=(w if w < N else 0),
-            )
+            if mesh is not None:
+                out = sharded_allpairs_ranksum(
+                    rows, jcid, jn, jpi, jpj, K, mesh=mesh,
+                    window=(w if w < N else 0),
+                )
+            else:
+                out = allpairs_ranksum_chunk(
+                    rows, jcid, jn, jpi, jpj, K,
+                    window=(w if w < N else 0),
+                )
             parts.append((ids, out))
             g0 = g1
         inv = np.empty(G, np.int64)

@@ -87,8 +87,12 @@ def _build(so: str) -> None:
         try:
             subprocess.run(["g++", *_CFLAGS, _SRC, "-o", tmp],
                            check=True, capture_output=True, text=True)
-        except subprocess.CalledProcessError:
-            # e.g. a compiler without -march=native for this target
+        except subprocess.CalledProcessError as e:
+            # Retry with generic flags only for a flag rejection; a genuine
+            # source error must propagate with ITS diagnostics, not the
+            # fallback's, and must not pay a doubled compile.
+            if "march" not in (e.stderr or ""):
+                raise
             subprocess.run(["g++", *_CFLAGS_FALLBACK, _SRC, "-o", tmp],
                            check=True, capture_output=True, text=True)
         os.replace(tmp, so)

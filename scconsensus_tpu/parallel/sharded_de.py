@@ -107,6 +107,7 @@ def sharded_allpairs_ranksum(
     n_clusters: int,
     mesh: Optional[Mesh] = None,
     axis_name: str = CELL_AXIS,
+    window: int = 0,
 ):
     """Gene-sharded all-pairs rank-sum (ops.ranksum_allpairs.ranksum_body
     shard_mapped over the gene-chunk axis; cid/pair tensors replicated).
@@ -114,6 +115,8 @@ def sharded_allpairs_ranksum(
     chunk: (Gc, N); returns (log_p, u, tie_sum), each (Gc, P) — identical to
     the single-device ``allpairs_ranksum_chunk``. The gene axis is padded to
     the shard count; padded all-zero rows produce NaN and are sliced off.
+    ``window``: zero-block decomposition width (see ranksum_body) — genes
+    are local to a shard, so the sparse-window mode shards unchanged.
     """
     mesh = mesh or make_mesh(axis_name=axis_name)
     n_shards = int(mesh.devices.size)
@@ -121,18 +124,20 @@ def sharded_allpairs_ranksum(
     pad = (-gc) % n_shards
     if pad:
         chunk = jnp.pad(chunk, ((0, pad), (0, 0)))
-    lp, u, ts = _jitted_allpairs(mesh, axis_name, n_clusters)(
+    lp, u, ts = _jitted_allpairs(mesh, axis_name, n_clusters, window)(
         chunk, cid, n_of, pair_i, pair_j
     )
     return lp[:gc], u[:gc], ts[:gc]
 
 
 @lru_cache(maxsize=32)
-def _jitted_allpairs(mesh: Mesh, axis_name: str, n_clusters: int):
+def _jitted_allpairs(mesh: Mesh, axis_name: str, n_clusters: int,
+                     window: int = 0):
     from scconsensus_tpu.ops.ranksum_allpairs import ranksum_body
 
     def local(chunk_loc, cid, n_of, pair_i, pair_j):
-        return ranksum_body(chunk_loc, cid, n_of, pair_i, pair_j, n_clusters)
+        return ranksum_body(chunk_loc, cid, n_of, pair_i, pair_j, n_clusters,
+                            window=window)
 
     return jax.jit(
         jax.shard_map(

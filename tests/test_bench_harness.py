@@ -1,0 +1,68 @@
+"""bench.py robustness contract (VERDICT r2 #3): a section failure must not
+take down the other sections, every exit prints ONE parseable JSON line, and
+the orchestrator's failure ladder ends in a structured record — r02 recorded
+nothing because none of this held."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+BENCH = str(pathlib.Path(__file__).parent.parent / "bench.py")
+
+
+def _run(env_over, timeout=900):
+    env = dict(os.environ)
+    env.pop("SCC_BENCH_CRASH", None)
+    env.update(env_over)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line in stdout; stderr tail: {proc.stderr[-500:]}"
+    # the LAST json line is the driver-facing record
+    return proc, json.loads(lines[-1])
+
+
+def test_crashed_section_does_not_kill_the_others():
+    proc, rec = _run({
+        "SCC_BENCH_CONFIG": "quick",
+        "SCC_BENCH_NO_FORK": "1",
+        "SCC_BENCH_CRASH": "edger",
+        "SCC_BENCH_PLATFORM": "cpu",
+    })
+    assert proc.returncode == 0
+    extra = rec["extra"]
+    assert "edger_error" in extra
+    # the wilcox section still produced a number and became the headline
+    assert "wilcox_s" in extra
+    assert rec["value"] == extra["wilcox_s"]
+    assert "wilcox" in rec["metric"]
+    # an edgeR-baseline ratio against a wilcox time would be inflated
+    assert rec["vs_baseline"] == 0.0
+
+
+def test_all_attempts_failed_yields_structured_record():
+    proc, rec = _run({
+        "SCC_BENCH_CONFIG": "quick",
+        "SCC_BENCH_TIMEOUT_SCALE": "0.001",  # every attempt times out ~1s
+    }, timeout=300)
+    assert proc.returncode == 0
+    assert rec["value"] == -1
+    assert rec["extra"]["failures"]
+    assert all(f["outcome"] == "timeout" for f in rec["extra"]["failures"])
+    # driver tail-window contract: the record must stay small
+    assert len(json.dumps(rec)) < 2000
+
+
+def test_final_line_fits_driver_tail_window():
+    _, rec = _run({
+        "SCC_BENCH_CONFIG": "quick",
+        "SCC_BENCH_NO_FORK": "1",
+        "SCC_BENCH_PLATFORM": "cpu",
+    })
+    assert len(json.dumps(rec)) < 2000
+    assert rec["value"] > 0

@@ -221,10 +221,10 @@ class TestSparseWindowRanksum:
     (and therefore scipy) on sparse data with ties, all-zero genes, and
     excluded cells."""
 
-    def _setup(self, rng, n=400, g=60, k=4):
+    def _setup(self, rng, n=400, g=60, k=4, max_nnz_frac=0.5):
         data = np.zeros((g, n), np.float32)
         for row in range(g):
-            nnz = int(rng.integers(0, n // 2))  # includes all-zero genes
+            nnz = int(rng.integers(0, int(n * max_nnz_frac)))  # incl. all-zero
             idx = rng.choice(n, size=nnz, replace=False)
             # quantized values force cross-cluster ties among positives
             data[row, idx] = np.round(rng.gamma(2.0, size=nnz) * 4) / 4 + 0.25
@@ -234,12 +234,46 @@ class TestSparseWindowRanksum:
         pi, pj = _all_pairs(k)
         return data, cell_idx_of, pi, pj
 
-    def test_windowed_matches_full(self, rng):
+    def test_kernel_window_matches_full(self, rng):
+        """Direct kernel check: sparse_mode (explicit window < N) against
+        the full-width kernel, no ladder in between."""
         import jax.numpy as jnp
 
         from scconsensus_tpu.ops.ranksum_allpairs import allpairs_ranksum_chunk
 
         data, cell_idx_of, pi, pj = self._setup(rng)
+        n_of = np.array([ci.size for ci in cell_idx_of], np.int32)
+        cid = _cid_from_groups(cell_idx_of, data.shape[1])
+        args = (jnp.asarray(data), jnp.asarray(cid), jnp.asarray(n_of),
+                jnp.asarray(pi), jnp.asarray(pj))
+        lp_full, u_full, ts_full = allpairs_ranksum_chunk(
+            *args, n_clusters=len(cell_idx_of)
+        )
+        # max nnz is n/2 = 200; window 256 genuinely exercises sparse_mode
+        lp_win, u_win, ts_win = allpairs_ranksum_chunk(
+            *args, n_clusters=len(cell_idx_of), window=256
+        )
+        np.testing.assert_allclose(
+            np.asarray(u_win), np.asarray(u_full), atol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(ts_win), np.asarray(ts_full), rtol=1e-6, atol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(lp_win), np.asarray(lp_full), rtol=2e-4, atol=1e-4
+        )
+
+    def test_engine_ladder_matches_full(self, rng):
+        """Engine path: N > the 1024 window floor so the nnz ladder actually
+        selects sparse windows (w < N) for most genes."""
+        import jax.numpy as jnp
+
+        from scconsensus_tpu.de.engine import _run_wilcox_device
+        from scconsensus_tpu.ops.ranksum_allpairs import allpairs_ranksum_chunk
+
+        data, cell_idx_of, pi, pj = self._setup(
+            rng, n=1600, g=24, k=3, max_nnz_frac=0.3  # nnz ≤ 480 < 1024 < N
+        )
         lp_win, u_win = _run_wilcox(data, cell_idx_of, pi, pj, exact="never")
         n_of = np.array([ci.size for ci in cell_idx_of], np.int32)
         cid = _cid_from_groups(cell_idx_of, data.shape[1])
@@ -255,7 +289,10 @@ class TestSparseWindowRanksum:
     def test_windowed_matches_scipy(self, rng):
         from scipy.stats import mannwhitneyu
 
-        data, cell_idx_of, pi, pj = self._setup(rng, n=200, g=25, k=3)
+        # N > 1024 floor and nnz ≤ 0.3·N: the ladder takes sparse windows
+        data, cell_idx_of, pi, pj = self._setup(
+            rng, n=1400, g=25, k=3, max_nnz_frac=0.3
+        )
         lp, _ = _run_wilcox(data, cell_idx_of, pi, pj, exact="never")
         for p in range(pi.size):
             a = data[:, cell_idx_of[pi[p]]]
