@@ -87,14 +87,16 @@ def _build(so: str) -> None:
         try:
             subprocess.run(["g++", *_CFLAGS, _SRC, "-o", tmp],
                            check=True, capture_output=True, text=True)
-        except subprocess.CalledProcessError as e:
-            # Retry with generic flags only for a flag rejection; a genuine
-            # source error must propagate with ITS diagnostics, not the
-            # fallback's, and must not pay a doubled compile.
-            if "march" not in (e.stderr or ""):
-                raise
-            subprocess.run(["g++", *_CFLAGS_FALLBACK, _SRC, "-o", tmp],
-                           check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as primary:
+            # Retry with generic flags (covers every flavor of target-flag
+            # failure, not just parse-time -march rejection); if the
+            # fallback fails too it was a genuine source error — surface
+            # the PRIMARY diagnostics, not the fallback's.
+            try:
+                subprocess.run(["g++", *_CFLAGS_FALLBACK, _SRC, "-o", tmp],
+                               check=True, capture_output=True, text=True)
+            except subprocess.CalledProcessError:
+                raise primary from None
         os.replace(tmp, so)
     finally:
         if os.path.exists(tmp):

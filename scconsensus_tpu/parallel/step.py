@@ -54,11 +54,20 @@ def _build_step(agg_fn, wilcox_fn, sil_fn, *, min_pct, log_fc_thrs, q_val_thrs, 
         # 4. BH over surviving genes + DE call (G-sized sort per pair)
         log_q = bh_adjust_masked(log_p, gate)
         de = gate & (log_q < jnp.log(jnp.float32(q_val_thrs)))
-        # 5. embed on a fixed-size top-score gene panel (static shapes:
-        #    jit-safe stand-in for the data-dependent DE union; the real
-        #    pipeline re-gathers on the union host-side between steps)
+        # 5. embed on a fixed-size panel of the strongest DE genes — the
+        #    static-shape stand-in for the data-dependent union, ranked by
+        #    the pipeline's own criterion (per-gene best |logFC| among DE
+        #    calls, de_gene_union's ordering); genes with no DE call rank
+        #    after every DE gene. The real pipeline re-gathers on the exact
+        #    union host-side between steps.
+        de_score = jnp.max(jnp.where(de, jnp.abs(log_fc), -jnp.inf), axis=0)
+        # Non-DE genes rank below every DE gene but among themselves by
+        # expression (no-DE regimes must not embed an arbitrary index-order
+        # panel); the +10 offset dominates the [0, 1) variance tiebreak.
         var = agg.sum_expm1.sum(axis=1)
-        _, top_idx = jax.lax.top_k(var, min(64, data.shape[0]))
+        var_rank = var / (jnp.max(var) + 1e-30)
+        score = jnp.where(jnp.isfinite(de_score), de_score + 10.0, var_rank)
+        _, top_idx = jax.lax.top_k(score, min(64, data.shape[0]))
         scores = pca_scores(data[top_idx].T, n_pcs)
         # 6. silhouette sufficient statistics over the embedding
         sil_sums = sil_fn(scores, onehot)

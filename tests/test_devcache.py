@@ -24,8 +24,9 @@ def test_entry_dies_with_array_or_is_evicted():
     # CPU backends may alias the host buffer (device array keeps it alive);
     # then the weakref can't fire — the FIFO cap bounds retention instead.
     if key in _cache:
-        for _ in range(_MAX_ENTRIES):
-            device_put_cached(np.zeros((2, 2), np.float32))
+        fillers = [np.zeros((2, 2), np.float32) for _ in range(_MAX_ENTRIES)]
+        for f in fillers:  # held alive so their entries can't self-remove
+            device_put_cached(f)
     assert key not in _cache
     assert len(_cache) <= _MAX_ENTRIES
 
