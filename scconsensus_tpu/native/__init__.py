@@ -70,13 +70,27 @@ def _cpu_tag() -> str:
     return tag
 
 
+def _build_dir() -> str:
+    """Directory for built artifacts: next to the source when writable (the
+    repo-checkout case), else a per-user cache dir — a pip install into
+    read-only site-packages must not silently lose the native path."""
+    if os.access(_DIR, os.W_OK):
+        return _DIR
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "scconsensus_tpu",
+    )
+    os.makedirs(cache, exist_ok=True)
+    return cache
+
+
 def _so_path() -> str:
     with open(_SRC, "rb") as f:
         src = f.read()
     key = hashlib.sha256(
         src + ("\x00".join(_CFLAGS) + "\x00" + _compiler_tag()).encode()
     ).hexdigest()[:16]
-    return os.path.join(_DIR, f"libscc_native-{key}.so")
+    return os.path.join(_build_dir(), f"libscc_native-{key}.so")
 
 
 def _build(so: str) -> None:
@@ -107,9 +121,10 @@ def _cleanup_stale(keep: str) -> None:
     """Drop orphaned builds of older source revisions. Called only after a
     successful CDLL load: a concurrent process that loses its .so to this
     unlink already has the inode mapped, so its handle stays valid."""
-    for f in os.listdir(_DIR):
+    base = os.path.dirname(keep)
+    for f in os.listdir(base):
         if f.startswith("libscc_native-") and f.endswith(".so"):
-            p = os.path.join(_DIR, f)
+            p = os.path.join(base, f)
             if p != keep:
                 try:
                     os.unlink(p)
