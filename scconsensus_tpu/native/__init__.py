@@ -122,6 +122,12 @@ def _cleanup_stale(keep: str) -> None:
     successful CDLL load: a concurrent process that loses its .so to this
     unlink already has the inode mapped, so its handle stays valid."""
     base = os.path.dirname(keep)
+    if base != _DIR:
+        # Shared per-user cache (read-only install): other environments may
+        # have live builds of other revisions here — deleting them causes
+        # rebuild thrash and an unlink/CDLL race. Only the repo-checkout
+        # case, where this revision owns the directory, gets cleanup.
+        return
     for f in os.listdir(base):
         if f.startswith("libscc_native-") and f.endswith(".so"):
             p = os.path.join(base, f)

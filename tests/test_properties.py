@@ -1,0 +1,85 @@
+"""Property tests promised by the build's test strategy (SURVEY.md §4):
+p-value uniformity under the null, permutation invariance over cell order,
+and monotonicity of the DE call in its thresholds."""
+
+import numpy as np
+import pytest
+
+from scconsensus_tpu.config import ReclusterConfig
+from scconsensus_tpu.de import pairwise_de
+from scconsensus_tpu.de.engine import _all_pairs, _run_wilcox
+
+
+def _null_data(rng, g=400, n=300):
+    """Two groups drawn from the SAME NB expression distribution."""
+    mu = rng.uniform(0.5, 4.0, size=(g, 1))
+    counts = rng.negative_binomial(2, 2 / (2 + mu), size=(g, n))
+    return np.log1p(counts).astype(np.float32)
+
+
+def test_null_pvalues_approximately_uniform(rng):
+    data = _null_data(rng)
+    half = data.shape[1] // 2
+    cell_idx_of = [
+        np.arange(half, dtype=np.int32),
+        np.arange(half, data.shape[1], dtype=np.int32),
+    ]
+    pi, pj = _all_pairs(2)
+    lp, _ = _run_wilcox(data, cell_idx_of, pi, pj, exact="never")
+    p = np.exp(lp[0])
+    p = p[np.isfinite(p)]
+    assert p.size > 300
+    # normal-approximation p-values under the null: mean ~1/2, mass in the
+    # lower decile ~10% (loose bounds — this is a sanity property, not a
+    # calibrated KS test)
+    assert abs(p.mean() - 0.5) < 0.06
+    assert abs((p < 0.1).mean() - 0.1) < 0.06
+    assert abs((p < 0.5).mean() - 0.5) < 0.08
+
+
+@pytest.mark.parametrize("method", ["wilcox", "edger"])
+def test_cell_order_permutation_invariance(rng, method):
+    from scconsensus_tpu.utils.synthetic import synthetic_scrna
+
+    data, truth, _ = synthetic_scrna(
+        n_genes=250, n_cells=240, n_clusters=3, seed=11,
+        n_markers_per_cluster=12,
+    )
+    labels = np.array([f"c{t}" for t in truth])
+    cfg = ReclusterConfig(method=method, min_cluster_size=5)
+    res1 = pairwise_de(data, labels, cfg)
+
+    perm = rng.permutation(data.shape[1])
+    res2 = pairwise_de(data[:, perm], labels[perm], cfg)
+
+    np.testing.assert_array_equal(res1.de_mask, res2.de_mask)
+    np.testing.assert_allclose(res1.log_p, res2.log_p, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(res1.log_fc, res2.log_fc, rtol=1e-4, atol=1e-5)
+
+
+def test_de_counts_monotone_in_thresholds(rng):
+    from scconsensus_tpu.utils.synthetic import synthetic_scrna
+
+    data, truth, _ = synthetic_scrna(
+        n_genes=300, n_cells=300, n_clusters=3, seed=3,
+        n_markers_per_cluster=15,
+    )
+    labels = np.array([f"c{t}" for t in truth])
+    prev = None
+    for q in (0.2, 0.05, 0.01):
+        cfg = ReclusterConfig(method="wilcox", q_val_thrs=q, min_cluster_size=5)
+        total = int(pairwise_de(data, labels, cfg).de_mask.sum())
+        if prev is not None:
+            assert total <= prev, (q, total, prev)
+        prev = total
+    assert prev is not None and prev >= 0
+    # and in the logFC threshold
+    prev = None
+    for f in (0.1, 0.5, 1.5):
+        cfg = ReclusterConfig(
+            method="wilcox", q_val_thrs=0.1, log_fc_thrs=f, min_cluster_size=5
+        )
+        total = int(pairwise_de(data, labels, cfg).de_mask.sum())
+        if prev is not None:
+            assert total <= prev, (f, total, prev)
+        prev = total
