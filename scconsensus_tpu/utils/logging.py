@@ -17,6 +17,24 @@ from typing import Any, Dict, List, Optional
 __all__ = ["get_logger", "StageTimer"]
 
 _FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
+_LOG_LIST_CAP = 16
+
+
+def _log_form(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """Log-line rendering of a stage record: long lists (e.g. the per-pair
+    DE counts at K=44 → 946 entries) are summarized; the STORED record —
+    what metrics/bench consumers read — keeps the full values."""
+    out: Dict[str, Any] = {}
+    for k, v in rec.items():
+        if isinstance(v, (list, tuple)) and len(v) > _LOG_LIST_CAP:
+            out[k] = {
+                "n": len(v),
+                "head": list(v[:_LOG_LIST_CAP]),
+                "sum": sum(v) if v and isinstance(v[0], (int, float)) else None,
+            }
+        else:
+            out[k] = v
+    return out
 
 
 def get_logger(name: str = "scconsensus_tpu") -> logging.Logger:
@@ -56,7 +74,7 @@ class StageTimer:
             if ann is not None:
                 ann.__exit__(None, None, None)
             self.records.append(rec)
-            self.logger.info("stage %s", json.dumps(rec, default=str))
+            self.logger.info("stage %s", json.dumps(_log_form(rec), default=str))
 
     def total_s(self) -> float:
         return sum(r.get("wall_s", 0.0) for r in self.records)
