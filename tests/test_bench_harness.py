@@ -58,6 +58,78 @@ def test_all_attempts_failed_yields_structured_record():
     assert len(json.dumps(rec)) < 2000
 
 
+def test_worker_sigterm_leaves_parseable_line_and_checkpoint(tmp_path):
+    """VERDICT r3 #1: the driver's timeout (SIGTERM → rc=124) must still
+    leave (a) a parseable JSON line in the output tail and (b) a checkpoint
+    file on disk. r03's bench printed only at the end, so rc=124 recorded
+    nothing."""
+    import signal
+    import time
+
+    ckpt = tmp_path / "ckpt.json"
+    env = dict(os.environ)
+    env.pop("SCC_BENCH_CRASH", None)
+    env.update({
+        "SCC_BENCH_CONFIG": "quick",
+        "SCC_BENCH_PLATFORM": "cpu",
+        "JAX_PLATFORMS": "cpu",
+        "SCC_BENCH_CKPT": str(ckpt),
+    })
+    proc = subprocess.Popen(
+        [sys.executable, BENCH, "--worker"], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    # the first cumulative partial line lands right after backend init
+    first = proc.stdout.readline()
+    assert first.strip().startswith("{"), first
+    rec = json.loads(first)
+    assert rec["extra"]["partial"] is True
+    assert ckpt.exists()
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=300)
+    lines = [l for l in (first + out).strip().splitlines()
+             if l.strip().startswith("{")]
+    last = json.loads(lines[-1])
+    # the freshest record survived the TERM, on stdout and on disk
+    assert last["extra"]["partial"] is True
+    disk = json.loads(ckpt.read_text())
+    assert disk["metric"]
+
+
+def test_checkpoint_partial_with_value_is_accepted_on_timeout(
+        tmp_path, monkeypatch):
+    """A timed-out attempt whose worker already checkpointed a real headline
+    value must surface that partial as the bench result, not a failure.
+    Drives the real _run_attempt: the worker is TERMed mid-startup and the
+    fresh checkpoint (standing in for one the worker wrote) is accepted."""
+    import bench as bench_mod
+
+    ckpt = tmp_path / "ckpt.json"
+    monkeypatch.setenv("SCC_BENCH_CKPT", str(ckpt))
+    monkeypatch.setenv("SCC_BENCH_CONFIG", "quick")
+    # Stand-in for a checkpoint the worker writes DURING the attempt: the
+    # freshness gate rejects anything older than the attempt start, so
+    # nudge the mtime forward past the Popen launch.
+    import time
+
+    ckpt.write_text(json.dumps({
+        "metric": "test-metric", "value": 12.5, "unit": "seconds",
+        "vs_baseline": 2.4, "extra": {"platform": "tpu"},
+    }))
+    future = time.time() + 1.0
+    os.utime(ckpt, (future, future))
+    parsed, failure = bench_mod._run_attempt(
+        "t", {"SCC_BENCH_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
+              "SCC_BENCH_HANG": "60"},  # worker hangs → attempt times out
+        timeout_s=2)
+    assert failure is None
+    assert parsed["value"] == 12.5
+    assert parsed["extra"]["partial"] is True
+    assert parsed["extra"]["attempt_outcome"] == "timeout"
+    # stale checkpoints (older than the orchestrator run) are rejected
+    assert bench_mod._read_ckpt(os.path.getmtime(ckpt) + 10) is None
+
+
 def test_final_line_fits_driver_tail_window():
     _, rec = _run({
         "SCC_BENCH_CONFIG": "quick",
