@@ -865,10 +865,34 @@ def main() -> None:
     t_start = time.time()
     _orchestrator_term_handler(t_start)
     probe = None
-    if plan is ATTEMPT_PLANS["default"]:
+    # SCC_BENCH_NO_CPU_FALLBACK=1: an accelerator-evidence run (the tunnel
+    # watcher) — a CPU-degraded record must never overwrite TPU evidence,
+    # so a dead tunnel fails fast instead of rerouting to CPU.
+    no_cpu = bool(os.environ.get("SCC_BENCH_NO_CPU_FALLBACK"))
+    if no_cpu:
+        plan = [(l, e, t) for l, e, t in plan
+                if e.get("SCC_BENCH_PLATFORM") != "cpu"]
+        if not plan:  # e.g. --quick, whose only attempt is CPU-pinned
+            print(json.dumps({
+                "metric": "no accelerator attempt in plan "
+                          "(no-cpu-fallback mode)",
+                "value": -1, "unit": "seconds", "vs_baseline": 0.0,
+                "extra": {},
+            }))
+            return
+    if plan is ATTEMPT_PLANS["default"] or no_cpu:
         probe = _probe_backend()
         log(f"[bench] backend probe: {probe}")
-        if probe in ("hang", "error"):
+        # no-cpu mode also rejects a probe that silently resolved to the
+        # CPU backend: the run exists to produce accelerator evidence.
+        if probe in ("hang", "error") or (no_cpu and probe == "cpu"):
+            if no_cpu:
+                print(json.dumps({
+                    "metric": "backend probe failed (no-cpu-fallback mode)",
+                    "value": -1, "unit": "seconds", "vs_baseline": 0.0,
+                    "extra": {"backend_probe": probe},
+                }))
+                return
             # tunnel down: don't burn the primary/retry windows on a hung
             # backend init — go straight to the bounded CPU fallback
             plan = [("cpu-degraded", {"SCC_BENCH_PLATFORM": "cpu",

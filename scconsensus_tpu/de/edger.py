@@ -260,9 +260,11 @@ def run_edger_pairs(
     engine re-uses its aggregate upload) — without it a dense matrix is
     uploaded here, once.
 
-    The returned (P, G) arrays are DEVICE arrays when the input was dense:
-    through a slow device→host link only the consumer-touched fields should
-    ever cross (engine.PairwiseDEResult materializes per field, lazily).
+    The returned log_p/tagwise_disp are ALWAYS device arrays — on the
+    sparse path too, since they are assembled from device parts (z1/tw
+    chunks); log_fc/common_disp are host numpy. Through a slow device→host
+    link only the consumer-touched fields should ever cross
+    (engine.PairwiseDEResult materializes per field, lazily).
     """
     from scconsensus_tpu.de.engine import (
         _cid_from_groups,
@@ -499,11 +501,14 @@ def run_edger_pairs(
     # paying the global worst case. Results scatter back ON DEVICE.
     n1_host = n_of[pair_i]
     n2_host = n_of[pair_j]
+    # pow-2 ladder (was pow-4): a task pays ≤2× its own support width. The
+    # extra compiled bucket variants (7 vs 4 at s_max=4096) amortize across
+    # runs via the persistent compile cache.
     s_buckets = []
     sb = 64
     while sb < s_max:
         s_buckets.append(sb)
-        sb *= 4
+        sb *= 2
     s_buckets.append(s_max)
     lower = 0.5  # tot == 0 is a point mass (p = 1): the normal branch's value
     all_rows, all_vals = [], []
@@ -519,10 +524,13 @@ def run_edger_pairs(
         s2_b = jnp.asarray(s2[rows, cols])
         n1_b = jnp.asarray(n1_host[rows])
         n2_b = jnp.asarray(n2_host[rows])
-        tb = max(1024, _EXACT_TASK_ELEMS // sb)
+        tb_budget = max(1024, _EXACT_TASK_ELEMS // sb)
         outs = []
-        for t0 in range(0, rows.size, tb):
-            t1 = min(t0 + tb, rows.size)
+        for t0 in range(0, rows.size, tb_budget):
+            t1 = min(t0 + tb_budget, rows.size)
+            # pad to the pow-2 of the ACTUAL count (shape reuse), not the
+            # full budget: a 500-task bucket must not compute 500k rows
+            tb = min(tb_budget, _next_pow2(t1 - t0))
             pad = tb - (t1 - t0)
             pw = [(0, pad)]
             lp = nb_exact_test_logp(
@@ -549,8 +557,8 @@ def run_edger_pairs(
     log_fc = np.log(ab1) - np.log(ab2)
 
     return EdgerPairResult(
-        log_p=j_log_p,          # device (dense input); lazy-fetched upstream
+        log_p=j_log_p,           # device on every path; lazy-fetched upstream
         log_fc=log_fc.astype(np.float32),
         common_disp=common,
-        tagwise_disp=j_tagwise,  # device; lazy-fetched upstream
+        tagwise_disp=j_tagwise,  # device on every path; lazy-fetched upstream
     )

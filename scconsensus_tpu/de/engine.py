@@ -27,6 +27,7 @@ Engine flow:
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -86,13 +87,23 @@ class PairwiseDEResult:
             and v is not None
             and not isinstance(v, np.ndarray)
         ):
-            v = np.asarray(jax.device_get(v))
-            object.__setattr__(self, name, v)
+            # Materialization mutates on read: serialize it so concurrent
+            # readers (e.g. a background store.save racing the pipeline's
+            # de_mask access) can't issue duplicate device_gets (ADVICE r3).
+            with object.__getattribute__(self, "_fetch_lock"):
+                v = object.__getattribute__(self, name)  # re-check under lock
+                if not isinstance(v, np.ndarray):
+                    v = np.asarray(jax.device_get(v))
+                    object.__setattr__(self, name, v)
         elif name == "aux" and v is not None and any(
             not isinstance(a, np.ndarray) for a in v.values()
         ):
-            v = {k: np.asarray(a) for k, a in jax.device_get(v).items()}
-            object.__setattr__(self, name, v)
+            with object.__getattribute__(self, "_fetch_lock"):
+                v = object.__getattribute__(self, name)
+                if any(not isinstance(a, np.ndarray) for a in v.values()):
+                    v = {k: np.asarray(a)
+                         for k, a in jax.device_get(v).items()}
+                    object.__setattr__(self, name, v)
         return v
 
     @property
@@ -113,21 +124,23 @@ class PairwiseDEResult:
     _OPT_ARRAY_FIELDS = ("pct1", "pct2")
 
     def __post_init__(self):
+        object.__setattr__(self, "_fetch_lock", threading.Lock())
         if self.pair_skipped is None:
             self.pair_skipped = np.zeros(self.pair_i.shape[0], bool)
 
     def _materialize_all(self) -> None:
         """Fetch every still-on-device lazy field in ONE batched device_get
         (per-field getattr would pay a blocking link round-trip each)."""
-        pending = {
-            f: object.__getattribute__(self, f)
-            for f in self._LAZY_FIELDS
-            if object.__getattribute__(self, f) is not None
-            and not isinstance(object.__getattribute__(self, f), np.ndarray)
-        }
-        if pending:
-            for f, v in jax.device_get(pending).items():
-                object.__setattr__(self, f, np.asarray(v))
+        with object.__getattribute__(self, "_fetch_lock"):
+            pending = {
+                f: object.__getattribute__(self, f)
+                for f in self._LAZY_FIELDS
+                if object.__getattribute__(self, f) is not None
+                and not isinstance(object.__getattribute__(self, f), np.ndarray)
+            }
+            if pending:
+                for f, v in jax.device_get(pending).items():
+                    object.__setattr__(self, f, np.asarray(v))
 
     def to_store(self) -> Tuple[Dict[str, np.ndarray], Dict]:
         """(arrays, meta) for ArtifactStore — the single serialization point,
@@ -726,8 +739,9 @@ def pairwise_de(
                 mean_exprs_thrs=config.mean_scaling_factor * gate_mean,
                 mixed_spaces=config.compat.mean_gate_mixed_spaces,
             )
-        # (P, G) statistics stay device arrays end to end (sparse inputs ride
-        # the host path and arrive numpy — both shapes work below).
+        # log_p/tagwise arrive as device arrays regardless of input sparsity
+        # (assembled from device chunks in de.edger); log_fc is numpy.
+        # _expand_rows_any accepts both forms.
         log_p = _expand_rows_any(nb.log_p, ok_rows, P)
         log_fc = _expand_rows(nb.log_fc, ok_rows, P)
         with timer.stage("bh_adjust"):

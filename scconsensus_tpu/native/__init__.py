@@ -84,37 +84,41 @@ def _build_dir() -> str:
     return cache
 
 
-def _so_path() -> str:
+def _so_path(flags: list) -> str:
+    """Cache path keyed by source + the EXACT flag set the binary was built
+    with (a -march=native binary and its generic fallback get distinct
+    paths, so the content-hash key always describes the artifact)."""
     with open(_SRC, "rb") as f:
         src = f.read()
     key = hashlib.sha256(
-        src + ("\x00".join(_CFLAGS) + "\x00" + _compiler_tag()).encode()
+        src + ("\x00".join(flags) + "\x00" + _compiler_tag()).encode()
     ).hexdigest()[:16]
     return os.path.join(_build_dir(), f"libscc_native-{key}.so")
 
 
-def _build(so: str) -> None:
-    # pid-unique tmp: concurrent first builds from separate processes must
-    # not interleave writes into one tmp file (os.replace is then atomic).
-    tmp = f"{so}.tmp.{os.getpid()}.so"
-    try:
+def _build() -> str:
+    """Compile and return the path of the artifact actually produced."""
+    primary_err = None
+    for flags in (_CFLAGS, _CFLAGS_FALLBACK):
+        so = _so_path(flags)
+        # pid-unique tmp: concurrent first builds from separate processes
+        # must not interleave writes into one tmp (os.replace is atomic).
+        tmp = f"{so}.tmp.{os.getpid()}.so"
         try:
-            subprocess.run(["g++", *_CFLAGS, _SRC, "-o", tmp],
+            subprocess.run(["g++", *flags, _SRC, "-o", tmp],
                            check=True, capture_output=True, text=True)
-        except subprocess.CalledProcessError as primary:
+            os.replace(tmp, so)
+            return so
+        except subprocess.CalledProcessError as e:
             # Retry with generic flags (covers every flavor of target-flag
             # failure, not just parse-time -march rejection); if the
             # fallback fails too it was a genuine source error — surface
             # the PRIMARY diagnostics, not the fallback's.
-            try:
-                subprocess.run(["g++", *_CFLAGS_FALLBACK, _SRC, "-o", tmp],
-                               check=True, capture_output=True, text=True)
-            except subprocess.CalledProcessError:
-                raise primary from None
-        os.replace(tmp, so)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+            primary_err = primary_err or e
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    raise primary_err
 
 
 def _cleanup_stale(keep: str) -> None:
@@ -146,9 +150,13 @@ def _load() -> ctypes.CDLL:
         if _LOAD_ERROR is not None:
             raise _LOAD_ERROR
         try:
-            so = _so_path()
-            if not os.path.exists(so):
-                _build(so)
+            so = next(
+                (p for p in (_so_path(_CFLAGS), _so_path(_CFLAGS_FALLBACK))
+                 if os.path.exists(p)),
+                None,
+            )
+            if so is None:
+                so = _build()
             lib = ctypes.CDLL(so)
             fn = lib.scc_ward_nnchain
             fn.restype = ctypes.c_int

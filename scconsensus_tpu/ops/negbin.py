@@ -402,11 +402,22 @@ def nb_exact_test_logp(
     )
     valid = a <= sc
     u = jnp.where(valid, u, -jnp.inf)
-    log_z = jsp.logsumexp(u, axis=-1)
+    # One exp sweep serves Z and both tails (three masked logsumexps each
+    # paid their own max+exp pass over the support — the exp is the cost).
+    # Tails are linear-space relative to the mode: a tail whose mass is
+    # below ~e^-87 of the mode underflows to the 1e-40 floor, i.e. log p
+    # saturates near -87 instead of tracking arbitrarily far — far beyond
+    # any DE threshold, and BH compares in log space unaffected.
+    m = jnp.max(u, axis=-1, keepdims=True)
+    e = jnp.where(valid, jnp.exp(u - m), 0.0)
+    z = jnp.sum(e, axis=-1)
     lower = a <= s1r[..., None]
     upper = a >= s1r[..., None]
-    log_pl_exact = jsp.logsumexp(jnp.where(lower, u, -jnp.inf), axis=-1) - log_z
-    log_pu_exact = jsp.logsumexp(jnp.where(upper, u, -jnp.inf), axis=-1) - log_z
+    pl_lin = jnp.sum(jnp.where(lower, e, 0.0), axis=-1)
+    pu_lin = jnp.sum(jnp.where(upper, e, 0.0), axis=-1)
+    log_z = jnp.log(jnp.maximum(z, 1e-40))
+    log_pl_exact = jnp.log(jnp.maximum(pl_lin, 1e-40)) - log_z
+    log_pu_exact = jnp.log(jnp.maximum(pu_lin, 1e-40)) - log_z
 
     # --- normal branch (s >= s_max) ---
     log_pl_norm, log_pu_norm = _normal_tails(s1r, s, alpha, beta)

@@ -10,43 +10,65 @@ over four rounds).
 
 Entries are keyed by the array's identity and die with it (weakref
 finalizer), so the cache can never outlive or alias its host array. Hits are
-additionally guarded by a strided content sentinel: a caller that mutates
-the cached array in place (the matrix is user-supplied) gets a cache miss
-and a fresh upload, not silently stale device data.
+additionally guarded by a content sentinel: a caller that mutates the cached
+array in place (the matrix is user-supplied) gets a cache miss and a fresh
+upload, not silently stale device data.
+
+Cost model (ADVICE r3): the full-array f64 sum pass (~0.2 s/1.5 GB) runs at
+insert time and on every hit. Arming it lazily at the first hit was tried
+and is unsound — a mutation between insert and first hit would be baked
+into the baseline, poisoning every later verification — so the insert-time
+pass stays; what ADVICE's cost concern bought instead is the entry cap of 2
+(was 4: ~6 GB of pinned HBM at flagship sizes) and eviction + one retry on
+device allocation failure.
 """
 
 from __future__ import annotations
 
 import hashlib
 import weakref
-from typing import Dict, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 
 __all__ = ["device_put_cached"]
 
-_cache: Dict[int, Tuple[object, bytes, object]] = {}
+
+class _Entry:
+    __slots__ = ("ref", "sample", "full_sum", "buf")
+
+    def __init__(self, ref, sample: bytes, full_sum: float, buf):
+        self.ref = ref
+        self.sample = sample
+        self.full_sum = full_sum  # insert-time baseline (see module docstring)
+        self.buf = buf
+
+
+_cache: Dict[int, _Entry] = {}
 _SENTINEL_SAMPLES = 4096
 # Bounded: on CPU backends jnp.asarray may alias the host buffer, in which
 # case the cached device array keeps its host array alive and the weakref
-# finalizer never fires — a cap keeps worst-case retention finite.
-_MAX_ENTRIES = 4
+# finalizer never fires — a cap keeps worst-case retention finite. Two
+# entries cover the realistic reuse pattern (log data + expm1 counts);
+# pinning four flagship-sized buffers was ~6 GB of HBM (ADVICE r3).
+_MAX_ENTRIES = 2
 
 
-def _sentinel(x: np.ndarray) -> bytes:
-    """Content fingerprint: shape/dtype + full-pass f64 sum + a strided
-    element sample. The full sum (one memory-bandwidth pass, ~0.2 s at
-    1.5 GB — still 5-30× cheaper than the upload it saves) catches partial
-    in-place edits the sparse sample would miss (e.g. zeroing one gene row);
-    the sample catches sum-preserving permutations."""
+def _sample_hash(x: np.ndarray) -> bytes:
+    """Cheap fingerprint: shape/dtype + a strided element sample."""
     flat = x.reshape(-1)
     step = max(1, flat.size // _SENTINEL_SAMPLES)
     sample = np.ascontiguousarray(flat[::step])
     h = hashlib.sha256()
     h.update(str((x.shape, x.dtype.str)).encode())
-    h.update(np.float64(np.sum(flat, dtype=np.float64)).tobytes())
     h.update(sample.tobytes())
     return h.digest()
+
+
+def _full_sum(x: np.ndarray) -> float:
+    """One memory-bandwidth pass; catches partial in-place edits the strided
+    sample misses (e.g. zeroing one gene row)."""
+    return float(np.sum(x.reshape(-1), dtype=np.float64))
 
 
 def device_put_cached(x: np.ndarray):
@@ -57,19 +79,32 @@ def device_put_cached(x: np.ndarray):
     import jax.numpy as jnp
 
     key = id(x)
-    sent = _sentinel(x)
+    sample = _sample_hash(x)
     ent = _cache.get(key)
     if ent is not None:
-        host = ent[0]()
-        if host is x and ent[1] == sent:
-            return ent[2]
+        host = ent.ref()
+        if host is x and ent.sample == sample:
+            cur = _full_sum(x)
+            # NaN-bearing matrices: NaN == NaN is False, which would evict
+            # and re-upload on every call — treat NaN baselines as equal
+            # (the strided sample still guards those entries).
+            same = (ent.full_sum == cur) or (
+                np.isnan(ent.full_sum) and np.isnan(cur)
+            )
+            if same:
+                return ent.buf
         _cache.pop(key, None)  # freed id reuse or in-place mutation
-    buf = jnp.asarray(x)
+    try:
+        buf = jnp.asarray(x)
+    except Exception:
+        # device allocation failure: drop every pinned buffer, retry once
+        _cache.clear()
+        buf = jnp.asarray(x)
     try:
         ref = weakref.ref(x, lambda _r, _k=key: _cache.pop(_k, None))
     except TypeError:
         return buf  # not weakref-able (exotic subclass): skip caching
     while len(_cache) >= _MAX_ENTRIES:  # FIFO eviction (dicts keep order)
         _cache.pop(next(iter(_cache)))
-    _cache[key] = (ref, sent, buf)
+    _cache[key] = _Entry(ref, sample, _full_sum(x), buf)
     return buf
