@@ -870,8 +870,11 @@ def main() -> None:
     # so a dead tunnel fails fast instead of rerouting to CPU.
     no_cpu = bool(os.environ.get("SCC_BENCH_NO_CPU_FALLBACK"))
     if no_cpu:
+        # an attempt is CPU-bound if its override pins CPU — or if the
+        # ambient env does and the override doesn't reclaim it
         plan = [(l, e, t) for l, e, t in plan
-                if e.get("SCC_BENCH_PLATFORM") != "cpu"]
+                if e.get("SCC_BENCH_PLATFORM",
+                         os.environ.get("SCC_BENCH_PLATFORM")) != "cpu"]
         if not plan:  # e.g. --quick, whose only attempt is CPU-pinned
             print(json.dumps({
                 "metric": "no accelerator attempt in plan "

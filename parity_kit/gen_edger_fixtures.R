@@ -1,0 +1,79 @@
+# Golden-fixture generator for the edgeR NB parity tests.
+# Run anywhere R + Bioconductor edgeR exist:
+#   Rscript parity_kit/gen_edger_fixtures.R > tests/fixtures/edger_golden.json
+#
+# Replicates the reference call sequence per cluster pair
+# (/root/reference analog: R/reclusterDEConsensus.R:133-156):
+#   DGEList(group) -> estimateCommonDisp -> estimateTagwiseDisp ->
+#   calcNormFactors("none") -> exactTest
+# on deterministic synthetic NB counts with planted DE blocks.
+# JSON is written by hand (no jsonlite dependency).
+
+suppressMessages(library(edgeR))
+
+set.seed(7)
+
+G <- 150
+sizes <- c(60, 45, 30)
+K <- length(sizes)
+N <- sum(sizes)
+phi_true <- 0.4
+
+# per-cluster mean profiles: shared baseline + a planted 4x block per cluster
+base <- runif(G, 1, 12)
+mu <- matrix(rep(base, K), nrow = G)
+block <- 30
+for (k in seq_len(K)) {
+  rows <- ((k - 1) * block + 1):(k * block)
+  mu[rows, k] <- mu[rows, k] * 4
+}
+
+group <- rep(seq_len(K), sizes)
+counts <- matrix(0L, nrow = G, ncol = N)
+for (n in seq_len(N)) {
+  depth <- runif(1, 0.6, 1.6)           # per-cell library variation
+  m <- mu[, group[n]] * depth
+  counts[, n] <- rnbinom(G, size = 1 / phi_true, mu = m)
+}
+
+pairs <- t(combn(seq_len(K), 2))
+
+# ---- hand-rolled JSON helpers (no dependencies) ----------------------------
+jnum <- function(x) {
+  s <- formatC(x, digits = 10, format = "g")
+  s[!is.finite(x)] <- "null"
+  paste0("[", paste(s, collapse = ","), "]")
+}
+jint <- function(x) paste0("[", paste(as.integer(x), collapse = ","), "]")
+
+res_chunks <- character(nrow(pairs))
+for (p in seq_len(nrow(pairs))) {
+  i <- pairs[p, 1]; j <- pairs[p, 2]
+  sel <- group %in% c(i, j)
+  g <- factor(group[sel], levels = c(i, j))
+  y <- DGEList(counts = counts[, sel], group = g)
+  y <- estimateCommonDisp(y)
+  y <- estimateTagwiseDisp(y)
+  y <- calcNormFactors(y, method = "none")   # reference order: after disp
+  et <- exactTest(y, pair = as.character(c(j, i)))  # logFC of i over j
+  res_chunks[p] <- paste0(
+    '{"common_disp":', formatC(y$common.dispersion, digits = 10, format = "g"),
+    ',"tagwise_disp":', jnum(y$tagwise.dispersion),
+    ',"p_value":', jnum(et$table$PValue),
+    ',"logfc_log2":', jnum(et$table$logFC), "}"
+  )
+}
+
+cat(
+  '{"schema_version":1',
+  ',"n_genes":', G,
+  ',"n_cells":', N,
+  ',"n_clusters":', K,
+  ',"counts":', jint(as.vector(t(counts))),      # row-major (gene-major)
+  ',"group":', jint(group - 1L),                 # 0-based
+  ',"pairs":[', paste(
+    apply(pairs - 1L, 1, function(r) paste0("[", r[1], ",", r[2], "]")),
+    collapse = ","), "]",
+  ',"results":[', paste(res_chunks, collapse = ","), "]}",
+  sep = ""
+)

@@ -1,0 +1,64 @@
+# Golden-fixture generator for the dynamicTreeCut parity tests.
+# Run anywhere R + dynamicTreeCut (CRAN) exist:
+#   Rscript parity_kit/gen_treecut_fixtures.R > tests/fixtures/treecut_golden.json
+#
+# Replicates the reference call sequence (/root/reference analog:
+# R/reclusterDEConsensus.R:254-260): hclust(dist(x), "ward.D2") then
+# cutreeHybrid at deepSplit 0..4 on deterministic planted Gaussian clusters.
+# JSON is written by hand (no jsonlite dependency).
+
+suppressMessages(library(dynamicTreeCut))
+
+set.seed(11)
+
+n_per <- 30
+d <- 4
+centers <- matrix(c(
+   0,  0,  0,  0,
+   8,  0,  0,  0,
+   0,  8,  0,  0,
+   0,  0,  8,  0,
+   5,  5,  5,  5,
+  -6,  4, -4,  6
+), ncol = d, byrow = TRUE)
+k0 <- nrow(centers)
+n <- n_per * k0
+
+x <- matrix(0, nrow = n, ncol = d)
+for (k in seq_len(k0)) {
+  rows <- ((k - 1) * n_per + 1):(k * n_per)
+  x[rows, ] <- sweep(
+    matrix(rnorm(n_per * d, sd = 1.2), ncol = d), 2, centers[k, ], `+`
+  )
+}
+
+dm <- dist(x)
+hc <- hclust(dm, method = "ward.D2")
+dmat <- as.matrix(dm)
+
+jnum <- function(v) {
+  s <- formatC(v, digits = 10, format = "g")
+  s[!is.finite(v)] <- "null"
+  paste0("[", paste(s, collapse = ","), "]")
+}
+jint <- function(v) paste0("[", paste(as.integer(v), collapse = ","), "]")
+
+lab_chunks <- character(5)
+for (ds in 0:4) {
+  ct <- cutreeHybrid(
+    dendro = hc, distM = dmat, deepSplit = ds,
+    minClusterSize = 5, pamStage = TRUE, verbose = 0
+  )
+  lab_chunks[ds + 1] <- paste0('"', ds, '":', jint(ct$labels))
+}
+
+cat(
+  '{"schema_version":1',
+  ',"n_points":', n,
+  ',"n_dims":', d,
+  ',"points":', jnum(as.vector(t(x))),           # row-major
+  ',"merge":', jint(as.vector(t(hc$merge))),     # row-major (n-1) x 2
+  ',"height":', jnum(hc$height),
+  ',"labels":{', paste(lab_chunks, collapse = ","), "}}",
+  sep = ""
+)

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import hashlib
 import weakref
-from typing import Dict, List, Optional
+from typing import Dict
 
 import numpy as np
 
