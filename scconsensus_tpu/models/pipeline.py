@@ -91,11 +91,6 @@ def refine(
         from scconsensus_tpu.parallel.mesh import auto_mesh
 
         mesh = auto_mesh()
-    if mesh is not None:
-        from scconsensus_tpu.io.sparsemat import is_sparse as _isp
-
-        if _isp(data):
-            mesh = None  # sparse input rides the serial chunked engine
     if is_sparse(data):
         data = as_csr(data)
     else:

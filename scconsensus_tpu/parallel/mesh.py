@@ -16,11 +16,28 @@ import numpy as np
 from jax.sharding import Mesh
 
 __all__ = [
-    "make_mesh", "auto_mesh", "pad_axis_to_multiple", "require_dense",
-    "CELL_AXIS",
+    "make_mesh", "auto_mesh", "pad_axis_to_multiple", "put_sharded",
+    "require_dense", "CELL_AXIS",
 ]
 
 CELL_AXIS = "cells"
+
+
+def put_sharded(x, mesh: Mesh, spec):
+    """device_put ``x`` with a NamedSharding over ``mesh``.
+
+    The multi-host-correct upload: every process passes the same host value
+    and receives the global array holding only its addressable shards —
+    ``jnp.asarray`` would commit to local device 0, which a cross-process
+    mesh cannot consume. Single-process it is equivalent (and pre-lays the
+    data so jit skips a resharding copy)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if isinstance(spec, str):  # a bare axis name is one axis, not characters
+        spec = PartitionSpec(spec)
+    elif not isinstance(spec, PartitionSpec):
+        spec = PartitionSpec(*spec)
+    return jax.device_put(x, NamedSharding(mesh, spec))
 
 
 def auto_mesh(axis_name: str = CELL_AXIS) -> Optional[Mesh]:

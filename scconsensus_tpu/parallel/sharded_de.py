@@ -27,6 +27,7 @@ from scconsensus_tpu.parallel.mesh import (
     CELL_AXIS,
     make_mesh,
     pad_axis_to_multiple,
+    put_sharded,
     require_dense,
 )
 
@@ -73,8 +74,13 @@ def sharded_aggregates(
     n_shards = mesh.devices.size
     dp, _ = pad_axis_to_multiple(np.asarray(data, np.float32), 1, n_shards)
     op, _ = pad_axis_to_multiple(np.asarray(onehot, np.float32), 0, n_shards)
+    # sharded device_put, not jnp.asarray: on a multi-process mesh each
+    # process uploads only its addressable cell blocks
     return ClusterAggregates(
-        *_jitted_aggregates(mesh, axis_name)(jnp.asarray(dp), jnp.asarray(op))
+        *_jitted_aggregates(mesh, axis_name)(
+            put_sharded(dp, mesh, P(None, axis_name)),
+            put_sharded(op, mesh, P(axis_name)),
+        )
     )
 
 
@@ -122,7 +128,13 @@ def sharded_allpairs_ranksum(
     n_shards = int(mesh.devices.size)
     gc = chunk.shape[0]
     pad = (-gc) % n_shards
-    if pad:
+    if isinstance(chunk, np.ndarray):
+        # host input (the multi-host entry): pad on host, upload sharded
+        if pad:
+            chunk = np.pad(chunk, ((0, pad), (0, 0)))
+        chunk = put_sharded(chunk.astype(np.float32, copy=False), mesh,
+                            P(axis_name, None))
+    elif pad:
         chunk = jnp.pad(chunk, ((0, pad), (0, 0)))
     lp, u, ts = _jitted_allpairs(mesh, axis_name, n_clusters, window)(
         chunk, cid, n_of, pair_i, pair_j
@@ -169,13 +181,16 @@ def sharded_wilcox_logp(
     n_shards = mesh.devices.size
     G = data.shape[0]
     dp, _ = pad_axis_to_multiple(np.asarray(data, np.float32), 0, n_shards)
+    # replicated small inputs stay host numpy: uncommitted values replicate
+    # onto any mesh, where a jnp.asarray would commit to local device 0 and
+    # be rejected by a cross-process jit
     log_p = _jitted_wilcox(mesh, axis_name)(
-        jnp.asarray(dp),
-        jnp.asarray(idx, np.int32),
-        jnp.asarray(m1),
-        jnp.asarray(m2),
-        jnp.asarray(n1, np.int32),
-        jnp.asarray(n2, np.int32),
+        put_sharded(dp, mesh, P(axis_name, None)),
+        np.asarray(idx, np.int32),
+        np.asarray(m1),
+        np.asarray(m2),
+        np.asarray(n1, np.int32),
+        np.asarray(n2, np.int32),
     )
     return np.asarray(log_p)[:, :G]
 

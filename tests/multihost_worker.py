@@ -1,0 +1,100 @@
+"""Worker for the 2-process jax.distributed DCN test (test_multihost.py).
+
+Each process owns 4 virtual CPU devices; the two processes form one 8-device
+mesh, so every collective in scconsensus_tpu.parallel crosses a process
+boundary — the CPU stand-in for DCN (the reference analog is the socket
+cluster at R/reclusterDEConsensusFast.R:61-65). Run via:
+
+    python tests/multihost_worker.py <coordinator> <process_id>
+
+Prints ``MULTIHOST_OK`` on success; any failure exits nonzero.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    coordinator, pid = sys.argv[1], int(sys.argv[2])
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=2, process_id=pid
+    )
+    assert len(jax.devices()) == 8, jax.devices()
+    assert len(jax.local_devices()) == 4
+
+    import jax.numpy as jnp
+    from scconsensus_tpu.ops.gates import compute_aggregates
+    from scconsensus_tpu.parallel.mesh import make_mesh
+    from scconsensus_tpu.parallel.sharded_de import (
+        sharded_aggregates,
+        sharded_allpairs_ranksum,
+    )
+
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(0)  # same seed → same data on both processes
+    G, N, K = 48, 96, 4
+    data = np.log1p(rng.poisson(1.5, size=(G, N))).astype(np.float32)
+    labels = rng.integers(0, K, size=N)
+    onehot = np.zeros((N, K), np.float32)
+    onehot[np.arange(N), labels] = 1.0
+
+    # ---- cell-sharded aggregates: psum crosses the process boundary ------
+    got = sharded_aggregates(data, onehot, mesh)
+    ref = compute_aggregates(jnp.asarray(data), jnp.asarray(onehot))
+    # outputs are replicated (P(None)): fully addressable on every process
+    np.testing.assert_allclose(
+        np.asarray(got.sum_log), np.asarray(ref.sum_log), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.counts), np.asarray(ref.counts), rtol=0
+    )
+
+    # ---- gene-sharded all-pairs rank-sum: output sharded across processes
+    from scconsensus_tpu.ops.ranksum_allpairs import allpairs_ranksum_chunk
+
+    n_of = np.bincount(labels, minlength=K).astype(np.int32)
+    pi, pj = np.triu_indices(K, k=1)
+    pi = pi.astype(np.int32)
+    pj = pj.astype(np.int32)
+    cid = labels.astype(np.int32)
+    lp, u, ts = sharded_allpairs_ranksum(
+        data, cid, n_of, pi, pj, K, mesh=mesh
+    )
+    ref_lp, ref_u, _ = allpairs_ranksum_chunk(
+        jnp.asarray(data), jnp.asarray(cid), jnp.asarray(n_of),
+        jnp.asarray(pi), jnp.asarray(pj), K,
+    )
+    ref_lp = np.asarray(ref_lp)
+    ref_u = np.asarray(ref_u)
+    # each process verifies the shards it owns against the serial reference
+    checked = 0
+    for shard in lp.addressable_shards:
+        np.testing.assert_allclose(
+            np.asarray(shard.data), ref_lp[shard.index],
+            rtol=1e-5, atol=1e-6, equal_nan=True,
+        )
+        checked += 1
+    for shard in u.addressable_shards:
+        np.testing.assert_allclose(
+            np.asarray(shard.data), ref_u[shard.index], rtol=1e-5
+        )
+    assert checked == 4, f"expected 4 local shards, saw {checked}"
+
+    print("MULTIHOST_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

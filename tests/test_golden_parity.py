@@ -85,8 +85,7 @@ def partitions_from_merge(merge, n, ks):
     """Partition of n leaves after applying the first n-k merges, for each k
     in ks — R hclust $merge conventions (negative = leaf, 1-based)."""
     out = {}
-    lab = -np.arange(1, n + 1)  # leaf ids as R negatives
-    comp = {-(i + 1): [i] for i in range(n)}
+    comp = {-(i + 1): [i] for i in range(n)}  # leaf ids as R negatives
     for step, (l, r) in enumerate(merge, start=1):
         members = comp.pop(int(l)) + comp.pop(int(r))
         comp[step] = members
@@ -205,7 +204,7 @@ def test_golden_treecut_matches_dynamictreecut():
             min_cluster_size=5, pam_stage=True,
         )
         ari = adjusted_rand_index(got, gold_lab)
-        exact = adjusted_rand_index(got, gold_lab) == pytest.approx(1.0)
+        exact = ari == pytest.approx(1.0)
         assert ari >= 0.9, (
             f"deepSplit={ds}: ARI {ari:.3f} vs dynamicTreeCut "
             f"(exact-match={exact}) — branch-logic divergence "

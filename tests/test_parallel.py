@@ -147,6 +147,31 @@ def test_mesh_refine_matches_serial(mesh):
     assert_mesh_equals_serial(mesh_res, ser_res)
 
 
+def test_mesh_refine_sparse_matches_serial(mesh):
+    """Sparse input no longer silently drops the mesh (VERDICT r3 #6): the
+    chunked sparse DE path densifies gene chunks onto the mesh and must
+    produce the serial result."""
+    import scipy.sparse as sp
+
+    from scconsensus_tpu.models.pipeline import recluster_de_consensus_fast
+    from scconsensus_tpu.utils.synthetic import noisy_labeling, synthetic_scrna
+
+    data, truth, _ = synthetic_scrna(
+        n_genes=120, n_cells=240, n_clusters=3, seed=5, n_markers_per_cluster=8
+    )
+    sdata = sp.csr_matrix(data)
+    labels = noisy_labeling(truth, 0.05, seed=1)
+    kw = dict(q_val_thrs=0.2, deep_split_values=(1, 2), min_cluster_size=5)
+    mesh_res = recluster_de_consensus_fast(sdata, labels, mesh=mesh, **kw)
+    ser_res = recluster_de_consensus_fast(sdata, labels, mesh=None, **kw)
+    from scconsensus_tpu.parallel.validate import assert_mesh_equals_serial
+
+    assert_mesh_equals_serial(mesh_res, ser_res)
+    # and sparse+mesh == dense+mesh (the sparse chunks feed the same kernels)
+    dense_res = recluster_de_consensus_fast(data, labels, mesh=mesh, **kw)
+    assert_mesh_equals_serial(mesh_res, dense_res)
+
+
 def test_distributed_refine_step_runs(mesh):
     inputs = build_step_inputs(n_cells=64, n_genes=48, n_clusters=3, n_shards=8)
     step = distributed_refine_step(mesh, n_pcs=4)
