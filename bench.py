@@ -400,33 +400,57 @@ def mfu_probes(platform: str) -> dict:
 
 
 def pallas_vs_xla_probe() -> dict:
-    """Fused Pallas distance+cluster-sums vs the XLA fallback at the
-    flagship silhouette shape (26k × 15, VERDICT r1 #2). TPU only."""
+    """Fused Pallas distance+cluster-sums vs the XLA fallback. Two shapes:
+    the flagship silhouette (26k × 15, K=22 — where round-3 measured XLA
+    ahead and demoted Pallas from auto) and the fat-K pooled-centroid
+    geometry (100k × 15, K=4096 — the brain1m assignment shape, VERDICT r3
+    #8's candidate for a Pallas win). TPU only."""
     import numpy as np
 
     from scconsensus_tpu.ops.pallas_kernels import distance_cluster_sums
 
     rng = np.random.default_rng(1)
-    x = rng.normal(size=(26_000, 15)).astype(np.float32)
-    lab = rng.integers(0, 22, size=26_000)
-    onehot = np.eye(22, dtype=np.float32)[lab]
+    shapes = {
+        "flagship_26k_k22": (26_000, 15, 22),
+        "pooled_100k_k4096": (100_000, 15, 4096),
+    }
     out = {}
-    try:
-        stats = {}
-        results = {}
-        for backend in ("xla", "pallas"):
-            results[backend] = distance_cluster_sums(x, onehot, backend=backend)
-            t0 = time.perf_counter()  # steady-state: returns a host array
-            results[backend] = distance_cluster_sums(x, onehot, backend=backend)
-            stats[backend] = time.perf_counter() - t0
-            out[f"{backend}_s"] = round(stats[backend], 4)
-        out["pallas_speedup"] = round(stats["xla"] / stats["pallas"], 3)
-        scale = max(1.0, float(np.max(np.abs(results["xla"]))))
-        out["max_rel_diff"] = float(
-            np.max(np.abs(results["xla"] - results["pallas"])) / scale
-        )
-    except Exception as e:
-        out["error"] = repr(e)[:300]
+    for name, (n, d, k) in shapes.items():
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        lab = rng.integers(0, k, size=n)
+        onehot = np.eye(k, dtype=np.float32)[lab]
+        rec = {}
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            # upload ONCE and keep results on device: at the fat-K shape the
+            # one-hot + result are ~3.2 GB — timing transfers instead of the
+            # kernels would push pallas_speedup to a meaningless ~1.0
+            jx = jnp.asarray(x)
+            joh = jnp.asarray(onehot)
+            stats = {}
+            results = {}
+            for backend in ("xla", "pallas"):
+                r = distance_cluster_sums(
+                    jx, joh, backend=backend, device_out=True
+                )
+                jax.block_until_ready(r)
+                t0 = time.perf_counter()
+                r = distance_cluster_sums(
+                    jx, joh, backend=backend, device_out=True
+                )
+                jax.block_until_ready(r)
+                stats[backend] = time.perf_counter() - t0
+                results[backend] = r
+                rec[f"{backend}_s"] = round(stats[backend], 4)
+            rec["pallas_speedup"] = round(stats["xla"] / stats["pallas"], 3)
+            diff = float(jnp.max(jnp.abs(results["xla"] - results["pallas"])))
+            scale = max(1.0, float(jnp.max(jnp.abs(results["xla"]))))
+            rec["max_rel_diff"] = diff / scale
+        except Exception as e:
+            rec["error"] = repr(e)[:300]
+        out[name] = rec
     return out
 
 

@@ -336,10 +336,10 @@ def _run_wilcox_device(
     it (genes are embarrassingly parallel).
 
     Single-device dense inputs take the sparse-window route: genes bucket
-    by their nonzero count onto a pow-4 window ladder and each bucket runs
-    the rank-sum kernel at its own window width (zero-block decomposition,
-    ops.ranksum_allpairs) — expression data is mostly zeros, so most genes
-    pay a fraction of the full N-cell scan.
+    by their nonzero count onto a pow-2 window ladder (floor 1024) and each
+    bucket runs the rank-sum kernel at its own window width (zero-block
+    decomposition, ops.ranksum_allpairs) — expression data is mostly zeros,
+    so most genes pay a fraction of the full N-cell scan.
     """
     from scconsensus_tpu.ops.ranksum_allpairs import (
         _ALLPAIRS_ELEM_BUDGET,
@@ -400,8 +400,15 @@ def _run_wilcox_device(
                 g1 += 1
             ids = order[g0:g1]
             rows = jnp.take(jdata, jnp.asarray(ids), axis=0)
-            if ids.size < gcb:
-                rows = jnp.pad(rows, ((0, gcb - ids.size), (0, 0)))
+            # pad to the pow-2 of the ACTUAL block population, not the full
+            # budget: a 50-gene window bucket must not sort/scan thousands
+            # of padded rows (same fix as the NB exact-task chunks). Floor
+            # 256 bounds the distinct compiled (gcb, w) shapes — each cold
+            # compile crosses the remote-compile tunnel (cf. the window
+            # floor above)
+            gcb_eff = min(gcb, _next_pow2(max(int(ids.size), 256)))
+            if ids.size < gcb_eff:
+                rows = jnp.pad(rows, ((0, gcb_eff - ids.size), (0, 0)))
             if mesh is not None:
                 out = sharded_allpairs_ranksum(
                     rows, jcid, jn, jpi, jpj, K, mesh=mesh,

@@ -102,14 +102,15 @@ def _dist_sums_pallas(xp: jnp.ndarray, ohp: jnp.ndarray, interpret: bool = False
     )(xp, xp, ohp)
 
 
-def _pad_to(x: np.ndarray, axis: int, multiple: int) -> np.ndarray:
+def _pad_to(x, axis: int, multiple: int):
     n = x.shape[axis]
     pad = (-n) % multiple
     if pad == 0:
         return x
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, pad)
-    return np.pad(x, widths)
+    # jnp.pad for device arrays (np.pad would silently fetch to host)
+    return (jnp.pad if isinstance(x, jax.Array) else np.pad)(x, widths)
 
 
 def distance_cluster_sums(
@@ -117,6 +118,7 @@ def distance_cluster_sums(
     onehot: np.ndarray,
     backend: str = "auto",
     block: int = 4096,
+    device_out: bool = False,
 ) -> np.ndarray:
     """(N, K) Σ distances from every point to every cluster's members.
 
@@ -124,9 +126,15 @@ def distance_cluster_sums(
     the fallback at the flagship shape, see module docstring),
     'pallas_interpret' (CPU-debuggable kernel, slow — tests only), 'xla'
     (blocked matmul fallback), or 'auto' (xla: the measured winner).
+
+    ``x``/``onehot`` may be device arrays (no host round-trip);
+    ``device_out=True`` returns the device array (callers benchmarking the
+    kernel must not pay a multi-GB fetch inside the timed region).
     """
-    x = np.ascontiguousarray(x, np.float32)
-    onehot = np.ascontiguousarray(onehot, np.float32)
+    if not isinstance(x, jax.Array):
+        x = np.ascontiguousarray(x, np.float32)
+    if not isinstance(onehot, jax.Array):
+        onehot = np.ascontiguousarray(onehot, np.float32)
     n, _d = x.shape
     k = onehot.shape[1]
     if backend == "auto":
@@ -139,8 +147,8 @@ def distance_cluster_sums(
         out = _dist_sums_pallas(
             jnp.asarray(xp), jnp.asarray(ohp),
             interpret=(backend == "pallas_interpret"),
-        )
-        return np.asarray(out)[:n, :k]
+        )[:n, :k]
+        return out if device_out else np.asarray(out)
 
     if backend == "xla":
         jx = jnp.asarray(x)
@@ -152,7 +160,8 @@ def distance_cluster_sums(
             _xla_block_sums(jx[s : min(s + block, n)], jx, joh)
             for s in range(0, n, block)
         ]
-        return np.asarray(jnp.concatenate(parts, axis=0))
+        out = jnp.concatenate(parts, axis=0)
+        return out if device_out else np.asarray(out)
 
     raise ValueError(f"unknown backend {backend!r}")
 

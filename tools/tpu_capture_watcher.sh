@@ -1,0 +1,54 @@
+#!/bin/bash
+# TPU capture watcher v2: probe the tunnel; when up, run the bench configs in
+# priority order (evidence files /root/repo/BENCH_TPU_<cfg>.json), then one
+# phase-profiled flagship run for stage diagnosis. Loops until all captured.
+cd /root/repo
+CFGS="flagship tm100k brain1m pbmc68k cite8k"
+LOG=/tmp/tpu_capture.log
+
+captured() {
+  python - "$1" <<'PY' 2>/dev/null
+import json, sys
+try:
+    d = json.load(open(f"/root/repo/BENCH_TPU_{sys.argv[1]}.json"))
+except Exception:
+    sys.exit(1)
+ex = d.get("extra", {})
+ok = (float(d.get("value", -1)) > 0 and ex.get("platform") not in (None, "cpu")
+      and not ex.get("degraded"))
+sys.exit(0 if ok else 1)
+PY
+}
+
+all_done() {
+  for c in $CFGS; do captured "$c" || return 1; done
+  [ -f /tmp/tpu_profile_flagship.done ] || return 1
+  return 0
+}
+
+while true; do
+  if all_done; then echo "$(date +%H:%M:%S) ALL CAPTURED" >> $LOG; exit 0; fi
+  plat=$(timeout 180 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
+  echo "$(date +%H:%M:%S) probe plat=$plat" >> $LOG
+  if [ -n "$plat" ] && [ "$plat" != "cpu" ]; then
+    for cfg in $CFGS; do
+      captured "$cfg" && continue
+      echo "$(date +%H:%M:%S) RUN $cfg" >> $LOG
+      SCC_BENCH_CONFIG=$cfg \
+      SCC_BENCH_CKPT=/root/repo/BENCH_TPU_$cfg.json \
+      SCC_BENCH_NO_CPU_FALLBACK=1 \
+      timeout 4000 python bench.py >> /tmp/tpu_capture_$cfg.out 2>&1
+      echo "$(date +%H:%M:%S) DONE $cfg rc=$?" >> $LOG
+      captured "$cfg" || break
+    done
+    if captured flagship && [ ! -f /tmp/tpu_profile_flagship.done ]; then
+      echo "$(date +%H:%M:%S) RUN profile" >> $LOG
+      SCC_BENCH_CONFIG=flagship SCC_BENCH_NO_FORK=1 SCC_EDGER_PROFILE=1 \
+      SCC_BENCH_CKPT=/tmp/bench_profile_ckpt.json \
+      timeout 4000 python bench.py > /tmp/tpu_profile_flagship.out 2>&1 \
+        && touch /tmp/tpu_profile_flagship.done
+      echo "$(date +%H:%M:%S) DONE profile rc=$?" >> $LOG
+    fi
+  fi
+  sleep 180
+done
