@@ -16,8 +16,8 @@ import numpy as np
 from jax.sharding import Mesh
 
 __all__ = [
-    "make_mesh", "auto_mesh", "pad_axis_to_multiple", "put_sharded",
-    "require_dense", "CELL_AXIS",
+    "make_mesh", "auto_mesh", "drain_if_cpu_mesh", "pad_axis_to_multiple",
+    "put_sharded", "require_dense", "CELL_AXIS",
 ]
 
 CELL_AXIS = "cells"
@@ -64,6 +64,22 @@ def make_mesh(
             )
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (axis_name,))
+
+
+def drain_if_cpu_mesh(mesh: Mesh, *arrays) -> None:
+    """Block until ``arrays`` are ready when the mesh devices are CPU.
+
+    On virtual-CPU meshes (N devices emulated on few physical cores) XLA's
+    in-process collectives can DEADLOCK when several collective programs are
+    in flight: device threads blocked in one program's rendezvous starve the
+    threads that would run the others' participants (observed: a 4000-cell
+    mesh refine wedged in an 8-way all-gather with 4 arrivals; raising the
+    rendezvous timeout only converts the abort into a hang). Draining after
+    each sharded launch keeps at most one collective program in flight.
+    Real accelerator meshes are untouched — async dispatch there is the
+    point, and each device owns its core."""
+    if mesh.devices.size and mesh.devices.flat[0].platform == "cpu":
+        jax.block_until_ready(arrays)
 
 
 def require_dense(*arrays) -> None:

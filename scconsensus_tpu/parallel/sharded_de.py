@@ -25,6 +25,7 @@ from scconsensus_tpu.ops.gates import ClusterAggregates
 from scconsensus_tpu.ops.wilcoxon import wilcoxon_pairs_tile
 from scconsensus_tpu.parallel.mesh import (
     CELL_AXIS,
+    drain_if_cpu_mesh,
     make_mesh,
     pad_axis_to_multiple,
     put_sharded,
@@ -76,12 +77,12 @@ def sharded_aggregates(
     op, _ = pad_axis_to_multiple(np.asarray(onehot, np.float32), 0, n_shards)
     # sharded device_put, not jnp.asarray: on a multi-process mesh each
     # process uploads only its addressable cell blocks
-    return ClusterAggregates(
-        *_jitted_aggregates(mesh, axis_name)(
-            put_sharded(dp, mesh, P(None, axis_name)),
-            put_sharded(op, mesh, P(axis_name)),
-        )
+    out = _jitted_aggregates(mesh, axis_name)(
+        put_sharded(dp, mesh, P(None, axis_name)),
+        put_sharded(op, mesh, P(axis_name)),
     )
+    drain_if_cpu_mesh(mesh, *out)
+    return ClusterAggregates(*out)
 
 
 @lru_cache(maxsize=32)
@@ -139,6 +140,8 @@ def sharded_allpairs_ranksum(
     lp, u, ts = _jitted_allpairs(mesh, axis_name, n_clusters, window)(
         chunk, cid, n_of, pair_i, pair_j
     )
+    # virtual-CPU meshes deadlock with >1 collective program in flight
+    drain_if_cpu_mesh(mesh, lp, u, ts)
     return lp[:gc], u[:gc], ts[:gc]
 
 

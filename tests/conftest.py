@@ -14,9 +14,18 @@ if not os.environ.get("SCC_TEST_TPU"):
     os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# 8 virtual devices share ONE physical core here: under a heavy sharded
+# program the collective rendezvous can take minutes of wall-clock before
+# every device thread arrives, and XLA's default 40 s terminate timeout
+# hard-aborts the process (observed at a 4000-cell mesh refine). Real
+# multi-chip runs have a core per device and are unaffected. Each flag is
+# guarded by its own name so a caller's explicit setting wins.
+for _f in ("xla_cpu_collective_timeout_seconds",
+           "xla_cpu_collective_call_terminate_timeout_seconds"):
+    if _f not in flags:
+        flags += f" --{_f}=1200"
+os.environ["XLA_FLAGS"] = flags
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
