@@ -1,0 +1,33 @@
+"""Virtual-CPU-mesh XLA flag bootstrap (jax-free: must be importable and
+applied BEFORE the first ``import jax`` side effects).
+
+Shared by tests/conftest.py and __graft_entry__.dryrun_multichip so the
+workaround set cannot drift between the two bootstrap paths.
+"""
+
+from __future__ import annotations
+
+import os
+
+# N virtual devices on few physical cores: XLA's default 40 s collective
+# rendezvous terminate-timeout hard-aborts oversubscribed runs (observed at
+# a 4000-cell mesh refine on 1 core); real multi-chip has a core per device
+# and never hits this.
+_TIMEOUT_FLAGS = (
+    "xla_cpu_collective_timeout_seconds",
+    "xla_cpu_collective_call_terminate_timeout_seconds",
+)
+
+
+def apply_virtual_cpu_xla_flags(n_devices: int) -> None:
+    """Set XLA_FLAGS for an n-device virtual CPU mesh. Each flag is guarded
+    by its own name, so a caller's explicit setting always wins."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    for f in _TIMEOUT_FLAGS:
+        if f not in flags:
+            flags += f" --{f}=1200"
+    os.environ["XLA_FLAGS"] = flags

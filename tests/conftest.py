@@ -12,22 +12,22 @@ import sys
 # Set SCC_TEST_TPU=1 to run the suite against the real chip instead.
 if not os.environ.get("SCC_TEST_TPU"):
     os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
-# 8 virtual devices share ONE physical core here: under a heavy sharded
-# program the collective rendezvous can take minutes of wall-clock before
-# every device thread arrives, and XLA's default 40 s terminate timeout
-# hard-aborts the process (observed at a 4000-cell mesh refine). Real
-# multi-chip runs have a core per device and are unaffected. Each flag is
-# guarded by its own name so a caller's explicit setting wins.
-for _f in ("xla_cpu_collective_timeout_seconds",
-           "xla_cpu_collective_call_terminate_timeout_seconds"):
-    if _f not in flags:
-        flags += f" --{_f}=1200"
-os.environ["XLA_FLAGS"] = flags
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# 8-virtual-device flags + collective-rendezvous timeout raises (shared,
+# jax-free bootstrap — see its docstring for the oversubscription
+# rationale). Loaded by file path: importing the package would pull jax in
+# before the flags are set.
+import importlib.util as _ilu  # noqa: E402
+
+_spec = _ilu.spec_from_file_location(
+    "scc_xla_bootstrap",
+    os.path.join(_REPO, "scconsensus_tpu", "utils", "xla_bootstrap.py"),
+)
+_boot = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_boot)
+_boot.apply_virtual_cpu_xla_flags(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

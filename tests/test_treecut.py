@@ -107,6 +107,71 @@ def test_pam_stage_assigns_stragglers():
             assert set(np.nonzero(base == c)[0]) <= set(np.nonzero(pam == c)[0])
 
 
+def test_cut_height_override_prunes_tall_merges():
+    # Two tight groups bridged by a tall merge: an explicit cutHeight below
+    # the bridge must keep them separate; a cutHeight above the tallest
+    # merge must allow the tree root to be considered (published cutHeight
+    # semantics: merges above cutHeight are never joined).
+    x, _ = _planted(30, [(0, 0), (12, 0)], scale=0.5, seed=13)
+    tree = ward_linkage(x)
+    bridge = float(tree.height[-1])
+    low = cutree_hybrid(tree, x, deep_split=1, min_cluster_size=10,
+                        cut_height=bridge * 0.5)
+    assert len(set(low[low > 0].tolist())) == 2
+    # cut_height is clamped to the max height internally; the root branch is
+    # then evaluated as one candidate — with loose criteria it may merge
+    high = cutree_hybrid(tree, x, deep_split=0, min_cluster_size=10,
+                         cut_height=bridge * 10.0)
+    assert high.max() >= 1
+
+
+def test_max_pam_dist_bounds_assignment():
+    # PAM with a tiny max_pam_dist must leave the far scatter unassigned;
+    # with a huge one it must absorb everything (published maxPamDist).
+    x, _ = _planted(30, [(0.0, 0.0), (15.0, 0.0)], scale=0.5, seed=17)
+    rng = np.random.default_rng(18)
+    far = rng.uniform(200, 210, size=(5, 2)).astype(np.float32)
+    x = np.concatenate([x, far])
+    tree = ward_linkage(x)
+    tight = cutree_hybrid(tree, x, deep_split=2, min_cluster_size=10,
+                          pam_stage=True, max_pam_dist=1.0)
+    loose = cutree_hybrid(tree, x, deep_split=2, min_cluster_size=10,
+                          pam_stage=True, max_pam_dist=1e6)
+    assert (tight[-5:] == 0).all()
+    assert (loose > 0).all()
+    # bounded PAM never unassigns points the unbounded one assigns
+    assert set(np.nonzero(tight > 0)[0]) <= set(np.nonzero(loose > 0)[0])
+
+
+def test_composite_side_branches_still_emitted():
+    # A chain geometry where clusters join an already-composite branch one
+    # at a time: each qualifying basic branch must still be emitted as its
+    # own cluster (the composite-merge emission path, ops/treecut.py).
+    centers = [(0, 0), (10, 0), (20, 0), (30, 0)]
+    x, truth = _planted(25, centers, scale=0.6, seed=19)
+    tree = ward_linkage(x)
+    from sklearn.metrics import adjusted_rand_score
+
+    lab = cutree_hybrid(tree, x, deep_split=2, min_cluster_size=10)
+    m = lab > 0
+    assert len(set(lab[m].tolist())) == 4
+    assert adjusted_rand_score(truth[m], lab[m]) == 1.0
+
+
+def test_permutation_invariance_of_partition():
+    # Relabeling rows must permute the labels, not change the partition.
+    x, _ = _planted(20, [(0, 0), (9, 0), (18, 3)], scale=0.7, seed=23)
+    rng = np.random.default_rng(24)
+    perm = rng.permutation(x.shape[0])
+    from sklearn.metrics import adjusted_rand_score
+
+    a = cutree_hybrid(ward_linkage(x), x, deep_split=2, min_cluster_size=8)
+    b = cutree_hybrid(ward_linkage(x[perm]), x[perm], deep_split=2,
+                      min_cluster_size=8)
+    keep = (a[perm] > 0) & (b > 0)
+    assert adjusted_rand_score(a[perm][keep], b[keep]) == 1.0
+
+
 def test_fixture_labels_pinned():
     """Regression fixtures: committed per-deepSplit labels for a fixed tree.
 
