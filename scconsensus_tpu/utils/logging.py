@@ -10,11 +10,19 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import time
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 __all__ = ["get_logger", "StageTimer"]
+
+# SCC_STAGE_SYNC=1: drain the device queue at every stage boundary so stage
+# walls are honest compute attribution instead of dispatch intervals (JAX
+# async dispatch otherwise lands queued work on whichever stage first
+# blocks — a 78 s "bh_adjust" was really the rank-sum queue draining).
+# Costs one device round-trip per stage; off by default.
+_STAGE_SYNC = bool(os.environ.get("SCC_STAGE_SYNC"))
 
 _FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
 _LOG_LIST_CAP = 16
@@ -57,6 +65,17 @@ class StageTimer:
         self.logger = logger or get_logger()
         self.trace = trace
 
+    @staticmethod
+    def _drain() -> None:
+        if not _STAGE_SYNC:
+            return
+        try:
+            import jax
+
+            (jax.device_put(0.0) + 0).block_until_ready()
+        except Exception:  # no backend yet / shutdown: attribution only
+            pass
+
     @contextmanager
     def stage(self, name: str, **metrics: Any):
         ann = None
@@ -65,15 +84,19 @@ class StageTimer:
 
             ann = jax.profiler.TraceAnnotation(name)
             ann.__enter__()
+        self._drain()
         t0 = time.perf_counter()
         rec: Dict[str, Any] = {"stage": name, **metrics}
         try:
             yield rec
         finally:
+            self._drain()
             rec["wall_s"] = round(time.perf_counter() - t0, 4)
             if ann is not None:
                 ann.__exit__(None, None, None)
             self.records.append(rec)
+            if _STAGE_SYNC:
+                rec["synced"] = True
             self.logger.info("stage %s", json.dumps(_log_form(rec), default=str))
 
     def total_s(self) -> float:

@@ -44,7 +44,7 @@ while true; do
     if captured flagship && [ ! -f /tmp/tpu_profile_flagship.done ]; then
       echo "$(date +%H:%M:%S) RUN profile" >> $LOG
       SCC_BENCH_CONFIG=flagship SCC_BENCH_NO_FORK=1 SCC_EDGER_PROFILE=1 \
-      SCC_BENCH_CKPT=/tmp/bench_profile_ckpt.json \
+      SCC_STAGE_SYNC=1 SCC_BENCH_CKPT=/tmp/bench_profile_ckpt.json \
       timeout 4000 python bench.py > /tmp/tpu_profile_flagship.out 2>&1 \
         && touch /tmp/tpu_profile_flagship.done
       echo "$(date +%H:%M:%S) DONE profile rc=$?" >> $LOG
