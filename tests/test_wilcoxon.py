@@ -75,6 +75,16 @@ class TestBH:
         expect = np.minimum.accumulate((sub[o] * len(sub) / np.arange(1, len(sub) + 1))[::-1])[::-1]
         np.testing.assert_allclose(np.exp(logq[mask][o]), np.minimum(expect, 1), rtol=5e-4)
 
+    def test_matches_scipy_fdr(self, rng):
+        # independent external anchor (scipy >= 1.11 implements BH directly)
+        from scipy.stats import false_discovery_control
+
+        p = rng.random(40)
+        q = np.exp(np.asarray(bh_adjust(jnp.log(p.astype(np.float32)))))
+        np.testing.assert_allclose(
+            q, false_discovery_control(p, method="bh"), rtol=2e-4
+        )
+
     def test_batched_rows(self, rng):
         p = rng.random((4, 12)).astype(np.float32)
         logq = np.asarray(bh_adjust(jnp.log(p)))
