@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Print one table from every benchmark/evidence artifact in the repo root.
+
+Covers driver artifacts (BENCH_r*.json: {n, cmd, rc, tail, parsed}),
+watcher TPU evidence (BENCH_TPU_*.json), bench checkpoints
+(BENCH_CHECKPOINT_*.json), and the committed SCALE_/MESH_ evidence files.
+Usage: python tools/summarize_evidence.py
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fmt(rec: dict) -> str:
+    ex = rec.get("extra", {})
+    bits = [
+        f"value={rec.get('value')}",
+        f"unit={rec.get('unit')}",
+        f"vs_baseline={rec.get('vs_baseline')}",
+        f"platform={ex.get('platform')}",
+    ]
+    if ex.get("degraded"):
+        bits.append("DEGRADED")
+    if ex.get("partial"):
+        bits.append("PARTIAL")
+    if ex.get("wilcox_s") is not None:
+        bits.append(f"wilcox_s={ex['wilcox_s']}")
+    return "  ".join(str(b) for b in bits)
+
+
+def _load(path: str):
+    """A mid-write (truncated) artifact must degrade to one 'unreadable'
+    row, never crash the whole table."""
+    try:
+        return json.load(open(path)), None
+    except (json.JSONDecodeError, OSError) as e:
+        return None, f"unreadable: {e!r}"
+
+
+def main() -> None:
+    rows = []
+    for path in sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json"))):
+        d, err = _load(path)
+        if err:
+            rows.append((os.path.basename(path), err))
+            continue
+        parsed = d.get("parsed")
+        rows.append((os.path.basename(path),
+                     f"rc={d.get('rc')}  parsed="
+                     + ("null" if parsed is None else _fmt(parsed))))
+    for pat in ("BENCH_TPU_*.json", "BENCH_CHECKPOINT_*.json"):
+        for path in sorted(glob.glob(os.path.join(ROOT, pat))):
+            d, err = _load(path)
+            rows.append((os.path.basename(path), err or _fmt(d)))
+    for path in sorted(glob.glob(os.path.join(ROOT, "SCALE_*.json"))):
+        d, err = _load(path)
+        if err:
+            rows.append((os.path.basename(path), err))
+            continue
+        # either {"configs": {name: record}} or top-level record(s)
+        entries = d.get("configs") or {
+            k: v for k, v in d.items() if isinstance(v, dict)
+        }
+        if entries:
+            for cfg, rec in entries.items():
+                rows.append((f"{os.path.basename(path)}:{cfg}", _fmt(rec)))
+        else:
+            rows.append((os.path.basename(path), _fmt(d)))
+    for path in sorted(glob.glob(os.path.join(ROOT, "MESH_*.json"))):
+        d, err = _load(path)
+        if err:
+            rows.append((os.path.basename(path), err))
+            continue
+        for size, rec in d.get("sizes", {}).items():
+            rows.append((
+                f"{os.path.basename(path)}:{size}",
+                f"mesh={rec.get('mesh8')}s serial={rec.get('serial')}s "
+                f"ratio={rec.get('mesh_over_serial')}",
+            ))
+    width = max(len(r[0]) for r in rows) if rows else 0
+    for name, desc in rows:
+        print(f"{name:<{width}}  {desc}")
+
+
+if __name__ == "__main__":
+    main()
