@@ -13,7 +13,17 @@ Measured verdict (v5e, 26k×15, K=22, round 2→3): the fused kernel runs at
 HBM-efficient at this shape, and the kernel's fixed 256-tile grid leaves MXU
 idle on the skinny (d=15, K≈22) operands. ``backend="auto"`` therefore
 selects **XLA everywhere**; the Pallas kernel remains an explicit opt-in
-(``backend="pallas"``) for revisiting on fatter feature/cluster axes.
+(``backend="pallas"``).
+
+Roofline note (round 4) on the fat-K hope (e.g. the 100k × 15, K=4096
+pooled-centroid geometry): both backends execute the identical dominant
+matmul — dist(B, N) @ onehot(N, K) is 2·N²·K ≈ 82 TFLOP at that shape,
+~1.7 s of v5e f32 MXU time — while the d-tile HBM round trip XLA pays and
+the fusion saves is only ~80 GB ≈ 0.1 s. A ≥1.15× fused-kernel win is
+therefore structurally unavailable at either the skinny or the fat shape;
+the kernel stays an opt-in demonstration unless a future shape breaks this
+arithmetic (bench.py's pallas_vs_xla probe records both shapes whenever a
+TPU run happens, so the claim stays falsifiable).
 
 Grid: (N/TM, N/TN); the (TM, K) output block is revisited across the j axis
 and accumulated in place (zeroed at j == 0) — the standard Pallas reduction
