@@ -39,6 +39,7 @@ PAM stage streams device-computed distance blocks. No N×N materialization.
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 from typing import List, Optional, Sequence
 
@@ -68,11 +69,34 @@ class _Branch:
 
 
 def _merge_sorted(b1: _Branch, b2: _Branch) -> _Branch:
-    """Fuse two branches, interleaving members by join height."""
+    """Fuse two branches, interleaving members by join height.
+
+    Ties keep b1's members first (matches the general interleave below).
+    Both inputs are consumed by the caller (popped from the branch table /
+    fresh singletons), so the fast paths mutate and return one of them: a
+    singleton joining a branch is one bisect + two C-level list.insert
+    memmoves, not a Python re-interleave of the whole branch (40 % of the
+    26k-cell cut's time)."""
+    a_s, a_h, b_s, b_h = b1.singletons, b1.heights, b2.singletons, b2.heights
+    if not a_s:
+        return b2
+    if not b_s:
+        return b1
+    if len(b_s) == 1:
+        pos = bisect.bisect_right(a_h, b_h[0])  # a first on ties
+        a_s.insert(pos, b_s[0]); a_h.insert(pos, b_h[0])
+        return b1
+    if len(a_s) == 1:
+        pos = bisect.bisect_left(b_h, a_h[0])   # a first on ties
+        b_s.insert(pos, a_s[0]); b_h.insert(pos, a_h[0])
+        return b2
+    if a_h[-1] <= b_h[0]:  # disjoint height ranges: plain concat
+        return _Branch(a_s + b_s, a_h + b_h)
+    if b_h[-1] < a_h[0]:   # symmetric case (strict: a first on ties)
+        return _Branch(b_s + a_s, b_h + a_h)
     s: List[int] = []
     h: List[float] = []
     i = j = 0
-    a_s, a_h, b_s, b_h = b1.singletons, b1.heights, b2.singletons, b2.heights
     while i < len(a_s) and j < len(b_s):
         if a_h[i] <= b_h[j]:
             s.append(a_s[i]); h.append(a_h[i]); i += 1
@@ -85,13 +109,15 @@ def _merge_sorted(b1: _Branch, b2: _Branch) -> _Branch:
 
 def _core_scatter(embedding: np.ndarray, members: Sequence[int]) -> float:
     pts = embedding[np.asarray(members)]
-    if pts.shape[0] < 2:
+    m = pts.shape[0]
+    if m < 2:
         return 0.0
     sq = np.sum(pts * pts, axis=1)
     d2 = np.maximum(sq[:, None] + sq[None, :] - 2.0 * pts @ pts.T, 0.0)
-    m = pts.shape[0]
-    iu = np.triu_indices(m, 1)
-    return float(np.mean(np.sqrt(d2[iu])))
+    # mean over off-diagonal pairs: the matrix is symmetric with a zero
+    # diagonal, so sum/ (m(m-1)) — no triu_indices materialization (was
+    # ~20 % of a 26k-cell cut)
+    return float(np.sqrt(d2).sum() / (m * (m - 1)))
 
 
 def _qualifies(
