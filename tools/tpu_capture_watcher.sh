@@ -26,7 +26,11 @@ all_done() {
   return 0
 }
 
+DEADLINE=${SCC_WATCHER_DEADLINE:-0}   # epoch seconds; 0 = no deadline
 while true; do
+  if [ "$DEADLINE" -gt 0 ] && [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "$(date +%H:%M:%S) DEADLINE reached, exiting" >> $LOG; exit 0
+  fi
   if all_done; then echo "$(date +%H:%M:%S) ALL CAPTURED" >> $LOG; exit 0; fi
   plat=$(timeout 180 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
   echo "$(date +%H:%M:%S) probe plat=$plat" >> $LOG
