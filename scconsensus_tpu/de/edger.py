@@ -271,7 +271,7 @@ def run_edger_pairs(
         _gene_chunks,
         _next_pow2,
     )
-    from scconsensus_tpu.io.sparsemat import as_csr, is_sparse
+    from scconsensus_tpu.io.sparsemat import as_csr, is_jax, is_sparse
 
     prof = _PhaseProfiler()
     G = n_genes
@@ -281,6 +281,12 @@ def run_edger_pairs(
     sparse = is_sparse(counts)
     if sparse:
         counts = as_csr(counts)
+    elif is_jax(counts):
+        # Device-resident input: stays in HBM (pulling it to host here was
+        # the exact whole-matrix transfer the jax-input path eliminates).
+        counts = counts.astype(jnp.float32)
+        if jcounts is None:
+            jcounts = counts
     else:
         counts = np.ascontiguousarray(counts, np.float32)
         # Dense input crosses host→device exactly once (or zero times, when

@@ -490,11 +490,12 @@ def _run_wilcox(
     mesh=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Host-array form of ``_run_wilcox_device`` (tests, small callers)."""
-    from scconsensus_tpu.io.sparsemat import is_sparse
+    from scconsensus_tpu.io.sparsemat import is_jax, is_sparse
 
     jdata = None
     if mesh is None and not is_sparse(data):
-        jdata = jnp.asarray(np.ascontiguousarray(data, np.float32))
+        jdata = (data.astype(jnp.float32) if is_jax(data)
+                 else jnp.asarray(np.ascontiguousarray(data, np.float32)))
     lp, u = _run_wilcox_device(
         data, cell_idx_of, pair_i, pair_j, exact=exact, mesh=mesh, jdata=jdata
     )
@@ -515,12 +516,14 @@ def pairwise_de(
     across it (the product pipeline's dp analog of the reference's
     doParallel fan-out, R/reclusterDEConsensusFast.R:61-65).
     """
-    from scconsensus_tpu.io.sparsemat import as_csr, is_sparse, mean_expm1
+    from scconsensus_tpu.io.sparsemat import as_csr, is_jax, is_sparse, mean_expm1
     from scconsensus_tpu.utils.logging import StageTimer
 
     timer = timer or StageTimer()
     if is_sparse(data):
         data = as_csr(data)  # canonicalize COO/CSC; sums duplicate entries
+    elif is_jax(data):
+        data = data.astype(jnp.float32)  # stays in HBM; no host round-trip
     else:
         data = np.ascontiguousarray(data, dtype=np.float32)
     G, N = data.shape

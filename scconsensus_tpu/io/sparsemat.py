@@ -1,8 +1,12 @@
 """Sparse-matrix helpers behind the engine's never-densify contract.
 
-Every function accepts either a ``scipy.sparse`` matrix or a dense ndarray
-(the dense path is a passthrough), so the engine and pipeline stay agnostic:
-``is_sparse`` gates the few places where the code paths differ.
+Every function accepts a ``scipy.sparse`` matrix, a dense ndarray (the dense
+path is a passthrough), or a **device-resident ``jax.Array``** — the third
+form exists so a matrix generated or loaded straight into HBM never crosses
+the host↔device link at all (the flagship matrix is ~1.5 GB; over the axon
+tunnel that transfer alone dwarfs the compute). ``is_sparse`` / ``is_jax``
+gate the few places where the code paths differ; jax branches keep the math
+on device and pull only O(N) or scalar results.
 """
 
 from __future__ import annotations
@@ -18,6 +22,7 @@ except ImportError:  # pragma: no cover
 
 __all__ = [
     "is_sparse",
+    "is_jax",
     "as_csr",
     "row_chunk_dense",
     "padded_row_chunk",
@@ -34,6 +39,17 @@ def is_sparse(x) -> bool:
     return _sp is not None and _sp.issparse(x)
 
 
+def is_jax(x) -> bool:
+    """True for a jax.Array (device-resident dense matrix). Checked without
+    importing jax at module load: numpy-only consumers never pay for it."""
+    mod = type(x).__module__
+    if not (mod.startswith("jax") or mod.startswith("jaxlib")):
+        return False
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
 def as_csr(x):
     """Canonicalize any scipy-sparse format to CSR (summing duplicate COO
     entries); dense input passes through. Entry points call this once so the
@@ -43,29 +59,42 @@ def as_csr(x):
     return x
 
 
-def row_chunk_dense(x, g0: int, g1: int) -> np.ndarray:
+def row_chunk_dense(x, g0: int, g1: int):
     """Dense float32 slice of rows [g0, g1) — the only densification the
-    engine performs (one gene-chunk × all-cells tile at a time)."""
+    engine performs (one gene-chunk × all-cells tile at a time). Device
+    inputs slice on device (no transfer)."""
     if is_sparse(x):
         return np.asarray(x[g0:g1].toarray(), dtype=np.float32)
+    if is_jax(x):
+        return x[g0:g1]
     return np.ascontiguousarray(x[g0:g1], dtype=np.float32)
 
 
-def padded_row_chunk(x, g0: int, width: int) -> np.ndarray:
+def padded_row_chunk(x, g0: int, width: int):
     """Dense float32 rows [g0, g0+width), zero-padded to exactly ``width``
     rows (keeps every chunk shape identical so jit caches hold one entry).
     The shared chunk primitive for the engine and NB driver loops."""
     g1 = min(g0 + width, x.shape[0])
     chunk = row_chunk_dense(x, g0, g1)
     if chunk.shape[0] < width:
-        chunk = np.pad(chunk, ((0, width - chunk.shape[0]), (0, 0)))
+        if is_jax(chunk):
+            import jax.numpy as jnp
+
+            chunk = jnp.pad(chunk, ((0, width - chunk.shape[0]), (0, 0)))
+        else:
+            chunk = np.pad(chunk, ((0, width - chunk.shape[0]), (0, 0)))
     return chunk
 
 
-def rows_dense(x, idx: np.ndarray) -> np.ndarray:
-    """Dense float32 gather of arbitrary gene rows (sparse-safe)."""
+def rows_dense(x, idx: np.ndarray):
+    """Dense float32 gather of arbitrary gene rows (sparse-safe). Device
+    inputs gather on device and stay there."""
     if is_sparse(x):
         return np.asarray(x[idx].toarray(), dtype=np.float32)
+    if is_jax(x):
+        import jax.numpy as jnp
+
+        return x[jnp.asarray(np.asarray(idx, np.int32))]
     return np.asarray(x[idx], dtype=np.float32)
 
 
@@ -75,6 +104,10 @@ def expm1_sparse(x):
         out = x.copy()
         out.data = np.expm1(out.data)
         return out
+    if is_jax(x):
+        import jax.numpy as jnp
+
+        return jnp.expm1(x)
     return np.expm1(x)
 
 
@@ -84,6 +117,10 @@ def mean_expm1(x) -> float:
     if is_sparse(x):
         total = float(np.expm1(x.data).sum())
         return total / float(x.shape[0] * x.shape[1])
+    if is_jax(x):
+        import jax.numpy as jnp
+
+        return float(jnp.mean(jnp.expm1(x)))
     return float(np.mean(np.expm1(x)))
 
 
@@ -91,6 +128,10 @@ def mean_value(x) -> float:
     """Mean over all entries without densifying."""
     if is_sparse(x):
         return float(x.sum()) / float(x.shape[0] * x.shape[1])
+    if is_jax(x):
+        import jax.numpy as jnp
+
+        return float(jnp.mean(x))
     return float(np.mean(x))
 
 
@@ -99,6 +140,10 @@ def nodg(x) -> np.ndarray:
     (the reference's O(N·G) interpreted loop, R/reclusterDEConsensus.R:272)."""
     if is_sparse(x):
         return np.asarray(x.astype(bool).sum(axis=0)).ravel().astype(np.int64)
+    if is_jax(x):
+        import jax.numpy as jnp
+
+        return np.asarray(jnp.sum(x > 0, axis=0), dtype=np.int64)
     return (x > 0).sum(axis=0).astype(np.int64)
 
 

@@ -79,6 +79,7 @@ def refine(
     """
     from scconsensus_tpu.io.sparsemat import (
         as_csr,
+        is_jax,
         is_sparse,
         nodg as sparse_nodg,
         rows_dense,
@@ -93,6 +94,13 @@ def refine(
         mesh = auto_mesh()
     if is_sparse(data):
         data = as_csr(data)
+    elif is_jax(data):
+        # Device-resident input (e.g. generated or loaded straight into
+        # HBM): keep it there — forcing numpy here would pull the whole
+        # matrix through the host link for nothing.
+        import jax.numpy as jnp
+
+        data = data.astype(jnp.float32)
     else:
         data = np.ascontiguousarray(data, dtype=np.float32)
     G, N = data.shape
@@ -146,11 +154,13 @@ def refine(
                 # as centered unit-norm expression vectors, where euclidean
                 # distance = sqrt(2·(1−r)) — monotone in Pearson distance —
                 # then reduce with PCA. Cluster geometry matches 1−r; absolute
-                # tree heights differ by the monotone transform.
-                cols = _rows_dense(union)  # (|U|, N)
+                # tree heights differ by the monotone transform. jnp ops keep
+                # a device-resident input on device (host input uploads the
+                # small (|U|, N) gather, which PCA needed anyway).
+                cols = jnp.asarray(_rows_dense(union))  # (|U|, N)
                 c = cols - cols.mean(axis=0, keepdims=True)
-                norm = np.linalg.norm(c, axis=0, keepdims=True)
-                cells = (c / np.maximum(norm, 1e-12)).T  # (N, |U|)
+                norm = jnp.linalg.norm(c, axis=0, keepdims=True)
+                cells = (c / jnp.maximum(norm, 1e-12)).T  # (N, |U|)
             else:
                 cells = _rows_dense(union).T
             scores = pca_scores(jnp.asarray(cells), n_pcs)
@@ -287,7 +297,7 @@ def refine(
             from scconsensus_tpu.report.de_heatmap import cell_type_de_plot
 
             cell_type_de_plot(
-                data_matrix=_rows_dense(union),
+                data_matrix=np.asarray(_rows_dense(union)),
                 nodg=nodg,
                 cell_tree=tree,
                 cluster_labels=np.asarray(labels).astype(str),

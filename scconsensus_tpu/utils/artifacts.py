@@ -31,17 +31,23 @@ def input_fingerprint(data, labels) -> Dict[str, Any]:
     change raises instead (ADVICE r1). Sampling keeps it O(1e5) regardless of
     matrix size.
     """
-    from scconsensus_tpu.io.sparsemat import is_sparse
+    from scconsensus_tpu.io.sparsemat import is_jax, is_sparse
 
     h = hashlib.sha256()
     if is_sparse(data):
         vals = data.data
         nnz = int(data.nnz)
+    elif is_jax(data):
+        # Device matrix: stride ON DEVICE and pull only the ~64k sample —
+        # np.asarray(data) here would drag the full matrix through the link.
+        vals = data.reshape(-1)
+        nnz = int((data != 0).sum()) if vals.size <= 10_000_000 else -1
     else:
         vals = np.asarray(data).ravel()
         nnz = int(np.count_nonzero(data)) if vals.size <= 10_000_000 else -1
     step = max(1, vals.size // 65_536)
-    h.update(np.ascontiguousarray(vals[::step], dtype=np.float32).tobytes())
+    h.update(np.ascontiguousarray(np.asarray(vals[::step]),
+                                  dtype=np.float32).tobytes())
     lab = np.asarray(labels).astype(str)
     lh = hashlib.sha256("\x00".join(lab.tolist()).encode()).hexdigest()[:16]
     return {

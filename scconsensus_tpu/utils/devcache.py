@@ -78,6 +78,11 @@ def device_put_cached(x: np.ndarray):
     jnp.asarray directly (this path pays a dict lookup + sample hash)."""
     import jax.numpy as jnp
 
+    from scconsensus_tpu.io.sparsemat import is_jax
+
+    if is_jax(x):
+        return x  # already device-resident: nothing to upload or verify
+
     key = id(x)
     sample = _sample_hash(x)
     ent = _cache.get(key)

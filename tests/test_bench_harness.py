@@ -130,6 +130,25 @@ def test_checkpoint_partial_with_value_is_accepted_on_timeout(
     assert bench_mod._read_ckpt(os.path.getmtime(ckpt) + 10) is None
 
 
+def test_cold_run_survives_as_headline_when_steady_dies():
+    """A tunnel window can close right after the edgeR cold run: the cold
+    number is a real end-to-end measurement and must become the headline
+    (metric says COLD) instead of value=-1 or a wilcox fallback."""
+    proc, rec = _run({
+        "SCC_BENCH_CONFIG": "quick",
+        "SCC_BENCH_NO_FORK": "1",
+        "SCC_BENCH_CRASH": "edger_steady",
+        "SCC_BENCH_PLATFORM": "cpu",
+    })
+    assert proc.returncode == 0
+    extra = rec["extra"]
+    assert "edger_error" in extra and "edger_cold_s" in extra
+    assert rec["value"] == extra["edger_cold_s"]
+    assert "COLD" in rec["metric"]
+    assert rec["vs_baseline"] > 0  # cold edgeR still prices the 30 s bar
+    assert "wilcox_s" in extra  # later sections still ran
+
+
 def test_final_line_fits_driver_tail_window():
     _, rec = _run({
         "SCC_BENCH_CONFIG": "quick",

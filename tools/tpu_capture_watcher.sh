@@ -32,8 +32,19 @@ while true; do
     echo "$(date +%H:%M:%S) DEADLINE reached, exiting" >> $LOG; exit 0
   fi
   if all_done; then echo "$(date +%H:%M:%S) ALL CAPTURED" >> $LOG; exit 0; fi
-  plat=$(timeout 180 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
-  echo "$(date +%H:%M:%S) probe plat=$plat" >> $LOG
+  probe=$(timeout 240 python tools/tunnel_probe.py 16 2>/dev/null | tail -1)
+  # one validation pass: emits "<plat>\t<canonical json>" only for real JSON,
+  # so a killed-mid-write probe can never corrupt TUNNEL_LOG.jsonl
+  parsed=$(echo "$probe" | python -c "import json,sys
+try:
+    d = json.loads(sys.stdin.read())
+    print((d.get('platform','') if d.get('alive') else '') + '\t' + json.dumps(d))
+except Exception:
+    pass" 2>/dev/null)
+  plat=${parsed%%$'\t'*}
+  pjson=${parsed#*$'\t'}
+  echo "$(date +%H:%M:%S) probe plat=$plat $pjson" >> $LOG
+  [ -n "$pjson" ] && echo "{\"ts\": \"$(date -Is)\", \"probe\": $pjson}" >> /root/repo/TUNNEL_LOG.jsonl
   if [ -n "$plat" ] && [ "$plat" != "cpu" ]; then
     for cfg in $CFGS; do
       captured "$cfg" && continue

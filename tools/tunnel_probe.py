@@ -1,0 +1,53 @@
+"""Tunnel health + bandwidth probe for the axon TPU.
+
+Run between capture attempts (never concurrently with a bench: the worker
+holds the device). Prints one JSON line:
+  {"alive": bool, "init_s": ..., "up_MBps": ..., "down_MBps": ..., "matmul_s": ...}
+
+The numbers size the capture timeouts: the flagship dataset is ~1.5 GB f32,
+so at up_MBps=U the one-time upload inside the edgeR cold run costs
+~1500/U seconds, which must fit inside the bench attempt window.
+"""
+import json
+import sys
+import time
+
+out = {"alive": False}
+
+
+def main() -> None:
+    t0 = time.perf_counter()
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        dev = jax.devices()[0]
+        out["platform"] = dev.platform
+        out["init_s"] = round(time.perf_counter() - t0, 2)
+
+        mb = float(sys.argv[1]) if len(sys.argv) > 1 else 64.0
+        host = np.ones((int(mb * 1e6 / 4),), np.float32)
+        t = time.perf_counter()
+        d = jax.device_put(host, dev)
+        d.block_until_ready()
+        up = time.perf_counter() - t
+        out["up_MBps"] = round(mb / up, 2)
+
+        t = time.perf_counter()
+        _ = np.asarray(d)
+        out["down_MBps"] = round(mb / (time.perf_counter() - t), 2)
+
+        x = jnp.ones((2048, 2048), jnp.float32)
+        y = (x @ x).block_until_ready()  # noqa: F841  (compile + run)
+        t = time.perf_counter()
+        (x @ x).block_until_ready()
+        out["matmul_s"] = round(time.perf_counter() - t, 4)
+        out["alive"] = True
+    except Exception as e:  # tunnel down / init hang handled by caller timeout
+        out["error"] = repr(e)[:300]
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
