@@ -56,6 +56,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_SECONDS = 30.0
+# Shared persistent XLA compile cache: reused across workers, attempts, AND
+# tunnel windows (a window that dies mid-compile still banks its programs).
+# The stall watchdog also reads it as a liveness signal — keep both in sync.
+_JAX_CACHE_DIR = "/tmp/scc_jax_cache"
 # v5e peak is 197 bf16 TFLOP/s per chip; our kernels run f32, so MFU quoted
 # against the bf16 peak is a conservative lower bound.
 TPU_PEAK_FLOPS = 197e12
@@ -571,7 +575,7 @@ def worker() -> None:
     plat = os.environ.get("SCC_BENCH_PLATFORM")
     if plat:
         jax.config.update("jax_platforms", plat)
-    jax.config.update("jax_compilation_cache_dir", "/tmp/scc_jax_cache")
+    jax.config.update("jax_compilation_cache_dir", _JAX_CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     name = os.environ.get("SCC_BENCH_CONFIG", "flagship")
@@ -806,8 +810,17 @@ def _run_attempt(label: str, env_over: dict, timeout_s: int):
     killed worker still leaves its progress log behind for the failure
     record — a pipe's buffer dies with the process. A timed-out worker's
     checkpoint file (and its partial stdout lines) are recovered: a partial
-    with a real headline value becomes the attempt's result."""
+    with a real headline value becomes the attempt's result.
+
+    Stall watchdog: a remote-TPU tunnel can die MID-RUN, leaving the worker
+    blocked forever inside a device RPC (zero CPU, no signal delivery into
+    the C++ wait — observed as a 35-min dead hang). The orchestrator
+    therefore tracks worker liveness (new stdout lines or a fresher
+    checkpoint file) and aborts the attempt after SCC_BENCH_STALL_S
+    (default 1200 s) without progress, so the ladder reaches its retry /
+    CPU fallback while there is still wall-clock to use them."""
     import tempfile
+    import threading
 
     global _CURRENT_WORKER
     env = dict(os.environ)
@@ -828,28 +841,97 @@ def _run_attempt(label: str, env_over: dict, timeout_s: int):
         try:
             proc = subprocess.Popen(
                 cmd, env=env, stdout=subprocess.PIPE, stderr=errf, text=True,
+                errors="replace",  # stray non-UTF-8 must not kill the drain
             )
             _CURRENT_WORKER = proc
-            try:
-                stdout, _ = proc.communicate(timeout=timeout_s)
-            except subprocess.TimeoutExpired:
+            lines: list = []
+            last_line_wall = [time.time()]
+
+            def _drain(pipe):
+                try:
+                    for ln in pipe:
+                        lines.append(ln)
+                        last_line_wall[0] = time.time()
+                except Exception as e:  # pipe closed on kill
+                    log(f"[bench] stdout drain ended early: {e!r}")
+
+            reader = threading.Thread(
+                target=_drain, args=(proc.stdout,), daemon=True
+            )
+            reader.start()
+            stall_s = float(os.environ.get("SCC_BENCH_STALL_S", "1200"))
+            deadline = t0 + timeout_s
+            outcome = None
+            err_size = [0]
+            err_grew = [0.0]
+            while proc.poll() is None:
+                if time.perf_counter() >= deadline:
+                    outcome = "timeout"
+                    break
+                activity = last_line_wall[0]
+                try:
+                    activity = max(activity, os.path.getmtime(_ckpt_path()))
+                except OSError:
+                    pass
+                # a compiling worker emits no stdout/checkpoints for minutes:
+                # count fresh persistent-cache entries and stderr growth
+                # (stage logs) as liveness too. Only entries newer than this
+                # attempt count — pre-existing cache contents are not life.
+                # (Caveat: the cache is machine-wide, so another JAX process
+                # compiling concurrently can defer — not defeat — the stall
+                # deadline; the attempt timeout still bounds the wait.)
+                try:
+                    activity = max(activity, max(
+                        (m for m in (
+                            e.stat().st_mtime
+                            for e in os.scandir(_JAX_CACHE_DIR)
+                        ) if m >= t0_wall),
+                        default=0.0,
+                    ))
+                except OSError:
+                    pass
+                try:
+                    sz = os.fstat(errf.fileno()).st_size
+                    if sz != err_size[0]:
+                        err_size[0] = sz
+                        err_grew[0] = time.time()
+                    activity = max(activity, err_grew[0])
+                except OSError:
+                    pass
+                if time.time() - activity > stall_s:
+                    outcome = "stall"
+                    break
+                try:  # wakes instantly on worker exit, unlike a flat sleep
+                    proc.wait(timeout=min(
+                        5.0, max(0.05, deadline - time.perf_counter())
+                    ))
+                except subprocess.TimeoutExpired:
+                    pass
+            if outcome is not None:
+                if outcome == "stall":
+                    log(f"[bench] attempt '{label}': no worker progress for "
+                        f"{stall_s:.0f}s — aborting (tunnel stall?)")
                 proc.terminate()  # gives the worker its SIGTERM checkpoint
                 try:
-                    stdout, _ = proc.communicate(timeout=20)
+                    proc.wait(timeout=20)
                 except subprocess.TimeoutExpired:
                     proc.kill()
-                    stdout, _ = proc.communicate()
+                    proc.wait()
+                reader.join(timeout=5)
+                stdout = "".join(lines)
                 partial = _best_partial(stdout, t0_wall)
-                failure = {"attempt": label, "outcome": "timeout",
+                failure = {"attempt": label, "outcome": outcome,
                            "timeout_s": timeout_s, "stderr_tail": _err_tail()}
                 if _record_value(partial) > 0:
                     partial.setdefault("extra", {})["attempt"] = label
                     partial["extra"]["partial"] = True
-                    partial["extra"]["attempt_outcome"] = "timeout"
+                    partial["extra"]["attempt_outcome"] = outcome
                     return partial, None
                 if partial is not None:
                     failure["partial"] = True
                 return None, failure
+            reader.join(timeout=10)
+            stdout = "".join(lines)
         finally:
             _CURRENT_WORKER = None
         wall = time.perf_counter() - t0

@@ -130,6 +130,27 @@ def test_checkpoint_partial_with_value_is_accepted_on_timeout(
     assert bench_mod._read_ckpt(os.path.getmtime(ckpt) + 10) is None
 
 
+def test_stalled_worker_is_aborted_by_watchdog(tmp_path, monkeypatch):
+    """A tunnel that dies MID-RUN leaves the worker blocked in a device RPC
+    with no progress signal; the orchestrator must abort the attempt after
+    SCC_BENCH_STALL_S instead of burning the whole attempt timeout."""
+    import time
+
+    import bench as bench_mod
+
+    monkeypatch.setenv("SCC_BENCH_CKPT", str(tmp_path / "none.json"))
+    monkeypatch.setenv("SCC_BENCH_STALL_S", "3")
+    t0 = time.perf_counter()
+    parsed, failure = bench_mod._run_attempt(
+        "t", {"SCC_BENCH_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
+              "SCC_BENCH_HANG": "120"},  # worker produces nothing, forever
+        timeout_s=600)
+    wall = time.perf_counter() - t0
+    assert parsed is None
+    assert failure["outcome"] == "stall"
+    assert wall < 60, f"stall abort took {wall:.0f}s"
+
+
 def test_cold_run_survives_as_headline_when_steady_dies():
     """A tunnel window can close right after the edgeR cold run: the cold
     number is a real end-to-end measurement and must become the headline
