@@ -916,7 +916,15 @@ def _run_attempt(label: str, env_over: dict, timeout_s: int):
                     proc.wait(timeout=20)
                 except subprocess.TimeoutExpired:
                     proc.kill()
-                    proc.wait()
+                    try:
+                        proc.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        # uninterruptible sleep inside a dead device RPC:
+                        # even SIGKILL is deferred. Abandon the zombie and
+                        # let the ladder proceed — waiting forever here
+                        # would burn the window the watchdog exists to save.
+                        log(f"[bench] attempt '{label}': worker ignores "
+                            "SIGKILL (uninterruptible RPC wait) — abandoning")
                 reader.join(timeout=5)
                 stdout = "".join(lines)
                 partial = _best_partial(stdout, t0_wall)

@@ -6,6 +6,11 @@ its only sparse-aware line is a ``Matrix::rowSums`` (SURVEY.md §2b N12). Here
 the contract is the opposite: matrices load as CSR (genes × cells), stay
 sparse on host, and only gene-chunk × cell-tile slices are densified onto the
 device — a 1M×20k matrix never materializes in full.
+
+For datasets that DO fit HBM dense, ``csr_to_device`` instead ships the
+compressed triplet across the host↔device link and densifies in HBM,
+producing a device-resident matrix the pipeline consumes with zero further
+host round-trips (models.pipeline's jax-input path).
 """
 
 from scconsensus_tpu.io.loaders import (
@@ -16,7 +21,9 @@ from scconsensus_tpu.io.loaders import (
 )
 from scconsensus_tpu.io.sparsemat import (
     aggregates_from_sparse,
+    csr_to_device,
     expm1_sparse,
+    is_jax,
     is_sparse,
     mean_expm1,
     nodg,
@@ -29,9 +36,11 @@ __all__ = [
     "load_h5ad",
     "log_normalize",
     "is_sparse",
+    "is_jax",
     "row_chunk_dense",
     "expm1_sparse",
     "mean_expm1",
     "nodg",
+    "csr_to_device",
     "aggregates_from_sparse",
 ]

@@ -31,6 +31,7 @@ __all__ = [
     "mean_expm1",
     "mean_value",
     "nodg",
+    "csr_to_device",
     "aggregates_from_sparse",
 ]
 
@@ -145,6 +146,48 @@ def nodg(x) -> np.ndarray:
 
         return np.asarray(jnp.sum(x > 0, axis=0), dtype=np.int64)
     return (x > 0).sum(axis=0).astype(np.int64)
+
+
+def csr_to_device(m):
+    """Densify a scipy CSR/CSC matrix INTO device HBM, shipping only the
+    compressed triplet (data f32 + indices i32 ≈ nnz·8 bytes + indptr)
+    across the host↔device link — at typical scRNA sparsity (~90 % zeros)
+    that is ~10× less link traffic than uploading the dense (G, N) f32
+    matrix, which matters when the accelerator sits behind a thin tunnel.
+    Row ids are recovered on device (searchsorted over indptr) and the
+    values scattered into a zero matrix. Returns a (G, N) f32 jax.Array
+    ready for the pipeline's device-resident input path."""
+    import jax.numpy as jnp
+
+    if is_jax(m):
+        return m  # already device-resident: re-routing it would round-trip
+    if not is_sparse(m):
+        return jnp.asarray(np.ascontiguousarray(m, dtype=np.float32))
+    m = m.tocsr()
+    if not m.has_canonical_format:
+        m = m.copy()  # tocsr() may alias the input; don't mutate the caller
+        m.sum_duplicates()
+    G, N = m.shape
+    if m.nnz >= np.iinfo(np.int32).max:
+        # int32 device indices (jax default without x64); a matrix this
+        # dense would not fit HBM as (G, N) f32 anyway at realistic G·N
+        raise ValueError(
+            f"csr_to_device supports nnz < 2^31 (got {m.nnz}); use the "
+            "host-sparse chunked path instead"
+        )
+    vals = jnp.asarray(m.data.astype(np.float32, copy=False))
+    cols = jnp.asarray(m.indices.astype(np.int32, copy=False))
+    iptr = jnp.asarray(m.indptr.astype(np.int32, copy=False))
+    rows = (
+        jnp.searchsorted(
+            iptr, jnp.arange(vals.size, dtype=jnp.int32), side="right"
+        ) - 1
+    )
+    return (
+        jnp.zeros((G, N), jnp.float32)
+        .at[rows, cols]
+        .set(vals, mode="drop", unique_indices=True)
+    )
 
 
 def aggregates_from_sparse(x, onehot: np.ndarray) -> Tuple[np.ndarray, ...]:

@@ -90,6 +90,54 @@ def test_sparsemat_jax_branches(dev_dataset):
     assert sparsemat.is_jax(e)
 
 
+def test_csr_to_device_roundtrip():
+    """Device densification of a CSR upload must reproduce toarray()
+    exactly — including duplicate-free scatter and empty rows/cols."""
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(0)
+    dense = rng.random((60, 45)).astype(np.float32)
+    dense[dense < 0.85] = 0.0  # ~85 % sparse, some all-zero rows
+    csr = sp.csr_matrix(dense)
+    got = sparsemat.csr_to_device(csr)
+    assert sparsemat.is_jax(got) and got.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(got), dense)
+    # CSC input canonicalizes through tocsr()
+    np.testing.assert_array_equal(
+        np.asarray(sparsemat.csr_to_device(sp.csc_matrix(dense))), dense
+    )
+    # dense input passes through as an upload
+    np.testing.assert_array_equal(
+        np.asarray(sparsemat.csr_to_device(dense)), dense
+    )
+
+
+def test_csr_to_device_feeds_pipeline(dev_dataset):
+    """loader-style CSR → device → refine must equal the host-sparse run."""
+    import scipy.sparse as sp
+
+    from scconsensus_tpu.config import ReclusterConfig
+    from scconsensus_tpu.models.pipeline import refine
+
+    data, labels, _ = dev_dataset
+    host = np.asarray(data)
+    csr = sp.csr_matrix(host)
+    cons = noisy_labeling(labels, 0.05, seed=3)
+    cfg = ReclusterConfig(
+        method="wilcox", min_cluster_size=5, deep_split_values=(1,),
+        q_val_thrs=0.05,
+    )
+    res_dev = refine(sparsemat.csr_to_device(csr), cons, cfg, mesh=None)
+    res_sp = refine(csr, cons, cfg, mesh=None)
+    np.testing.assert_array_equal(
+        res_dev.de_gene_union_idx, res_sp.de_gene_union_idx
+    )
+    for k in res_sp.dynamic_labels:
+        np.testing.assert_array_equal(
+            res_dev.dynamic_labels[k], res_sp.dynamic_labels[k]
+        )
+
+
 def test_devcache_passthrough(dev_dataset):
     from scconsensus_tpu.utils.devcache import device_put_cached
 
