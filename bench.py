@@ -1076,6 +1076,26 @@ def main() -> None:
 
     failures = []
     for label, env_over, timeout_s in plan:
+        accel_attempt = env_over.get(
+            "SCC_BENCH_PLATFORM", os.environ.get("SCC_BENCH_PLATFORM")
+        ) != "cpu"
+        if (failures and accel_attempt
+                and failures[-1].get("outcome") == "stall"):
+            # The previous accelerator attempt STALLED — the dead-tunnel
+            # signature (a plain timeout means slow-but-alive). Re-probe
+            # before burning another accelerator window; if the backend is
+            # dead now, fall through to whatever CPU attempt the plan still
+            # holds (or fail fast in no-cpu mode) instead of stalling again.
+            p2 = _probe_backend()
+            log(f"[bench] re-probe after {failures[-1]['outcome']}: {p2}")
+            # no-cpu mode: a probe that silently resolved to the CPU backend
+            # is as disqualifying as a dead one (same rule as the initial
+            # probe) — a CPU record must never land in TPU evidence.
+            if p2 in ("hang", "error") or (no_cpu and p2 == "cpu"):
+                failures.append({"attempt": label,
+                                 "outcome": "skipped-dead-backend",
+                                 "reprobe": p2})
+                continue
         parsed, failure = _run_attempt(label, env_over, timeout_s)
         if parsed is not None and float(parsed.get("value", -1)) < 0:
             # A worker that swallowed every section's failure still exits

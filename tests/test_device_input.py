@@ -110,6 +110,19 @@ def test_csr_to_device_roundtrip():
     np.testing.assert_array_equal(
         np.asarray(sparsemat.csr_to_device(dense)), dense
     )
+    # non-canonical CSR (duplicate column indices built directly): values
+    # must SUM, and the caller's matrix must not be restructured in place
+    dup = sp.csr_matrix(
+        (np.array([1.0, 2.0, 5.0], np.float32),
+         np.array([1, 1, 0]), np.array([0, 2, 3])),
+        shape=(2, 2),
+    )
+    nnz_before = dup.nnz
+    got_dup = np.asarray(sparsemat.csr_to_device(dup))
+    assert dup.nnz == nnz_before  # caller untouched
+    np.testing.assert_array_equal(
+        got_dup, np.array([[0.0, 3.0], [5.0, 0.0]], np.float32)
+    )
 
 
 def test_csr_to_device_feeds_pipeline(dev_dataset):

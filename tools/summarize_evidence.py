@@ -82,6 +82,36 @@ def main() -> None:
                 f"mesh={rec.get('mesh8')}s serial={rec.get('serial')}s "
                 f"ratio={rec.get('mesh_over_serial')}",
             ))
+    tlog = os.path.join(ROOT, "TUNNEL_LOG.jsonl")
+    if os.path.exists(tlog):
+        try:
+            import statistics
+
+            alive = down = 0
+            bw = []
+            with open(tlog) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    p = rec.get("probe") if isinstance(rec, dict) else None
+                    if not isinstance(p, dict):
+                        continue
+                    if p.get("alive"):
+                        alive += 1
+                        if p.get("up_MBps"):
+                            bw.append(float(p["up_MBps"]))
+                    else:
+                        down += 1
+            desc = f"probes: {alive} alive / {down} down"
+            if bw:
+                desc += (f"; up-bandwidth MB/s min={min(bw):.1f} "
+                         f"median={statistics.median(bw):.1f} "
+                         f"max={max(bw):.1f}")
+        except OSError as e:
+            desc = f"unreadable: {e!r}"
+        rows.append(("TUNNEL_LOG.jsonl", desc))
     width = max(len(r[0]) for r in rows) if rows else 0
     for name, desc in rows:
         print(f"{name:<{width}}  {desc}")
