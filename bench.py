@@ -1038,6 +1038,20 @@ def main() -> None:
     t_start = time.time()
     _orchestrator_term_handler(t_start)
     probe = None
+
+    def _is_cpu_attempt(env_over: dict) -> bool:
+        """An attempt is CPU-bound if its override pins CPU — or if the
+        ambient env does and the override doesn't reclaim it."""
+        return env_over.get(
+            "SCC_BENCH_PLATFORM", os.environ.get("SCC_BENCH_PLATFORM")
+        ) == "cpu"
+
+    def _probe_disqualified(p: str, no_cpu_mode: bool) -> bool:
+        """Shared rule for the initial probe and the post-stall re-probe:
+        dead backends always disqualify; in no-cpu (accelerator-evidence)
+        mode a probe that silently resolved to CPU disqualifies too."""
+        return p in ("hang", "error") or (no_cpu_mode and p == "cpu")
+
     # SCC_BENCH_NO_CPU_FALLBACK=1: an accelerator-evidence run (the tunnel
     # watcher) — a CPU-degraded record must never overwrite TPU evidence,
     # so a dead tunnel fails fast instead of rerouting to CPU.
@@ -1045,9 +1059,7 @@ def main() -> None:
     if no_cpu:
         # an attempt is CPU-bound if its override pins CPU — or if the
         # ambient env does and the override doesn't reclaim it
-        plan = [(l, e, t) for l, e, t in plan
-                if e.get("SCC_BENCH_PLATFORM",
-                         os.environ.get("SCC_BENCH_PLATFORM")) != "cpu"]
+        plan = [(l, e, t) for l, e, t in plan if not _is_cpu_attempt(e)]
         if not plan:  # e.g. --quick, whose only attempt is CPU-pinned
             print(json.dumps({
                 "metric": "no accelerator attempt in plan "
@@ -1061,7 +1073,7 @@ def main() -> None:
         log(f"[bench] backend probe: {probe}")
         # no-cpu mode also rejects a probe that silently resolved to the
         # CPU backend: the run exists to produce accelerator evidence.
-        if probe in ("hang", "error") or (no_cpu and probe == "cpu"):
+        if _probe_disqualified(probe, no_cpu):
             if no_cpu:
                 print(json.dumps({
                     "metric": "backend probe failed (no-cpu-fallback mode)",
@@ -1076,9 +1088,7 @@ def main() -> None:
 
     failures = []
     for label, env_over, timeout_s in plan:
-        accel_attempt = env_over.get(
-            "SCC_BENCH_PLATFORM", os.environ.get("SCC_BENCH_PLATFORM")
-        ) != "cpu"
+        accel_attempt = not _is_cpu_attempt(env_over)
         if (failures and accel_attempt
                 and failures[-1].get("outcome") == "stall"):
             # The previous accelerator attempt STALLED — the dead-tunnel
@@ -1088,10 +1098,7 @@ def main() -> None:
             # holds (or fail fast in no-cpu mode) instead of stalling again.
             p2 = _probe_backend()
             log(f"[bench] re-probe after {failures[-1]['outcome']}: {p2}")
-            # no-cpu mode: a probe that silently resolved to the CPU backend
-            # is as disqualifying as a dead one (same rule as the initial
-            # probe) — a CPU record must never land in TPU evidence.
-            if p2 in ("hang", "error") or (no_cpu and p2 == "cpu"):
+            if _probe_disqualified(p2, no_cpu):
                 failures.append({"attempt": label,
                                  "outcome": "skipped-dead-backend",
                                  "reprobe": p2})

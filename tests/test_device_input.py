@@ -169,6 +169,32 @@ def test_fingerprint_device_matches_host(dev_dataset):
     assert fp_d["labels_sha"] == fp_h["labels_sha"]
 
 
+def test_refine_device_input_on_mesh_equals_serial(dev_dataset):
+    """Device-resident input through the MESH path (sharded rank tests,
+    ring silhouette) must match the serial host-input run — the
+    many-device user's configuration."""
+    from scconsensus_tpu.config import ReclusterConfig
+    from scconsensus_tpu.models.pipeline import refine
+    from scconsensus_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    data, labels, _ = dev_dataset
+    cons = noisy_labeling(labels, 0.05, seed=3)
+    cfg = ReclusterConfig(
+        method="wilcox", min_cluster_size=5, deep_split_values=(1,),
+        q_val_thrs=0.05,
+    )
+    res_m = refine(data, cons, cfg, mesh=make_mesh(8))
+    res_s = refine(np.asarray(data), cons, cfg, mesh=None)
+    np.testing.assert_array_equal(
+        res_m.de_gene_union_idx, res_s.de_gene_union_idx
+    )
+    for k in res_s.dynamic_labels:
+        np.testing.assert_array_equal(
+            res_m.dynamic_labels[k], res_s.dynamic_labels[k]
+        )
+
+
 @pytest.mark.parametrize("method", ["wilcox", "edgeR"])
 def test_refine_device_input_equals_host_input(dev_dataset, method):
     """End-to-end: the same values as a jax.Array and as numpy must produce

@@ -109,7 +109,7 @@ def main() -> None:
                 desc += (f"; up-bandwidth MB/s min={min(bw):.1f} "
                          f"median={statistics.median(bw):.1f} "
                          f"max={max(bw):.1f}")
-        except OSError as e:
+        except (OSError, ValueError, TypeError) as e:
             desc = f"unreadable: {e!r}"
         rows.append(("TUNNEL_LOG.jsonl", desc))
     width = max(len(r[0]) for r in rows) if rows else 0
@@ -118,4 +118,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BrokenPipeError:  # `| head` closing early is fine
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), 1)
