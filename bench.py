@@ -215,6 +215,9 @@ def _consensus(*labelings):
 _GEN_CACHE = {}
 
 
+_DEVICE_GEN_BROKEN = False  # set after a device-gen failure (see _gen)
+
+
 def _device_gen() -> bool:
     """Generate the synthetic matrix on device when running on an
     accelerator (opt out: SCC_BENCH_HOST_GEN=1; force on anywhere:
@@ -222,7 +225,7 @@ def _device_gen() -> bool:
     flagship scale plus a ~1.5 GB upload — over the remote-TPU tunnel the
     upload alone can outlast a tunnel window, which is how round 3's
     capture died. On-device gen moves only KBs."""
-    if os.environ.get("SCC_BENCH_HOST_GEN"):
+    if _DEVICE_GEN_BROKEN or os.environ.get("SCC_BENCH_HOST_GEN"):
         return False
     if os.environ.get("SCC_BENCH_DEVICE_GEN"):
         return True
@@ -244,14 +247,29 @@ def _gen(n_cells, n_genes, n_clusters, seed=7):
     key = (n_cells, n_genes, n_clusters, seed, dev)
     if key not in _GEN_CACHE:
         _GEN_CACHE.clear()  # at most one flagship-sized dataset resident
-        fn = synthetic_scrna_device if dev else synthetic_scrna
-        _GEN_CACHE[key] = fn(
+        kw = dict(
             n_genes=n_genes,
             n_cells=n_cells,
             n_clusters=n_clusters,
             n_markers_per_cluster=min(40, n_genes // n_clusters),
             seed=seed,
         )
+        if dev:
+            try:
+                _GEN_CACHE[key] = synthetic_scrna_device(**kw)
+            except Exception as e:
+                # Untested-backend insurance: losing the upload saving is
+                # better than losing the whole measurement section. The
+                # flag makes every later _gen call go straight to host gen
+                # instead of re-failing (and re-clearing the cache).
+                global _DEVICE_GEN_BROKEN
+                _DEVICE_GEN_BROKEN = True
+                log(f"[bench] device gen failed ({repr(e)[:200]}); "
+                    "falling back to host gen + upload")
+                key = (n_cells, n_genes, n_clusters, seed, False)
+                _GEN_CACHE[key] = synthetic_scrna(**kw)
+        else:
+            _GEN_CACHE[key] = synthetic_scrna(**kw)
     return _GEN_CACHE[key]
 
 

@@ -62,9 +62,14 @@ def main() -> None:
         if err:
             rows.append((os.path.basename(path), err))
             continue
-        # either {"configs": {name: record}} or top-level record(s)
+        # three shapes: a single bench record ({"metric", "value", ...}),
+        # {"configs": {name: record}}, or a top-level map of records
+        if "metric" in d and "value" in d:
+            rows.append((os.path.basename(path), _fmt(d)))
+            continue
         entries = d.get("configs") or {
-            k: v for k, v in d.items() if isinstance(v, dict)
+            k: v for k, v in d.items()
+            if isinstance(v, dict) and ("metric" in v or "value" in v)
         }
         if entries:
             for cfg, rec in entries.items():
@@ -121,6 +126,4 @@ if __name__ == "__main__":
     try:
         main()
     except BrokenPipeError:  # `| head` closing early is fine
-        import os
-
         os.dup2(os.open(os.devnull, os.O_WRONLY), 1)
