@@ -256,7 +256,14 @@ def _gen(n_cells, n_genes, n_clusters, seed=7):
         )
         if dev:
             try:
-                _GEN_CACHE[key] = synthetic_scrna_device(**kw)
+                import jax
+
+                out = synthetic_scrna_device(**kw)
+                # force materialization NOW: async dispatch would otherwise
+                # surface a device-side failure (e.g. HBM OOM) later inside
+                # the timed section, past this try
+                jax.block_until_ready(out[0])
+                _GEN_CACHE[key] = out
             except Exception as e:
                 # Untested-backend insurance: losing the upload saving is
                 # better than losing the whole measurement section. The

@@ -172,6 +172,71 @@ def test_permutation_invariance_of_partition():
     assert adjusted_rand_score(a[perm][keep], b[keep]) == 1.0
 
 
+@pytest.mark.parametrize("deep_split", [0, 1, 2, 3, 4])
+@pytest.mark.parametrize("pam", [False, True])
+def test_matches_naive_oracle(deep_split, pam):
+    """The optimized cut must be label-identical to the naive spec-level
+    twin (ops/treecut_direct.py) — the consumed-oracle treatment the NB
+    engine gets from de/edger_direct.py. Randomized geometries hit the
+    fast paths the oracle deliberately avoids (bisect interleaves,
+    triu-free scatter, vectorized PAM)."""
+    from scconsensus_tpu.ops.treecut_direct import cutree_hybrid_direct
+
+    rng = np.random.default_rng(deep_split * 2 + int(pam))
+    # mixed geometry: blobs of uneven size/scale + elongated cluster + noise
+    parts = [
+        rng.normal((0, 0), 0.8, size=(60, 2)),
+        rng.normal((6, 0), 1.6, size=(25, 2)),
+        rng.normal((0, 7), 0.5, size=(90, 2)),
+        np.stack([np.linspace(10, 16, 40),
+                  rng.normal(0, 0.3, 40)], axis=1),
+        rng.uniform(-4, 18, size=(15, 2)),
+    ]
+    x = np.concatenate(parts).astype(np.float32)
+    tree = ward_linkage(x)
+    for mcs in (5, 12):
+        a = cutree_hybrid(tree, x, deep_split=deep_split,
+                          min_cluster_size=mcs, pam_stage=pam)
+        b = cutree_hybrid_direct(tree, x, deep_split=deep_split,
+                                 min_cluster_size=mcs, pam_stage=pam)
+        np.testing.assert_array_equal(a, b)
+
+
+def test_matches_naive_oracle_large_random():
+    """800 unstructured points build a deep, tie-rich tree — maximal
+    exercise for the interleave fast paths; labels must still be
+    identical to the oracle."""
+    from scconsensus_tpu.ops.treecut_direct import cutree_hybrid_direct
+
+    rng = np.random.default_rng(99)
+    x = rng.normal(size=(800, 5)).astype(np.float32)
+    x[200:420] += (4.0, 0, 0, 0, 0)
+    x[420:520] *= 0.3
+    tree = ward_linkage(x)
+    for ds in (1, 3):
+        a = cutree_hybrid(tree, x, deep_split=ds, min_cluster_size=15)
+        b = cutree_hybrid_direct(tree, x, deep_split=ds, min_cluster_size=15)
+        np.testing.assert_array_equal(a, b)
+
+
+def test_matches_naive_oracle_cut_height_and_pam_dist():
+    """cutHeight override and maxPamDist bound agree with the oracle too."""
+    from scconsensus_tpu.ops.treecut_direct import cutree_hybrid_direct
+
+    x, _ = _planted(30, [(0, 0), (8, 0), (0, 9)], scale=1.2, seed=5)
+    tree = ward_linkage(x)
+    hmax = float(tree.height[-1])
+    for ch in (0.5 * hmax, 0.9 * hmax, None):
+        for mpd in (None, 2.0):
+            a = cutree_hybrid(tree, x, deep_split=2, min_cluster_size=10,
+                              cut_height=ch, pam_stage=True,
+                              max_pam_dist=mpd)
+            b = cutree_hybrid_direct(tree, x, deep_split=2,
+                                     min_cluster_size=10, cut_height=ch,
+                                     pam_stage=True, max_pam_dist=mpd)
+            np.testing.assert_array_equal(a, b)
+
+
 def test_fixture_labels_pinned():
     """Regression fixtures: committed per-deepSplit labels for a fixed tree.
 
