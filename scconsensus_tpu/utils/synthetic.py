@@ -131,7 +131,11 @@ def synthetic_scrna_device(
         hi = min(lo + n_markers_per_cluster, n_genes)
         marker_mask[k, lo:hi] = True
 
-    B = int(min(gene_block, n_genes))
+    # Bound per-block HBM: the gamma/poisson draws hold ~3 block-sized f32
+    # temporaries, so cap blocks at ~128M elements (512 MB each) — at 100k
+    # cells this drops the block to 1280 genes instead of risking an OOM
+    # next to the full (G, N) counts buffer.
+    B = int(min(gene_block, n_genes, max(256, 128_000_000 // max(n_cells, 1))))
     n_blocks = -(-n_genes // B)
     g_pad = n_blocks * B
     # Padding rows get log-mu = -inf → mu = 0 → counts = 0; they are sliced

@@ -21,3 +21,18 @@ def test_quickstart_runs(tmp_path):
     assert "resume: DE stage skipped" in proc.stdout
     assert (tmp_path / "Contingency_Table.pdf").exists()
     assert (tmp_path / "Reclustered_DE_edgeR_Heatmap.pdf").exists()
+
+
+def test_device_resident_example_runs(tmp_path):
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        [sys.executable, str(repo / "examples" / "device_resident.py"),
+         "--cells", "500", "--genes", "300"],
+        capture_output=True, text=True, timeout=900,
+        cwd=tmp_path,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "device-resident: True" in proc.stdout
+    assert "refine over device matrix" in proc.stdout
+    assert "refine over csr_to_device matrix" in proc.stdout
