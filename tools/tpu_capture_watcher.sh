@@ -43,8 +43,18 @@ except Exception:
     pass" 2>/dev/null)
   plat=${parsed%%$'\t'*}
   pjson=${parsed#*$'\t'}
+  # a failed probe produces no JSON: record that too, so an all-day outage
+  # leaves committed evidence, not just silence. Distinguish a hang (no
+  # output at all — killed by the timeout) from fast-fail garbage output.
+  if [ -z "$pjson" ]; then
+    if [ -z "$probe" ]; then
+      pjson='{"alive": false, "error": "probe hang/timeout (no output; killed by probe timeout)"}'
+    else
+      pjson='{"alive": false, "error": "probe returned non-JSON output (fast failure; see /tmp/tpu_capture.log)"}'
+    fi
+  fi
   echo "$(date +%H:%M:%S) probe plat=$plat $pjson" >> $LOG
-  [ -n "$pjson" ] && echo "{\"ts\": \"$(date -Is)\", \"probe\": $pjson}" >> /root/repo/TUNNEL_LOG.jsonl
+  echo "{\"ts\": \"$(date -Is)\", \"probe\": $pjson}" >> /root/repo/TUNNEL_LOG.jsonl
   if [ -n "$plat" ] && [ "$plat" != "cpu" ]; then
     for cfg in $CFGS; do
       captured "$cfg" && continue
