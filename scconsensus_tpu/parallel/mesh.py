@@ -17,7 +17,7 @@ from jax.sharding import Mesh
 
 __all__ = [
     "make_mesh", "auto_mesh", "drain_if_cpu_mesh", "pad_axis_to_multiple",
-    "put_sharded", "require_dense", "CELL_AXIS",
+    "pad_and_shard", "put_sharded", "require_dense", "CELL_AXIS",
 ]
 
 CELL_AXIS = "cells"
@@ -38,6 +38,40 @@ def put_sharded(x, mesh: Mesh, spec):
     elif not isinstance(spec, PartitionSpec):
         spec = PartitionSpec(*spec)
     return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def pad_and_shard(x, mesh: Mesh, spec, shard_axis: int) -> Tuple[object, int]:
+    """Lay ``x`` out sharded over ``mesh``, padding ``shard_axis`` up to the
+    device count. Host numpy pads on host and uploads; a device-resident
+    ``jax.Array`` pads and redistributes ON DEVICE via ``device_put`` with
+    the target NamedSharding — no host round-trip, so the device-resident
+    input path stays device-resident through the mesh engines (ADVICE r4).
+    Returns (sharded, n_pad)."""
+    import jax.numpy as jnp
+
+    n_shards = int(mesh.devices.size)
+    if isinstance(x, jax.Array) and not isinstance(x, np.ndarray):
+        # Device-resident input never round-trips through host: pad/cast
+        # stay jnp ops. The explicit sharded device_put is a single-process
+        # optimization only — device_put of a committed array to a sharding
+        # spanning non-addressable devices is rejected by JAX, so on a
+        # multi-process mesh the global array is returned as-is and the
+        # jitted shard_map lays it out (exactly the pre-existing device
+        # path of sharded_allpairs_ranksum).
+        n_pad = (-x.shape[shard_axis]) % n_shards
+        if n_pad:
+            widths = [(0, 0)] * x.ndim
+            widths[shard_axis] = (0, n_pad)
+            x = jnp.pad(x, widths)
+        if x.dtype != jnp.float32:
+            x = x.astype(jnp.float32)
+        if jax.process_count() == 1:
+            x = put_sharded(x, mesh, spec)
+        return x, n_pad
+    xp, n_pad = pad_axis_to_multiple(
+        np.asarray(x, np.float32), shard_axis, n_shards
+    )
+    return put_sharded(xp, mesh, spec), n_pad
 
 
 def auto_mesh(axis_name: str = CELL_AXIS) -> Optional[Mesh]:

@@ -27,8 +27,7 @@ from scconsensus_tpu.parallel.mesh import (
     CELL_AXIS,
     drain_if_cpu_mesh,
     make_mesh,
-    pad_axis_to_multiple,
-    put_sharded,
+    pad_and_shard,
     require_dense,
 )
 
@@ -72,15 +71,13 @@ def sharded_aggregates(
     """
     require_dense(data, onehot)
     mesh = mesh or make_mesh(axis_name=axis_name)
-    n_shards = mesh.devices.size
-    dp, _ = pad_axis_to_multiple(np.asarray(data, np.float32), 1, n_shards)
-    op, _ = pad_axis_to_multiple(np.asarray(onehot, np.float32), 0, n_shards)
-    # sharded device_put, not jnp.asarray: on a multi-process mesh each
-    # process uploads only its addressable cell blocks
-    out = _jitted_aggregates(mesh, axis_name)(
-        put_sharded(dp, mesh, P(None, axis_name)),
-        put_sharded(op, mesh, P(axis_name)),
-    )
+    # pad_and_shard keeps a device-resident jax.Array on device (pad +
+    # redistribute in HBM); host numpy pads on host and uploads sharded —
+    # on a multi-process mesh each process uploads only its addressable
+    # cell blocks
+    dp, _ = pad_and_shard(data, mesh, P(None, axis_name), 1)
+    op, _ = pad_and_shard(onehot, mesh, P(axis_name), 0)
+    out = _jitted_aggregates(mesh, axis_name)(dp, op)
     drain_if_cpu_mesh(mesh, *out)
     return ClusterAggregates(*out)
 
@@ -126,17 +123,10 @@ def sharded_allpairs_ranksum(
     are local to a shard, so the sparse-window mode shards unchanged.
     """
     mesh = mesh or make_mesh(axis_name=axis_name)
-    n_shards = int(mesh.devices.size)
     gc = chunk.shape[0]
-    pad = (-gc) % n_shards
-    if isinstance(chunk, np.ndarray):
-        # host input (the multi-host entry): pad on host, upload sharded
-        if pad:
-            chunk = np.pad(chunk, ((0, pad), (0, 0)))
-        chunk = put_sharded(chunk.astype(np.float32, copy=False), mesh,
-                            P(axis_name, None))
-    elif pad:
-        chunk = jnp.pad(chunk, ((0, pad), (0, 0)))
+    # host input pads+uploads; device-resident input pads+redistributes in
+    # HBM — either way the jitted shard_map sees a pre-laid-out operand
+    chunk, _ = pad_and_shard(chunk, mesh, P(axis_name, None), 0)
     lp, u, ts = _jitted_allpairs(mesh, axis_name, n_clusters, window)(
         chunk, cid, n_of, pair_i, pair_j
     )
@@ -181,14 +171,14 @@ def sharded_wilcox_logp(
     """
     require_dense(data)
     mesh = mesh or make_mesh(axis_name=axis_name)
-    n_shards = mesh.devices.size
     G = data.shape[0]
-    dp, _ = pad_axis_to_multiple(np.asarray(data, np.float32), 0, n_shards)
+    # device-resident input pads/redistributes in HBM; host input uploads
+    dp, _ = pad_and_shard(data, mesh, P(axis_name, None), 0)
     # replicated small inputs stay host numpy: uncommitted values replicate
     # onto any mesh, where a jnp.asarray would commit to local device 0 and
     # be rejected by a cross-process jit
     log_p = _jitted_wilcox(mesh, axis_name)(
-        put_sharded(dp, mesh, P(axis_name, None)),
+        dp,
         np.asarray(idx, np.int32),
         np.asarray(m1),
         np.asarray(m2),

@@ -42,7 +42,7 @@ def test_crashed_section_does_not_kill_the_others():
     assert rec["value"] == extra["wilcox_s"]
     assert "wilcox" in rec["metric"]
     # an edgeR-baseline ratio against a wilcox time would be inflated
-    assert rec["vs_baseline"] == 0.0
+    assert rec["vs_baseline"] is None
 
 
 def test_all_attempts_failed_yields_structured_record():
@@ -166,7 +166,10 @@ def test_cold_run_survives_as_headline_when_steady_dies():
     assert "edger_error" in extra and "edger_cold_s" in extra
     assert rec["value"] == extra["edger_cold_s"]
     assert "COLD" in rec["metric"]
-    assert rec["vs_baseline"] > 0  # cold edgeR still prices the 30 s bar
+    # quick is a size-reduced flagship: the 30 s ratio must be null — a
+    # sub-scale run can't honestly price the 26k-cell bar (VERDICT r4 #6)
+    assert rec["vs_baseline"] is None
+    assert rec["extra"]["size_reduced"] is True
     assert "wilcox_s" in extra  # later sections still ran
 
 
@@ -178,3 +181,21 @@ def test_final_line_fits_driver_tail_window():
     })
     assert len(json.dumps(rec)) < 2000
     assert rec["value"] > 0
+    # size-reduced (quick) records never carry a vs_baseline ratio
+    assert rec["vs_baseline"] is None
+
+
+def test_vs_baseline_null_when_degraded():
+    """VERDICT r4 weak #1: BENCH_r04's 2k-cell degraded-CPU record carried
+    vs_baseline=8.165 against the 26k TPU bar. Degraded or size-reduced
+    records must report null."""
+    import bench as bench_mod
+
+    extra = {"degraded": True, "size_reduced": False}
+    assert bench_mod._vsb(3.7, extra) is None
+    extra = {"degraded": False, "size_reduced": True}
+    assert bench_mod._vsb(3.7, extra) is None
+    extra = {"degraded": False, "size_reduced": False}
+    assert bench_mod._vsb(15.0, extra) == 2.0
+    assert bench_mod._vsb(None, extra) is None
+    assert bench_mod._vsb(-1.0, extra) is None

@@ -2,15 +2,16 @@
 # TPU capture watcher v2: probe the tunnel; when up, run the bench configs in
 # priority order (evidence files /root/repo/BENCH_TPU_<cfg>.json), then one
 # phase-profiled flagship run for stage diagnosis. Loops until all captured.
-cd /root/repo
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO_ROOT"
 CFGS="flagship tm100k brain1m pbmc68k cite8k"
 LOG=/tmp/tpu_capture.log
 
 captured() {
-  python - "$1" <<'PY' 2>/dev/null
+  python - "$1" "$REPO_ROOT" <<'PY' 2>/dev/null
 import json, sys
 try:
-    d = json.load(open(f"/root/repo/BENCH_TPU_{sys.argv[1]}.json"))
+    d = json.load(open(f"{sys.argv[2]}/BENCH_TPU_{sys.argv[1]}.json"))
 except Exception:
     sys.exit(1)
 ex = d.get("extra", {})
@@ -54,13 +55,13 @@ except Exception:
     fi
   fi
   echo "$(date +%H:%M:%S) probe plat=$plat $pjson" >> $LOG
-  echo "{\"ts\": \"$(date -Is)\", \"probe\": $pjson}" >> /root/repo/TUNNEL_LOG.jsonl
+  echo "{\"ts\": \"$(date -Is)\", \"probe\": $pjson}" >> "$REPO_ROOT/TUNNEL_LOG.jsonl"
   if [ -n "$plat" ] && [ "$plat" != "cpu" ]; then
     for cfg in $CFGS; do
       captured "$cfg" && continue
       echo "$(date +%H:%M:%S) RUN $cfg" >> $LOG
       SCC_BENCH_CONFIG=$cfg \
-      SCC_BENCH_CKPT=/root/repo/BENCH_TPU_$cfg.json \
+      SCC_BENCH_CKPT="$REPO_ROOT/BENCH_TPU_$cfg.json" \
       SCC_BENCH_NO_CPU_FALLBACK=1 \
       timeout 4000 python bench.py >> /tmp/tpu_capture_$cfg.out 2>&1
       echo "$(date +%H:%M:%S) DONE $cfg rc=$?" >> $LOG
