@@ -673,7 +673,6 @@ def worker() -> None:
             b1m_state["secs"] = elapsed
             b1m_state["phase"] = "steady"
         log(f"[bench] steady: {elapsed:.2f}s {info}")
-        b1m_state["secs"] = elapsed
         extra.update(info)
         final = _b1m_record(elapsed)
         _write_ckpt(final)
@@ -840,7 +839,6 @@ def worker() -> None:
             for s in result.metrics.get("stages", [])
             if "wall_s" in s
         }
-    refine_state["secs"] = elapsed
     final = _refine_record(elapsed)
     _write_ckpt(final)
     print(json.dumps(final))

@@ -314,6 +314,71 @@ def _exact_host_update(
     log_p[row, cols] = np.log(pe).astype(np.float32)
 
 
+def _redo_overflow_genes(parts, overflow, jdata, jcid, jn, jpi, jpj, K,
+                         run_cap):
+    """Windowed path: re-route genes whose tie-run count overflowed the
+    run-space table to the scan kernel and splice the corrected rows back
+    into the collected block outputs. ONE batched n_runs fetch for all
+    blocks, after every block has been dispatched — keeps the main loop's
+    async pipelining intact (rare path: counts-derived data stays under
+    the cap; continuous data overflows and pays one cheap wasted pass)."""
+    from scconsensus_tpu.ops.ranksum_allpairs import allpairs_ranksum_chunk
+
+    all_nr = jax.device_get([nr for _, _, _, nr in overflow])
+    for (idx, ids, weff, _), nr in zip(overflow, all_nr):
+        bad = np.nonzero(nr[: ids.size] > run_cap)[0]
+        if not bad.size:
+            continue
+        rows = jnp.take(jdata, jnp.asarray(ids[bad]), axis=0)
+        pad_to = _next_pow2(max(int(bad.size), 256))
+        if bad.size < pad_to:
+            rows = jnp.pad(rows, ((0, pad_to - bad.size), (0, 0)))
+        lp_r, u_r, ts_r = allpairs_ranksum_chunk(
+            rows, jcid, jn, jpi, jpj, K, window=weff,
+        )
+        sel = jnp.asarray(bad)
+        ids0, (lp0, u0, ts0) = parts[idx]
+        parts[idx] = (ids0, (
+            lp0.at[sel].set(lp_r[: bad.size]),
+            u0.at[sel].set(u_r[: bad.size]),
+            ts0.at[sel].set(ts_r[: bad.size]),
+        ))
+
+
+def _redo_overflow_dense(outs, overflow, data, gc, jdata, jcid, jn, jpi,
+                         jpj, K, run_cap):
+    """Dense-path twin of ``_redo_overflow_genes``: chunks are re-
+    materialized from the source matrix (sparse inputs densify the chunk
+    again) and fully re-run through the scan kernel when any gene in the
+    chunk overflowed — dense chunks are span-shaped, so per-gene splicing
+    would re-gather anyway."""
+    from scconsensus_tpu.io.sparsemat import is_sparse, padded_row_chunk
+    from scconsensus_tpu.ops.ranksum_allpairs import allpairs_ranksum_chunk
+
+    all_nr = jax.device_get([nr for _, _, _, nr in overflow])
+    sparse = is_sparse(data)
+    for (idx, g0, g1, _), nr in zip(overflow, all_nr):
+        bad = np.nonzero(nr[: g1 - g0] > run_cap)[0]
+        if not bad.size:
+            continue
+        if sparse:
+            chunk = jnp.asarray(padded_row_chunk(data, g0, gc))
+        else:
+            chunk = jdata[g0: g0 + gc]
+            if chunk.shape[0] < gc:
+                chunk = jnp.pad(chunk, ((0, gc - chunk.shape[0]), (0, 0)))
+        lp_r, u_r, ts_r = allpairs_ranksum_chunk(
+            chunk, jcid, jn, jpi, jpj, K
+        )
+        sel = jnp.asarray(bad)
+        _, _, (lp0, u0, ts0) = outs[idx]
+        outs[idx] = (g0, g1, (
+            lp0.at[sel].set(lp_r[sel]),
+            u0.at[sel].set(u_r[sel]),
+            ts0.at[sel].set(ts_r[sel]),
+        ))
+
+
 def _run_wilcox_device(
     data: np.ndarray,
     cell_idx_of: List[np.ndarray],
@@ -341,9 +406,13 @@ def _run_wilcox_device(
     decomposition, ops.ranksum_allpairs) — expression data is mostly zeros,
     so most genes pay a fraction of the full N-cell scan.
     """
+    import os
+
     from scconsensus_tpu.ops.ranksum_allpairs import (
         _ALLPAIRS_ELEM_BUDGET,
+        RUN_CAP,
         allpairs_ranksum_chunk,
+        allpairs_ranksum_runspace_chunk,
         chunk_genes_for_budget,
     )
 
@@ -357,6 +426,19 @@ def _run_wilcox_device(
     jpj = jnp.asarray(pair_j)
     gc = chunk_genes_for_budget(N, K)
     gc = min(gc, _next_pow2(G))
+    # Tied-run kernel on the single-device CPU path: the scan kernel's
+    # cummax/cummin fills lower to ~43 ns/element scans on XLA:CPU (92 % of
+    # its wall there, ROUND5_NOTES.md) while the tied-run formulation needs
+    # one cumsum + scatter-built per-run tables; genes whose tied-run count
+    # overflows the table are re-run through the scan kernel below. TPU
+    # keeps the scan body everywhere (its scan lowerings are fast, the
+    # layout was tuned on v5e, and TPU scatters are not); the mesh path
+    # likewise (one shard_mapped program, no host redo round-trip).
+    use_runspace = (
+        mesh is None
+        and jax.default_backend() == "cpu"
+        and not os.environ.get("SCC_NO_RUNSPACE")
+    )
     if mesh is not None:
         from scconsensus_tpu.parallel.sharded_de import sharded_allpairs_ranksum
 
@@ -376,6 +458,7 @@ def _run_wilcox_device(
         order = np.argsort(nnz_g, kind="stable").astype(np.int64)
         nnz_sorted = nnz_g[order]
         parts = []  # (gene_ids, (log_p, u, ties)) in sorted-gene order
+        overflow = []  # (part idx, ids, window, device n_runs)
         g0 = 0
         while g0 < G:
             # window floor 1024: bounds the distinct compiled shapes (cold
@@ -409,18 +492,27 @@ def _run_wilcox_device(
             gcb_eff = min(gcb, _next_pow2(max(int(ids.size), 256)))
             if ids.size < gcb_eff:
                 rows = jnp.pad(rows, ((0, gcb_eff - ids.size), (0, 0)))
+            weff = w if w < N else 0
             if mesh is not None:
                 out = sharded_allpairs_ranksum(
-                    rows, jcid, jn, jpi, jpj, K, mesh=mesh,
-                    window=(w if w < N else 0),
+                    rows, jcid, jn, jpi, jpj, K, mesh=mesh, window=weff,
                 )
+            elif use_runspace:
+                lp_b, u_b, ts_b, nr_b = allpairs_ranksum_runspace_chunk(
+                    rows, jcid, jn, jpi, jpj, K, window=weff,
+                )
+                out = (lp_b, u_b, ts_b)
+                overflow.append((len(parts), ids, weff, nr_b))
             else:
                 out = allpairs_ranksum_chunk(
-                    rows, jcid, jn, jpi, jpj, K,
-                    window=(w if w < N else 0),
+                    rows, jcid, jn, jpi, jpj, K, window=weff,
                 )
             parts.append((ids, out))
             g0 = g1
+        if use_runspace and overflow:
+            _redo_overflow_genes(
+                parts, overflow, jdata, jcid, jn, jpi, jpj, K, RUN_CAP,
+            )
         inv = np.empty(G, np.int64)
         inv[np.concatenate([ids for ids, _ in parts])] = np.arange(G)
         jinv = jnp.asarray(inv)
@@ -434,15 +526,27 @@ def _run_wilcox_device(
         outs = None
     else:
         outs = []
+        overflow = []  # (outs idx, g0, g1, device n_runs)
         for g0, g1, chunk in _gene_chunks(data, gc, jdata=jdata):
             if mesh is not None:
                 outs.append((g0, g1, sharded_allpairs_ranksum(
                     chunk, jcid, jn, jpi, jpj, K, mesh=mesh
                 )))
+            elif use_runspace:
+                lp_b, u_b, ts_b, nr_b = allpairs_ranksum_runspace_chunk(
+                    chunk, jcid, jn, jpi, jpj, K
+                )
+                overflow.append((len(outs), g0, g1, nr_b))
+                outs.append((g0, g1, (lp_b, u_b, ts_b)))
             else:
                 outs.append((g0, g1, allpairs_ranksum_chunk(
                     chunk, jcid, jn, jpi, jpj, K
                 )))
+        if use_runspace and overflow:
+            _redo_overflow_dense(
+                outs, overflow, data, gc, jdata, jcid, jn, jpi, jpj, K,
+                RUN_CAP,
+            )
         log_p = jnp.concatenate(
             [lp[: g1 - g0] for g0, g1, (lp, _, _) in outs], axis=0
         ).T  # (P, G)

@@ -45,6 +45,8 @@ __all__ = [
     "one_group_nb_rate",
     "q2q_nbinom",
     "q2q_normal",
+    "q2q_normal_raw",
+    "q2q_gamma_raw",
     "equalize_pseudo",
     "common_dispersion_grid",
     "tagwise_dispersion",
@@ -151,17 +153,29 @@ def one_group_nb_rate(
     return jnp.where(tot_y > 0, jnp.exp(beta), 0.0)
 
 
-def _qgamma(p: jnp.ndarray, shape: jnp.ndarray, n_iter: int = 6) -> jnp.ndarray:
+def _qgamma(p: jnp.ndarray, shape: jnp.ndarray, n_iter: int = 3) -> jnp.ndarray:
     """Gamma(shape, scale=1) quantile via Wilson–Hilferty start + Newton on
-    the regularized incomplete gamma (no gammaincinv in jax.scipy)."""
+    the regularized incomplete gamma (no gammaincinv in jax.scipy).
+
+    ``gammainc`` is ~60× a ``gammaln`` on this backend and dominates the
+    whole q2q map (the NB engine's hottest phase), so iterations are
+    precious: measured against scipy's exact ``gammaincinv`` over the
+    realistic (λ·lib, φ) domain, 3 Newton steps from the WH start give the
+    same p99/aggregate pseudo-count error as the previous 6 (the clamped
+    steps converge slowly in the extreme-shape tails either way; at φ=2.5
+    the 3-step aggregate error is actually LOWER, 2.3e-2 vs 3.5e-2 — see
+    ROUND5_NOTES.md; 2 steps shaved engine↔oracle DE agreement in the
+    high-dispersion stress regime below its 0.98 gate, so 3 it is).
+    ``gammaln(shape)`` is loop-invariant and hoisted."""
     z = jsp.ndtri(jnp.clip(p, 1e-7, 1.0 - 1e-7))
     c = 1.0 / (9.0 * jnp.maximum(shape, 1e-6))
     x0 = shape * (1.0 - c + z * jnp.sqrt(c)) ** 3
     x0 = jnp.maximum(x0, 1e-8)
+    log_norm = jsp.gammaln(shape)
 
     def body(_, x):
         f = jsp.gammainc(shape, x) - p
-        logpdf = (shape - 1.0) * jnp.log(x) - x - jsp.gammaln(shape)
+        logpdf = (shape - 1.0) * jnp.log(x) - x - log_norm
         pdf = jnp.exp(logpdf)
         step = f / jnp.maximum(pdf, 1e-30)
         x_new = x - jnp.clip(step, -0.5 * x, 0.5 * x + 1.0)
@@ -193,6 +207,48 @@ def q2q_normal(
     return jnp.maximum(mu_out + (x - mu_in) * jnp.sqrt(v_out / v_in), 0.0)
 
 
+def q2q_normal_raw(
+    x: jnp.ndarray,
+    mu_in: jnp.ndarray,
+    mu_out: jnp.ndarray,
+    dispersion: jnp.ndarray,
+) -> jnp.ndarray:
+    """Unclamped normal half of the NB quantile map (z-score transfer).
+    Shared by ``q2q_nbinom`` and the zero-compacted table builder in
+    de.edger so the two paths stay arithmetically identical."""
+    mu_in = jnp.maximum(mu_in, 1e-10)
+    mu_out = jnp.maximum(mu_out, 1e-10)
+    v_in = mu_in + dispersion * mu_in * mu_in
+    v_out = mu_out + dispersion * mu_out * mu_out
+    return mu_out + (x - mu_in) * jnp.sqrt(v_out / v_in)
+
+
+def q2q_gamma_raw(
+    x: jnp.ndarray,
+    mu_in: jnp.ndarray,
+    mu_out: jnp.ndarray,
+    dispersion: jnp.ndarray,
+) -> jnp.ndarray:
+    """Gamma half of the NB quantile map: moment-matched shapes, lower-tail
+    quantile transfer. x = 0 maps to EXACTLY 0: the continuous gamma
+    approximation places no mass below 0, so the transferred quantile of a
+    zero count is the 0-quantile — the previous behavior (clip p to 1e-7,
+    invert) returned the 1e-7-quantile, a pure clip artifact. This is also
+    what lets the table builder skip the ~60×-a-gammaln ``gammainc`` chain
+    on the zero entries entirely (they dominate expression matrices)."""
+    mu_in = jnp.maximum(mu_in, 1e-10)
+    mu_out = jnp.maximum(mu_out, 1e-10)
+    v_in = mu_in + dispersion * mu_in * mu_in
+    v_out = mu_out + dispersion * mu_out * mu_out
+    shape_in = mu_in * mu_in / v_in
+    scale_in = v_in / mu_in
+    shape_out = mu_out * mu_out / v_out
+    scale_out = v_out / mu_out
+    p = jsp.gammainc(shape_in, jnp.maximum(x, 0.0) / scale_in)
+    q_gamma = _qgamma(p, shape_out) * scale_out
+    return jnp.where(x > 0, q_gamma, 0.0)
+
+
 def q2q_nbinom(
     x: jnp.ndarray,
     mu_in: jnp.ndarray,
@@ -206,20 +262,8 @@ def q2q_nbinom(
     gamma-approximation map — the same two-approximation average edgeR's
     quantile adjustment is built on. Inputs broadcast; dispersion ≥ 0.
     """
-    mu_in = jnp.maximum(mu_in, 1e-10)
-    mu_out = jnp.maximum(mu_out, 1e-10)
-    v_in = mu_in + dispersion * mu_in * mu_in
-    v_out = mu_out + dispersion * mu_out * mu_out
-    # Normal map: pnorm then qnorm with matched tails == z-score transfer.
-    q_norm = mu_out + (x - mu_in) * jnp.sqrt(v_out / v_in)
-    # Gamma map: moment-matched shapes; lower tail (quantile transfer is
-    # monotone, and pseudo-counts near the mean dominate downstream sums).
-    shape_in = mu_in * mu_in / v_in
-    scale_in = v_in / mu_in
-    shape_out = mu_out * mu_out / v_out
-    scale_out = v_out / mu_out
-    p = jsp.gammainc(shape_in, jnp.maximum(x, 0.0) / scale_in)
-    q_gamma = _qgamma(p, shape_out) * scale_out
+    q_norm = q2q_normal_raw(x, mu_in, mu_out, dispersion)
+    q_gamma = q2q_gamma_raw(x, mu_in, mu_out, dispersion)
     return jnp.maximum(0.5 * (q_norm + q_gamma), 0.0)
 
 
@@ -392,8 +436,11 @@ def nb_exact_test_logp(
     sc = jnp.minimum(s, float(s_max))[..., None]
     ratio_num = (sc - a) * (a + alpha[..., None])
     ratio_den = (a + 1.0) * (sc - a - 1.0 + beta[..., None])
-    log_ratio = jnp.log(jnp.maximum(ratio_num, 1e-37)) - jnp.log(
-        jnp.maximum(ratio_den, 1e-37)
+    # one log of the ratio, not log(num)−log(den): the transcendental count
+    # is the cost of this sweep, and both operands are far from f32
+    # overflow (≤ s_max·(s_max+α) ≲ 1e9)
+    log_ratio = jnp.log(
+        jnp.maximum(ratio_num, 1e-37) / jnp.maximum(ratio_den, 1e-37)
     )
     # u(a) = log pmf(a) − log pmf(0); valid for a ≤ s.
     u = jnp.concatenate(

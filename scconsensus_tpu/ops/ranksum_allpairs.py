@@ -62,12 +62,26 @@ import jax.numpy as jnp
 
 from scconsensus_tpu.ops.wilcoxon import wilcoxon_from_ranks
 
-__all__ = ["allpairs_ranksum_chunk", "ranksum_body", "chunk_genes_for_budget"]
+__all__ = [
+    "allpairs_ranksum_chunk", "allpairs_ranksum_runspace_chunk",
+    "ranksum_body", "ranksum_body_runspace", "chunk_genes_for_budget",
+    "RUN_CAP",
+]
 
 _HIGHEST = jax.lax.Precision.HIGHEST
 
 # Element budget for the (Gc, K, N) working tensors (~6 live at once).
 _ALLPAIRS_ELEM_BUDGET = 320_000_000
+
+# Static TIED-run table height of the tie-table kernel. Only runs of size
+# ≥ 2 need slots: counts-derived values (raw counts, log1p counts, ADT)
+# have ≤ ~25 distinct values per gene, and per-cell normalized 26k-cell
+# flagship data measures p50 = 224 / p99 = 746 / max = 1070 tied runs per
+# gene (ROUND5_NOTES.md) — 2048 covers both regimes with slack. The table
+# is filled by scatter-add (independent of the cap), so the cap only
+# prices the small (Gc, T, K) per-run einsums. Genes that overflow are
+# re-routed to the scan kernel by the caller (engine._run_wilcox_device).
+RUN_CAP = 2048
 
 
 def chunk_genes_for_budget(n_cells: int, n_clusters: int,
@@ -155,8 +169,22 @@ def ranksum_body(
         "gkn,gln->gkl", C * own_eq[:, None, :], E, precision=_HIGHEST
     )
 
-    # Per-pair extraction as tiny matmuls (TPU gathers on (Gc, K, K) with a
-    # 1k-wide pair list measured slower than the one-hot contraction).
+    nnz_k = jnp.sum(C, axis=-1)                             # (Gc, K)
+    return _pairs_finish(u_mat, B, nnz_k, n_of, pair_i, pair_j, n_clusters,
+                         sparse_mode)
+
+
+def _pairs_finish(u_mat, B, nnz_k, n_of, pair_i, pair_j, n_clusters: int,
+                  sparse_mode: bool):
+    """Shared tail of the scan and run-space kernels: per-pair extraction
+    from the (K, K) statistic matrices, zero-block corrections (sparse
+    mode), and the p-value — one implementation so the two formulations
+    cannot drift.
+
+    Per-pair extraction is tiny matmuls (TPU gathers on (Gc, K, K) with a
+    1k-wide pair list measured slower than the one-hot contraction)."""
+    Gc = u_mat.shape[0]
+    K = n_clusters
     P = pair_i.shape[0]
     sel_i = jax.nn.one_hot(pair_i, K, dtype=jnp.float32)    # (P, K)
     sel_j = jax.nn.one_hot(pair_j, K, dtype=jnp.float32)
@@ -175,7 +203,6 @@ def ranksum_body(
     if sparse_mode:
         # Zero-block corrections. nnz/z per (gene, cluster) from the window
         # counts; pair columns via the same one-hot contractions.
-        nnz_k = jnp.sum(C, axis=-1)                         # (Gc, K)
         z_k = jnp.maximum(n_of.astype(jnp.float32)[None, :] - nnz_k, 0.0)
         nnz_j = jnp.dot(nnz_k, sel_j.T, precision=_HIGHEST)  # (Gc, P)
         z_i = jnp.dot(z_k, sel_i.T, precision=_HIGHEST)
@@ -196,8 +223,122 @@ def ranksum_body(
     return log_p, u_out, tie_sum
 
 
-# Single-device jitted entry; the sharded form lives in
-# parallel.sharded_de.sharded_allpairs_ranksum and shard_maps the same body.
+def ranksum_body_runspace(
+    chunk: jnp.ndarray,
+    cid: jnp.ndarray,
+    n_of: jnp.ndarray,
+    pair_i: jnp.ndarray,
+    pair_j: jnp.ndarray,
+    n_clusters: int,
+    window: int = 0,
+    run_cap: int = RUN_CAP,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Tied-run formulation of ``ranksum_body`` — one cumsum, no fills.
+
+    The scan kernel's cummax/cummin fills (~87 of its ~100 ns/element on
+    this backend's log-depth scan lowering) exist only to spread run-start
+    and run-end values across TIE runs. But a position p in a size-1 run
+    satisfies, for every other cluster j,
+
+        L_j(p) + E_j(p)/2 = S_j(p) − C_j(p)
+
+    directly from the inclusive cumsum S — no fill needed — and positions
+    in size-≥2 runs can be routed through a tiny per-RUN table instead:
+    with R[k, t] = # cells of cluster k in tied run t and
+    Lg[j, t] = # j-cells strictly before the run (S − C at the run start),
+
+        U[i, j] = Σ_{p untied} C_i(S_j − C_j) + Σ_t R_i·(Lg_j + R_j/2),
+        B[k, l] = diag(# untied positions of k) + Σ_t R_k²·R_l,
+
+    which is exactly the scan kernel's statistic (size-1 runs contribute
+    t³−t = 0 to the tie moments). Both data regimes fit one cap:
+    counts-derived values have ≤ ~25 runs TOTAL per gene; per-cell
+    normalized values (the reference's input convention,
+    R/reclusterDEConsensus.R:5) measure p50 = 224 / max ≈ 1100 tied runs
+    per gene at the 26k-cell flagship — under the 2048 slots. (A first
+    attempt capped TOTAL runs at 32 and overflowed on every normalized
+    gene, making the bench 4 % SLOWER than the scan kernel via the wasted
+    pass + redo — ROUND5_NOTES.md tells the story.)
+
+    Cost: one sort + one (Gc, K, W) cumsum (~13 ns/elem) + scatter-built
+    per-run tables + batched gemms — the fills are gone. Returns
+    (log_p, u, tie_sum, n_tied_runs); entries whose ``n_tied_runs >
+    run_cap`` had tail runs merged and are INVALID — the caller re-routes
+    those genes to ``ranksum_body`` (engine._run_wilcox_device does).
+    """
+    Gc, N = chunk.shape
+    K = n_clusters
+    sparse_mode = 0 < window < N
+    key = -chunk if sparse_mode else chunk
+    sv, scid = jax.lax.sort(
+        (key, jnp.broadcast_to(cid, chunk.shape)), dimension=1, num_keys=1
+    )
+    if sparse_mode:
+        sv = sv[:, :window]
+        scid = jnp.where(sv < 0, scid[:, :window], -1)
+    W = sv.shape[1]
+
+    oh_k = (scid[:, :, None] == jnp.arange(K, dtype=jnp.int32)[None, None, :]
+            ).astype(jnp.float32)                           # (Gc, W, K)
+    S = jnp.cumsum(oh_k, axis=1)                            # inclusive
+    SmC = S - oh_k                                          # strictly-before
+
+    same_prev = jnp.concatenate(
+        [jnp.zeros((Gc, 1), bool), sv[:, 1:] == sv[:, :-1]], axis=1
+    )
+    same_next = jnp.concatenate(
+        [same_prev[:, 1:], jnp.zeros((Gc, 1), bool)], axis=1
+    )
+    tied = same_prev | same_next                            # (Gc, W)
+    if sparse_mode:
+        # the window's all-zero tail (sv == 0; every such position is
+        # already excluded, scid = -1) would otherwise count as one tied
+        # run per gene — wasting a table slot and over-reporting n_truns
+        # by one at the overflow boundary. Positives are strictly sv < 0
+        # here, so this cannot touch a live cell's run membership.
+        tied = tied & (sv < 0)
+    tstart = tied & ~same_prev
+    tid_raw = jnp.cumsum(tstart.astype(jnp.int32), axis=1) - 1
+    n_truns = tid_raw[:, -1] + 1                            # tied runs/gene
+    # table height: a window of W holds at most W/2 size-≥2 runs
+    T = int(min(run_cap, 1 << (max(W // 2, 1)).bit_length()))
+    tid = jnp.clip(tid_raw, 0, T - 1)
+    # Per-run tables by scatter-add (cost ~ one (Gc, W, K) pass, independent
+    # of T — a one-hot einsum at T=2048 would materialize a 17 GB tensor).
+    gidx = jnp.arange(Gc, dtype=jnp.int32)[:, None]         # (Gc, 1)
+    tied_f = tied[:, :, None].astype(jnp.float32)
+    R = jnp.zeros((Gc, T, K), jnp.float32).at[gidx, tid].add(
+        oh_k * tied_f
+    )                                                       # (Gc, T, K)
+    # j-cells strictly before each tied run: S−C at the run-start position
+    Lg = jnp.zeros((Gc, T, K), jnp.float32).at[gidx, tid].add(
+        SmC * tstart[:, :, None].astype(jnp.float32)
+    )
+    Cu = oh_k * (1.0 - tied_f)                              # untied one-hot
+    u_mat = (
+        jnp.einsum("gwi,gwj->gij", Cu, SmC, precision=_HIGHEST)
+        + jnp.einsum("gti,gtj->gij", R, Lg + 0.5 * R, precision=_HIGHEST)
+    )
+    untied_k = jnp.sum(Cu, axis=1)                          # (Gc, K)
+    B = jnp.einsum("gtk,gtl->gkl", R * R, R, precision=_HIGHEST)
+    B = B + untied_k[:, :, None] * jnp.eye(K, dtype=jnp.float32)[None]
+    nnz_k = S[:, -1, :]
+    log_p, u_out, tie_sum = _pairs_finish(
+        u_mat, B, nnz_k, n_of, pair_i, pair_j, n_clusters, sparse_mode
+    )
+    # overflow contract: callers test `> run_cap`, so a gene exceeding the
+    # EFFECTIVE table height T (possibly < run_cap at small windows) must
+    # read as over the cap too
+    n_truns = jnp.where(n_truns > T, jnp.maximum(n_truns, run_cap + 1),
+                        n_truns)
+    return log_p, u_out, tie_sum, n_truns
+
+
+# Single-device jitted entries; the sharded form lives in
+# parallel.sharded_de.sharded_allpairs_ranksum and shard_maps the scan body.
 allpairs_ranksum_chunk = jax.jit(
     ranksum_body, static_argnames=("n_clusters", "window")
+)
+allpairs_ranksum_runspace_chunk = jax.jit(
+    ranksum_body_runspace, static_argnames=("n_clusters", "window", "run_cap")
 )

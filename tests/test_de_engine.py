@@ -306,3 +306,125 @@ class TestSparseWindowRanksum:
                 np.testing.assert_allclose(
                     lp[p, row], np.log(ref.pvalue), rtol=5e-4, atol=5e-4
                 )
+
+
+class TestRunspaceKernel:
+    """Run-space all-pairs kernel (ranksum_body_runspace): identical output
+    to the scan kernel on tie-heavy data, honest overflow signalling on
+    continuous data, and the engine's scan-fallback for overflowed genes."""
+
+    def _geom(self, rng, g=40, n=900, k=5):
+        data = np.round(rng.gamma(1.5, size=(g, n)) * 3) / 3  # heavy ties
+        data[rng.random((g, n)) < 0.5] = 0.0
+        lab = rng.integers(0, k, n)
+        lab[:5] = -1
+        cell_idx_of = [np.nonzero(lab == c)[0].astype(np.int32)
+                       for c in range(k)]
+        pi, pj = _all_pairs(k)
+        n_of = np.array([ci.size for ci in cell_idx_of], np.int32)
+        cid = _cid_from_groups(cell_idx_of, n)
+        return data, cid, n_of, pi, pj, k
+
+    @pytest.mark.parametrize("window", [0, 256])
+    def test_matches_scan_kernel(self, rng, window):
+        import jax.numpy as jnp
+
+        from scconsensus_tpu.ops.ranksum_allpairs import (
+            RUN_CAP,
+            allpairs_ranksum_chunk,
+            allpairs_ranksum_runspace_chunk,
+        )
+
+        data, cid, n_of, pi, pj, k = self._geom(rng)
+        args = (jnp.asarray(data), jnp.asarray(cid), jnp.asarray(n_of),
+                jnp.asarray(pi), jnp.asarray(pj))
+        ref = allpairs_ranksum_chunk(*args, n_clusters=k, window=window)
+        got = allpairs_ranksum_runspace_chunk(
+            *args, n_clusters=k, window=window
+        )
+        assert int(np.asarray(got[3]).max()) <= RUN_CAP
+        for a, b in zip(ref, got[:3]):
+            a, b = np.asarray(a), np.asarray(b)
+            assert np.array_equal(np.isnan(a), np.isnan(b))
+            m = np.isfinite(a)
+            # same statistic, different f32 summation order
+            np.testing.assert_allclose(a[m], b[m], rtol=1e-5, atol=1e-3)
+
+    def test_normalized_continuous_data_fits_the_cap(self, rng):
+        """Per-cell normalized values are mostly distinct: only the few
+        genuinely tied runs need table slots, so the tied-run kernel stays
+        valid where the first (total-run) formulation overflowed on every
+        gene (ROUND5_NOTES.md)."""
+        import jax.numpy as jnp
+
+        from scconsensus_tpu.ops.ranksum_allpairs import (
+            RUN_CAP,
+            allpairs_ranksum_chunk,
+            allpairs_ranksum_runspace_chunk,
+        )
+
+        g, n, k = 10, 800, 3
+        counts = rng.poisson(1.2, (g, n)).astype(np.float32)
+        lib = counts.sum(axis=0, keepdims=True)
+        data = np.log1p(counts / np.maximum(lib, 1.0) * 1e4)  # distinct
+        cid = rng.integers(0, k, n).astype(np.int32)
+        n_of = np.bincount(cid, minlength=k).astype(np.int32)
+        pi = np.array([0, 0, 1], np.int32)
+        pj = np.array([1, 2, 2], np.int32)
+        args = (jnp.asarray(data), jnp.asarray(cid), jnp.asarray(n_of),
+                jnp.asarray(pi), jnp.asarray(pj))
+        ref = allpairs_ranksum_chunk(*args, n_clusters=k, window=256)
+        lp, u, ts, nr = allpairs_ranksum_runspace_chunk(
+            *args, n_clusters=k, window=256
+        )
+        assert int(np.asarray(nr).max()) <= RUN_CAP
+        m = np.isfinite(np.asarray(ref[0]))
+        np.testing.assert_allclose(
+            np.asarray(lp)[m], np.asarray(ref[0])[m], rtol=1e-5, atol=1e-3
+        )
+
+    def test_overflow_flagged_on_tie_heavy_wide_data(self, rng):
+        """More than RUN_CAP genuinely tied runs must be flagged invalid."""
+        import jax.numpy as jnp
+
+        from scconsensus_tpu.ops.ranksum_allpairs import (
+            RUN_CAP,
+            allpairs_ranksum_runspace_chunk,
+        )
+
+        n_pairs_vals = RUN_CAP + 200
+        base = rng.permutation(
+            np.repeat(np.arange(n_pairs_vals, dtype=np.float32), 2)
+        )
+        n = base.size
+        data = np.tile(base, (4, 1)) + 1.0
+        cid = rng.integers(0, 3, n).astype(np.int32)
+        n_of = np.bincount(cid, minlength=3).astype(np.int32)
+        pi = np.array([0, 0, 1], np.int32)
+        pj = np.array([1, 2, 2], np.int32)
+        _, _, _, nr = allpairs_ranksum_runspace_chunk(
+            jnp.asarray(data), jnp.asarray(cid), jnp.asarray(n_of),
+            jnp.asarray(pi), jnp.asarray(pj), n_clusters=3,
+        )
+        assert (np.asarray(nr) > RUN_CAP).all()
+
+    def test_engine_falls_back_for_overflow_genes(self, rng, monkeypatch):
+        """Continuous (all-distinct) genes overflow the run table; the
+        engine must transparently re-run them through the scan kernel and
+        return the same answers as a no-runspace run."""
+        g, n, k = 12, 600, 3
+        data = np.abs(rng.normal(size=(g, n))).astype(np.float32)
+        data[rng.random((g, n)) < 0.4] = 0.0   # sparse but untied positives
+        lab = rng.integers(0, k, n)
+        cell_idx_of = [np.nonzero(lab == c)[0].astype(np.int32)
+                       for c in range(k)]
+        pi, pj = _all_pairs(k)
+        lp_rs, u_rs = _run_wilcox(data, cell_idx_of, pi, pj, exact="never")
+        monkeypatch.setenv("SCC_NO_RUNSPACE", "1")
+        lp_sc, u_sc = _run_wilcox(data, cell_idx_of, pi, pj, exact="never")
+        np.testing.assert_array_equal(
+            np.isnan(lp_rs), np.isnan(lp_sc)
+        )
+        m = np.isfinite(lp_sc)
+        np.testing.assert_allclose(lp_rs[m], lp_sc[m], rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(u_rs, u_sc, atol=1e-3)

@@ -171,3 +171,51 @@ def test_logfc_close(results):
     big = m & (np.abs(old.log_fc) > np.log(2.0))
     err = np.abs(new.log_fc[big] - old.log_fc[big])
     assert np.median(err) < 0.2, np.median(err)
+
+
+def test_zero_compacted_table_equals_uncompacted():
+    """The nnz-windowed sorted table builder (_sub_table_sorted_chunk) must
+    produce the same node table and pseudo sums as the straight per-element
+    path (_sub_pseudo_chunk + _table_chunk): sorting carries (cid, lib), the
+    per-cluster sums are order-free, and the gamma map's x=0 closed form is
+    shared via ops.negbin.q2q_gamma_raw."""
+    import jax.numpy as jnp
+
+    from scconsensus_tpu.de.edger import (
+        _sub_pseudo_chunk,
+        _sub_table_sorted_chunk,
+        _table_chunk,
+    )
+
+    rng = np.random.default_rng(5)
+    G, Ns, K, R = 32, 180, 4, 24
+    counts = rng.poisson(0.9, (G, Ns)).astype(np.float32)
+    counts[rng.random((G, Ns)) < 0.5] = 0.0
+    lib = rng.uniform(200.0, 900.0, Ns).astype(np.float32)
+    cid = rng.integers(0, K, Ns).astype(np.int32)
+    onehot = np.zeros((Ns, K), np.float32)
+    onehot[np.arange(Ns), cid] = 1.0
+    rates = rng.gamma(0.4, 0.004, (G, K)).astype(np.float32)
+    r_nodes = jnp.asarray(
+        np.exp(np.linspace(-5.0, 9.0, R)).astype(np.float32)
+    )
+    phi, clib = jnp.float32(0.07), jnp.float32(500.0)
+
+    psub = _sub_pseudo_chunk(
+        jnp.asarray(counts), jnp.asarray(lib), jnp.asarray(cid),
+        jnp.asarray(rates), clib, phi,
+    )
+    t_ref, z_ref = _table_chunk(psub, jnp.asarray(onehot), r_nodes)
+
+    max_nnz = int((counts > 0).sum(axis=1).max())
+    t_got, z_got = _sub_table_sorted_chunk(
+        jnp.asarray(counts), jnp.asarray(lib), jnp.asarray(cid),
+        jnp.asarray(rates), clib, phi, r_nodes,
+        window=max(128, max_nnz), n_clusters=K,
+    )
+    np.testing.assert_allclose(
+        np.asarray(z_got), np.asarray(z_ref), rtol=1e-5, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(t_got), np.asarray(t_ref), rtol=1e-4, atol=2e-2
+    )

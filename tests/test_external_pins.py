@@ -1,0 +1,170 @@
+"""Definition-level external pins for the re-derived NB numerics (VERDICT r4 #7).
+
+The parity kit (parity_kit/) needs an R host and has not been executable in
+this environment; the published worked examples (Robinson & Smyth 2008,
+Langfelder & Horvath 2008) are likewise unavailable offline, so transcribing
+them is impossible without fabrication. These tests are the honest
+next-best: they pin the re-derivations against DISTRIBUTIONAL ground truths
+that are independent of both our implementation and our reading of the
+papers' algorithm descriptions —
+
+* the qCML conditional likelihood (``nb_cond_log_lik``, our reading of
+  Robinson & Smyth 2008 eq. for the conditional log-likelihood given the
+  group sum) is checked against the textbook NB additivity fact: a sum of
+  n iid NB(r, p) variables is NB(n·r, p), so the exact conditional
+  probability  P(y₁..yₙ | Σy = z) = Π nbinom.pmf(y_j; r, p) /
+  nbinom.pmf(z; n·r, p)  is computable from scipy's independent NB pmf with
+  NO shared code or shared derivation. The conditional must also be
+  p-independent (that is WHY qCML conditions on the sum) — asserted at two
+  different p values.
+* the common-dispersion maximizer (grid + quadratic refinement) is checked
+  against a brute-force argmax of that scipy-computed conditional
+  likelihood over a dense dispersion sweep.
+* ``cluster::silhouette`` semantics (Rousseeuw 1987: s(i) = (b−a)/max(a,b)
+  with a = mean intra-cluster distance EXCLUDING self, b = min over other
+  clusters of mean distance) are pinned on a 5-point configuration whose
+  silhouette values are computed longhand here with plain numpy loops.
+
+What still has NO external pin in-environment (and is documented as such):
+the tagwise weighted-likelihood EB procedure and the dynamicTreeCut hybrid
+re-derivation — both are procedure definitions with no distributional
+ground truth; only running the parity kit against real edgeR/dynamicTreeCut
+closes them (parity_kit/README.md).
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+from scipy.stats import nbinom
+
+from scconsensus_tpu.ops.negbin import (
+    common_dispersion_grid,
+    delta_grid,
+    nb_cond_log_lik,
+)
+
+
+def _scipy_cond_loglik(y: np.ndarray, r: float, p: float) -> float:
+    """log P(y | Σy) from NB additivity, via scipy's independent pmf."""
+    z = int(y.sum())
+    n = y.size
+    num = nbinom.logpmf(y, r, p).sum()
+    den = nbinom.logpmf(z, n * r, p)
+    return float(num - den)
+
+
+class TestConditionalLikelihoodAgainstNBAdditivity:
+    """nb_cond_log_lik drops r-independent terms, so compare SHAPES over r:
+    both curves, shifted to zero at a reference r, must coincide."""
+
+    Y = np.array([3, 0, 7, 2, 1, 5, 0, 4], np.float32)
+    R_SWEEP = np.array([0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0])
+
+    def _ours(self, r: float) -> float:
+        return float(
+            nb_cond_log_lik(
+                jnp.asarray(self.Y), jnp.ones(self.Y.size, bool),
+                jnp.float32(r),
+            )
+        )
+
+    def test_matches_scipy_curve_shape(self):
+        ours = np.array([self._ours(r) for r in self.R_SWEEP])
+        # scipy curve at an arbitrary p — the conditional is p-free
+        ref = np.array([
+            _scipy_cond_loglik(self.Y.astype(int), r, 0.4)
+            for r in self.R_SWEEP
+        ])
+        np.testing.assert_allclose(
+            ours - ours[3], ref - ref[3], rtol=0, atol=5e-4
+        )
+
+    def test_scipy_conditional_is_p_independent(self):
+        # the textbook fact the comparison above leans on, asserted
+        a = [_scipy_cond_loglik(self.Y.astype(int), r, 0.2)
+             for r in self.R_SWEEP]
+        b = [_scipy_cond_loglik(self.Y.astype(int), r, 0.7)
+             for r in self.R_SWEEP]
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-8)
+
+    def test_masked_cells_are_excluded(self):
+        mask = np.ones(self.Y.size, bool)
+        mask[[1, 6]] = False
+        got = float(
+            nb_cond_log_lik(jnp.asarray(self.Y), jnp.asarray(mask),
+                            jnp.float32(2.0))
+        )
+        got0 = float(
+            nb_cond_log_lik(jnp.asarray(self.Y[mask]),
+                            jnp.ones(mask.sum(), bool), jnp.float32(2.0))
+        )
+        assert abs(got - got0) < 1e-5
+
+
+class TestCommonDispersionAgainstBruteForce:
+    def test_grid_maximizer_matches_scipy_brute_force(self):
+        # two planted groups of NB counts; moderate dispersion
+        rng = np.random.default_rng(11)
+        phi_true = 0.5
+        r_true = 1.0 / phi_true
+        g, w = 120, 16
+        mu = rng.uniform(4, 25, size=(g, 1))
+        y = rng.negative_binomial(
+            r_true, r_true / (r_true + mu), size=(g, w)
+        ).astype(int)
+
+        # brute force: scipy conditional LL summed over genes on a dense
+        # phi sweep (p-free, so any p works; use each gene's moment p)
+        phis = np.exp(np.linspace(np.log(0.05), np.log(5.0), 400))
+        brute = []
+        for phi in phis:
+            r = 1.0 / phi
+            tot = 0.0
+            for row in y:
+                tot += _scipy_cond_loglik(row, r, 0.5)
+            brute.append(tot)
+        phi_brute = phis[int(np.argmax(brute))]
+
+        # our pipeline: nb_cond_log_lik on the same sweep positions used by
+        # the production grid machinery
+        deltas = delta_grid(48)
+        lls = []
+        for d in np.asarray(deltas):
+            r = (1.0 - d) / d
+            ll = nb_cond_log_lik(
+                jnp.asarray(y.astype(np.float32)),
+                jnp.ones_like(y, bool), jnp.float32(r),
+            )
+            lls.append(float(jnp.sum(ll)))
+        phi_ours = float(
+            common_dispersion_grid(jnp.asarray(lls)[None, :], deltas)[0]
+        )
+        assert abs(np.log(phi_ours) - np.log(phi_brute)) < 0.15, (
+            phi_ours, phi_brute,
+        )
+
+
+class TestSilhouetteAgainstRousseeuwLonghand:
+    def test_five_point_configuration(self):
+        from scconsensus_tpu.ops.silhouette import silhouette_widths
+
+        x = np.array(
+            [[0.0, 0.0], [0.0, 1.0], [4.0, 0.0], [4.0, 1.0], [4.0, 2.0]],
+            np.float32,
+        )
+        labels = np.array([0, 0, 1, 1, 1])
+        d = np.sqrt(((x[:, None, :] - x[None, :, :]) ** 2).sum(-1))
+
+        # Rousseeuw 1987 definition, longhand
+        expect = np.zeros(5)
+        for i in range(5):
+            own = (labels == labels[i]) & (np.arange(5) != i)
+            a = d[i, own].mean()
+            b = min(
+                d[i, labels == k].mean()
+                for k in np.unique(labels) if k != labels[i]
+            )
+            expect[i] = (b - a) / max(a, b)
+
+        got = np.asarray(silhouette_widths(x, labels))
+        np.testing.assert_allclose(got, expect, rtol=0, atol=1e-5)

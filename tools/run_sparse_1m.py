@@ -1,0 +1,157 @@
+"""1M-cell sparse-in FULL-pipeline proof (VERDICT r4 #5).
+
+The brain1m bench config times the clustering tail only (pooled
+distance+linkage+cut+silhouette on an embedding). This runner exercises the
+never-densify contract (SURVEY.md §2b N12) at its design scale through the
+WHOLE product pipeline: sparse CSR 1M×G expression matrix → consensus →
+all-pairs DE (chunked sparse path) → union → PCA embed → pooled Ward →
+dynamic cuts → NODG — the path the reference densifies at
+R/reclusterDEConsensus.R:32 and must never be densified here.
+
+The matrix is generated DIRECTLY in CSR form (per-gene nonzero draws;
+no dense intermediate at any point). Evidence artifact:
+SCALE_r05_cpu_1m_fullpipe_sparse.json with the stage dict, peak RSS, and
+the dense-equivalent size it never allocated.
+
+Run:  python tools/run_sparse_1m.py           (CPU, ~1-2 h on one core)
+Env:  SCC_1M_CELLS / SCC_1M_GENES override the shape (testing).
+"""
+
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+import scipy.sparse as sp
+
+
+def gen_sparse_scrna(n_cells: int, n_genes: int, n_clusters: int, seed: int = 0):
+    """Planted-cluster scRNA-like CSR (G, N) built row-by-row — the dense
+    (G, N) matrix never exists. ~5% global nonzero fraction; each cluster
+    has marker genes with elevated rates (so consensus/DE have signal)."""
+    rng = np.random.default_rng(seed)
+    cid = rng.integers(0, n_clusters, n_cells).astype(np.int32)
+    base_p = rng.uniform(0.005, 0.05, n_genes)
+    # ~8 marker genes per cluster with strongly elevated expression
+    markers = {
+        k: rng.choice(n_genes, size=8, replace=False)
+        for k in range(n_clusters)
+    }
+    boost = np.ones((n_genes, n_clusters), np.float32)
+    for k, gs in markers.items():
+        boost[gs, k] = rng.uniform(8.0, 15.0, gs.size)
+
+    indptr = np.zeros(n_genes + 1, np.int64)
+    idx_parts, val_parts = [], []
+    p_cell = np.empty(n_cells, np.float32)
+    for g in range(n_genes):
+        np.take(base_p[g] * boost[g], cid, out=p_cell)
+        np.clip(p_cell, 0.0, 0.6, out=p_cell)
+        mask = rng.random(n_cells, dtype=np.float32) < p_cell
+        pos = np.nonzero(mask)[0].astype(np.int32)
+        lam = 1.0 + 4.0 * (boost[g, cid[pos]] > 1.0)
+        vals = np.log1p(rng.poisson(lam).astype(np.float32) + 1.0)
+        idx_parts.append(pos)
+        val_parts.append(vals)
+        indptr[g + 1] = indptr[g] + pos.size
+    mat = sp.csr_matrix(
+        (np.concatenate(val_parts), np.concatenate(idx_parts), indptr),
+        shape=(n_genes, n_cells),
+    )
+    return mat, cid
+
+
+def noisy(labels: np.ndarray, flip: float, k: int, seed: int, prefix: str):
+    rng = np.random.default_rng(seed)
+    out = labels.copy()
+    n = out.size
+    m = rng.random(n) < flip
+    out[m] = rng.integers(0, k, int(m.sum()))
+    return np.array([f"{prefix}{v}" for v in out])
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    n_cells = int(os.environ.get("SCC_1M_CELLS", 1_000_000))
+    n_genes = int(os.environ.get("SCC_1M_GENES", 3000))
+    n_clusters = 16
+
+    from scconsensus_tpu import plot_contingency_table, recluster_de_consensus_fast
+    from scconsensus_tpu.config import CompatFlags
+
+    t_all = time.perf_counter()
+    t0 = time.perf_counter()
+    mat, truth = gen_sparse_scrna(n_cells, n_genes, n_clusters, seed=7)
+    gen_s = time.perf_counter() - t0
+    nnz_frac = mat.nnz / (n_cells * n_genes)
+    print(f"[1m] generated CSR {mat.shape} nnz={mat.nnz} "
+          f"({100*nnz_frac:.1f}%) in {gen_s:.1f}s", flush=True)
+
+    sup = noisy(truth, 0.05, n_clusters, 1, "S")
+    uns = noisy(truth, 0.10, n_clusters, 2, "U")
+    t0 = time.perf_counter()
+    consensus = plot_contingency_table(sup, uns, filename=None)
+    consensus_s = time.perf_counter() - t0
+    print(f"[1m] consensus: {len(set(consensus))} labels in "
+          f"{consensus_s:.1f}s", flush=True)
+
+    # silhouette at 1M is O(N²) — out of scope for this proof (the brain1m
+    # config prices the clustering tail separately); everything else runs.
+    t0 = time.perf_counter()
+    res = recluster_de_consensus_fast(
+        mat, consensus,
+        q_val_thrs=0.05,
+        approx_threshold=50_000,           # force the pooled tree path
+        compat=CompatFlags(return_silhouette=False),
+        mesh=None,
+    )
+    refine_s = time.perf_counter() - t0
+
+    stages = {
+        s["stage"]: round(s["wall_s"], 3)
+        for s in res.metrics.get("stages", [])
+        if "wall_s" in s
+    }
+    peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    dense_gb = n_cells * n_genes * 4 / 1e9
+    record = {
+        "metric": f"{n_cells//1000}k-cell sparse-in FULL pipeline "
+                  "(consensus+DE+union+embed+pooled recluster+nodg) "
+                  "wall-clock",
+        "value": round(refine_s + consensus_s, 3),
+        "unit": "seconds",
+        "vs_baseline": None,  # no reference number exists (BASELINE.md)
+        "extra": {
+            "platform": jax.devices()[0].platform,
+            "n_cells": n_cells, "n_genes": n_genes,
+            "nnz_frac": round(nnz_frac, 4),
+            "gen_s": round(gen_s, 1),
+            "consensus_s": round(consensus_s, 1),
+            "stages": stages,
+            "union_size": int(res.de_gene_union_idx.size),
+            "deep_split_info": res.deep_split_info,
+            "peak_rss_gb": round(peak_rss_gb, 2),
+            "dense_equivalent_gb": round(dense_gb, 1),
+            "never_densified": bool(peak_rss_gb < dense_gb),
+            "silhouette": "skipped (O(N^2); priced separately by brain1m)",
+            "total_wall_s": round(time.perf_counter() - t_all, 1),
+        },
+    }
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..",
+        f"SCALE_r05_cpu_{n_cells//1000}k_fullpipe_sparse.json",
+    )
+    with open(out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps(record), flush=True)
+
+
+if __name__ == "__main__":
+    main()
