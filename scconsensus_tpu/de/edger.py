@@ -372,8 +372,8 @@ def run_edger_pairs(
     cid_sub = np.concatenate(
         [np.full(s.size, k, np.int32) for k, s in enumerate(sub_idx_of)]
     )
-    sub_onehot = np.zeros((sub_cells.size, K), np.float32)
-    sub_onehot[np.arange(sub_cells.size), cid_sub] = 1.0
+    # (no subsample one-hot here: the zero-compacted table builder derives
+    # its one-hot from the sorted carried cids, _sub_table_sorted_chunk)
 
     onehot = np.zeros((N, K), np.float32)
     onehot[kept, cid[kept]] = 1.0
@@ -381,7 +381,6 @@ def run_edger_pairs(
     j_lib = jnp.asarray(lib_all)
     j_cid_safe = jnp.asarray(cid_safe)
     j_kept = jnp.asarray(kept)
-    j_sub_onehot = jnp.asarray(sub_onehot)
     j_lib_sub = jnp.asarray(lib_all[sub_cells])
     j_cid_sub = jnp.asarray(cid_sub)
     if sparse:

@@ -73,15 +73,18 @@ _HIGHEST = jax.lax.Precision.HIGHEST
 # Element budget for the (Gc, K, N) working tensors (~6 live at once).
 _ALLPAIRS_ELEM_BUDGET = 320_000_000
 
-# Static TIED-run table height of the tie-table kernel. Only runs of size
-# ≥ 2 need slots: counts-derived values (raw counts, log1p counts, ADT)
-# have ≤ ~25 distinct values per gene, and per-cell normalized 26k-cell
-# flagship data measures p50 = 224 / p99 = 746 / max = 1070 tied runs per
-# gene (ROUND5_NOTES.md) — 2048 covers both regimes with slack. The table
-# is filled by scatter-add (independent of the cap), so the cap only
-# prices the small (Gc, T, K) per-run einsums. Genes that overflow are
-# re-routed to the scan kernel by the caller (engine._run_wilcox_device).
-RUN_CAP = 2048
+# Upper bound on the tied-run table height (a memory guard, not a tuning
+# knob). The effective height is pow2(W/2) — the most size-≥2 runs a
+# W-wide window can physically hold — so overflow is IMPOSSIBLE for
+# windows up to 2·RUN_CAP and the scan-kernel redo path only exists for
+# wider-than-128k windows (≥256k cells in one window). A fixed 2048 cap
+# was tried first: the 26k flagship fits (p50 = 224 / max ≈ 1100 tied
+# runs per gene) but the 100k-cell tm100k config measures thousands of
+# tied runs per gene — every gene overflowed and the wasted pass + redo
+# made the cold wilcox 3737 s vs the scan kernel's ~3100 (ROUND5_NOTES.md).
+# The table is scatter-filled (cost independent of height); the height
+# only prices the (Gc, T, K) per-run einsums and their memory.
+RUN_CAP = 65536
 
 
 def chunk_genes_for_budget(n_cells: int, n_clusters: int,
@@ -251,14 +254,16 @@ def ranksum_body_runspace(
         B[k, l] = diag(# untied positions of k) + Σ_t R_k²·R_l,
 
     which is exactly the scan kernel's statistic (size-1 runs contribute
-    t³−t = 0 to the tie moments). Both data regimes fit one cap:
-    counts-derived values have ≤ ~25 runs TOTAL per gene; per-cell
-    normalized values (the reference's input convention,
-    R/reclusterDEConsensus.R:5) measure p50 = 224 / max ≈ 1100 tied runs
-    per gene at the 26k-cell flagship — under the 2048 slots. (A first
-    attempt capped TOTAL runs at 32 and overflowed on every normalized
-    gene, making the bench 4 % SLOWER than the scan kernel via the wasted
-    pass + redo — ROUND5_NOTES.md tells the story.)
+    t³−t = 0 to the tie moments). The table height is pow2(W/2) — the
+    most size-≥2 runs a window can physically hold — so no data can
+    overflow it at any window up to 2·RUN_CAP; the table is filled by
+    scatter-add, whose cost is height-independent. (Two capped variants
+    were tried and beaten by real data first: a 32-slot TOTAL-run table —
+    per-cell normalized values are mostly distinct, every flagship gene
+    overflowed — and a 2048-slot tied-run table — the 100k-cell tm100k
+    config measures thousands of tied runs per gene. ROUND5_NOTES.md
+    tells the story; the overflow redo each time cost more than the
+    kernel saved.)
 
     Cost: one sort + one (Gc, K, W) cumsum (~13 ns/elem) + scatter-built
     per-run tables + batched gemms — the fills are gone. Returns
@@ -300,8 +305,9 @@ def ranksum_body_runspace(
     tstart = tied & ~same_prev
     tid_raw = jnp.cumsum(tstart.astype(jnp.int32), axis=1) - 1
     n_truns = tid_raw[:, -1] + 1                            # tied runs/gene
-    # table height: a window of W holds at most W/2 size-≥2 runs
-    T = int(min(run_cap, 1 << (max(W // 2, 1)).bit_length()))
+    # table height: a window of W holds at most W/2 size-≥2 runs, so this
+    # never overflows unless W > 2·run_cap
+    T = int(min(run_cap, 1 << max(W // 2 - 1, 1).bit_length()))
     tid = jnp.clip(tid_raw, 0, T - 1)
     # Per-run tables by scatter-add (cost ~ one (Gc, W, K) pass, independent
     # of T — a one-hot einsum at T=2048 would materialize a 17 GB tensor).

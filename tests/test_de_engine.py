@@ -383,18 +383,19 @@ class TestRunspaceKernel:
             np.asarray(lp)[m], np.asarray(ref[0])[m], rtol=1e-5, atol=1e-3
         )
 
-    def test_overflow_flagged_on_tie_heavy_wide_data(self, rng):
-        """More than RUN_CAP genuinely tied runs must be flagged invalid."""
+    def test_overflow_flagged_when_table_capped(self, rng):
+        """With the default pow2(W/2) table height overflow is physically
+        impossible; when the height IS capped (memory guard at >128k
+        windows), genes with more tied runs than slots must read invalid."""
         import jax.numpy as jnp
 
         from scconsensus_tpu.ops.ranksum_allpairs import (
-            RUN_CAP,
             allpairs_ranksum_runspace_chunk,
         )
 
-        n_pairs_vals = RUN_CAP + 200
+        cap = 32
         base = rng.permutation(
-            np.repeat(np.arange(n_pairs_vals, dtype=np.float32), 2)
+            np.repeat(np.arange(cap + 40, dtype=np.float32), 2)
         )
         n = base.size
         data = np.tile(base, (4, 1)) + 1.0
@@ -404,21 +405,29 @@ class TestRunspaceKernel:
         pj = np.array([1, 2, 2], np.int32)
         _, _, _, nr = allpairs_ranksum_runspace_chunk(
             jnp.asarray(data), jnp.asarray(cid), jnp.asarray(n_of),
-            jnp.asarray(pi), jnp.asarray(pj), n_clusters=3,
+            jnp.asarray(pi), jnp.asarray(pj), n_clusters=3, run_cap=cap,
         )
-        assert (np.asarray(nr) > RUN_CAP).all()
+        assert (np.asarray(nr) > cap).all()
 
     def test_engine_falls_back_for_overflow_genes(self, rng, monkeypatch):
-        """Continuous (all-distinct) genes overflow the run table; the
-        engine must transparently re-run them through the scan kernel and
-        return the same answers as a no-runspace run."""
+        """When the engine's overflow threshold trips (only possible with a
+        capped table — forced here by patching RUN_CAP small), flagged
+        genes must transparently re-run through the scan kernel and the
+        final answers must match a no-runspace run."""
+        import scconsensus_tpu.ops.ranksum_allpairs as ra
+
         g, n, k = 12, 600, 3
-        data = np.abs(rng.normal(size=(g, n))).astype(np.float32)
-        data[rng.random((g, n)) < 0.4] = 0.0   # sparse but untied positives
+        data = np.round(np.abs(rng.normal(size=(g, n))) * 5).astype(
+            np.float32
+        )  # quantized -> well over 4 tied runs per gene
+        data[rng.random((g, n)) < 0.4] = 0.0
         lab = rng.integers(0, k, n)
         cell_idx_of = [np.nonzero(lab == c)[0].astype(np.int32)
                        for c in range(k)]
         pi, pj = _all_pairs(k)
+        monkeypatch.setattr(ra, "RUN_CAP", 4)  # engine threshold only:
+        # the kernel's own table height stays pow2(W/2), so its results
+        # are valid — the redo must preserve them, not corrupt them
         lp_rs, u_rs = _run_wilcox(data, cell_idx_of, pi, pj, exact="never")
         monkeypatch.setenv("SCC_NO_RUNSPACE", "1")
         lp_sc, u_sc = _run_wilcox(data, cell_idx_of, pi, pj, exact="never")

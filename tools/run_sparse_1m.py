@@ -10,8 +10,9 @@ R/reclusterDEConsensus.R:32 and must never be densified here.
 
 The matrix is generated DIRECTLY in CSR form (per-gene nonzero draws;
 no dense intermediate at any point). Evidence artifact:
-SCALE_r05_cpu_1m_fullpipe_sparse.json with the stage dict, peak RSS, and
-the dense-equivalent size it never allocated.
+SCALE_r05_cpu_<cells//1000>k_fullpipe_sparse.json (the 1M run writes
+SCALE_r05_cpu_1000k_fullpipe_sparse.json) with the stage dict, peak RSS,
+and the dense-equivalent size it never allocated.
 
 Run:  python tools/run_sparse_1m.py           (CPU, ~1-2 h on one core)
 Env:  SCC_1M_CELLS / SCC_1M_GENES override the shape (testing).
@@ -77,8 +78,13 @@ def noisy(labels: np.ndarray, flip: float, k: int, seed: int, prefix: str):
 def main() -> None:
     import jax
 
-    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    # The env var alone is NOT enough here: the site's axon sitecustomize
+    # registers the TPU plugin and wins, hanging backend init on a dead
+    # tunnel. Pin CPU via jax.config BEFORE the first backend touch
+    # (SCC_1M_PLATFORM overrides for a real accelerator run).
+    jax.config.update(
+        "jax_platforms", os.environ.get("SCC_1M_PLATFORM", "cpu")
+    )
     n_cells = int(os.environ.get("SCC_1M_CELLS", 1_000_000))
     n_genes = int(os.environ.get("SCC_1M_GENES", 3000))
     n_clusters = 16
