@@ -187,7 +187,15 @@ def _sub_table_sorted_chunk(sc, lib_sub, cid_sub, rates_chunk, common_lib,
         dimension=1, num_keys=1,
     )
     x = -sv
-    lam = jnp.maximum(jnp.take_along_axis(rates_chunk, scid, axis=1), 1e-10)
+    oh = (scid[:, :, None]
+          == jnp.arange(n_clusters, dtype=jnp.int32)[None, None, :]
+          ).astype(jnp.float32)
+    # per-cell rate via the one-hot contraction, not take_along_axis: the
+    # same (Gb, Ns, K) one-hot feeds the table contraction below, and a
+    # matmul stays fast where TPU gathers do not
+    lam = jnp.maximum(
+        jnp.einsum("gnk,gk->gn", oh, rates_chunk, precision=_HI), 1e-10
+    )
     mu_in = lam * slib
     mu_out = lam * common_lib
     qn = q2q_normal_raw(x, mu_in, mu_out, phi)
@@ -195,9 +203,6 @@ def _sub_table_sorted_chunk(sc, lib_sub, cid_sub, rates_chunk, common_lib,
                        phi)
     qg_full = jnp.pad(qg, ((0, 0), (0, sc.shape[1] - window)))
     psub = jnp.maximum(0.5 * (qn + qg_full), 0.0)
-    oh = (scid[:, :, None]
-          == jnp.arange(n_clusters, dtype=jnp.int32)[None, None, :]
-          ).astype(jnp.float32)
     lg = lgamma_shift(psub[..., None], r_nodes[None, None, :])
     table = jnp.einsum("gnr,gnk->gkr", lg, oh, precision=_HI)
     zs = jnp.einsum("gn,gnk->gk", psub, oh, precision=_HI)
