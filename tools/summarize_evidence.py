@@ -85,7 +85,7 @@ def main() -> None:
             rows.append((
                 f"{os.path.basename(path)}:{size}",
                 f"mesh={rec.get('mesh8')}s serial={rec.get('serial')}s "
-                f"ratio={rec.get('mesh_over_serial')}",
+                f"ratio={rec.get('ratio', rec.get('mesh_over_serial'))}",
             ))
     tlog = os.path.join(ROOT, "TUNNEL_LOG.jsonl")
     if os.path.exists(tlog):
