@@ -839,6 +839,22 @@ def worker() -> None:
             for s in result.metrics.get("stages", [])
             if "wall_s" in s
         }
+        # the rank-sum window-ladder occupancy probe (engine r6) rides the
+        # stage records; committing it makes every refine artifact carry
+        # its own ladder diagnosis
+        occ = next(
+            (s["occupancy"] for s in result.metrics.get("stages", [])
+             if "occupancy" in s), None,
+        )
+        if occ is not None:
+            extra["wilcox_occupancy"] = occ
+        sil = [
+            {k: d[k] for k in ("deep_split", "silhouette",
+                               "silhouette_method") if k in d}
+            for d in result.deep_split_info
+        ]
+        if any("silhouette" in d for d in sil):
+            extra["silhouette"] = sil
     final = _refine_record(elapsed)
     _write_ckpt(final)
     print(json.dumps(final))

@@ -85,6 +85,13 @@ class ReclusterConfig:
     approx_method: str = "pool"  # pool (centroid pre-pooling) | knn (ring-kNN graph Ward)
     n_pool_centroids: int = 4096
     knn_graph_k: int = 15  # neighbors per cell for approx_method="knn"
+    # Above approx_threshold the per-deepSplit silhouette switches to the
+    # pooled O(N·m) estimator (ops.silhouette.pooled_multi_cut_silhouette,
+    # reusing the tree stage's pool when one exists); below it the exact
+    # O(N²) path runs unchanged. ``silhouette_sample`` caps the evaluated
+    # rows (None = every cell; counts/cluster sizes always use all cells).
+    silhouette_pool_centroids: int = 2048
+    silhouette_sample: Optional[int] = None
 
     # --- misc ---
     compat: CompatFlags = dataclasses.field(default_factory=CompatFlags)

@@ -36,7 +36,7 @@ import numpy as np
 
 from scconsensus_tpu.config import ReclusterConfig
 from scconsensus_tpu.ops.gates import (
-    compute_aggregates,
+    compute_aggregates_cid,
     pair_gates_fast,
     pair_gates_slow,
 )
@@ -314,27 +314,28 @@ def _exact_host_update(
     log_p[row, cols] = np.log(pe).astype(np.float32)
 
 
-def _redo_overflow_genes(parts, overflow, jdata, jcid, jn, jpi, jpj, K,
-                         run_cap):
+def _redo_overflow_genes(parts, overflow, refetch, jn, jpi, jpj, K,
+                         run_cap, probe=None):
     """Windowed path: re-route genes whose tie-run count overflowed the
     run-space table to the scan kernel and splice the corrected rows back
     into the collected block outputs. ONE batched n_runs fetch for all
     blocks, after every block has been dispatched — keeps the main loop's
     async pipelining intact (rare path: counts-derived data stays under
-    the cap; continuous data overflows and pays one cheap wasted pass)."""
+    the cap; continuous data overflows and pays one cheap wasted pass).
+    ``refetch(ids, window)`` rebuilds kernel inputs for a gene subset —
+    dense-device rows or CSR-compacted windows, the caller knows which."""
     from scconsensus_tpu.ops.ranksum_allpairs import allpairs_ranksum_chunk
 
     all_nr = jax.device_get([nr for _, _, _, nr in overflow])
     for (idx, ids, weff, _), nr in zip(overflow, all_nr):
         bad = np.nonzero(nr[: ids.size] > run_cap)[0]
+        if probe is not None and idx < len(probe.get("buckets", [])):
+            probe["buckets"][idx]["overflow_genes"] = int(bad.size)
         if not bad.size:
             continue
-        rows = jnp.take(jdata, jnp.asarray(ids[bad]), axis=0)
-        pad_to = _next_pow2(max(int(bad.size), 256))
-        if bad.size < pad_to:
-            rows = jnp.pad(rows, ((0, pad_to - bad.size), (0, 0)))
+        rows, kcid, win = refetch(ids[bad], weff)
         lp_r, u_r, ts_r = allpairs_ranksum_chunk(
-            rows, jcid, jn, jpi, jpj, K, window=weff,
+            rows, kcid, jn, jpi, jpj, K, window=win,
         )
         sel = jnp.asarray(bad)
         ids0, (lp0, u0, ts0) = parts[idx]
@@ -357,6 +358,11 @@ def _redo_overflow_dense(outs, overflow, data, gc, jdata, jcid, jn, jpi,
 
     all_nr = jax.device_get([nr for _, _, _, nr in overflow])
     sparse = is_sparse(data)
+    if jdata is None and not sparse:
+        # mirror _gene_chunks's defensive rebuild: its contract lets dense
+        # callers omit jdata (it uploads on demand), and this redo twin
+        # must not crash on the same inputs in the rare overflow case
+        jdata = jnp.asarray(data)
     for (idx, g0, g1, _), nr in zip(overflow, all_nr):
         bad = np.nonzero(nr[: g1 - g0] > run_cap)[0]
         if not bad.size:
@@ -379,6 +385,16 @@ def _redo_overflow_dense(outs, overflow, data, gc, jdata, jcid, jn, jpi,
         ))
 
 
+def _window_floor(n_cells: int) -> int:
+    """Window-ladder floor: 1024 bounds the distinct compiled shapes (cold
+    compiles cross the remote-compile tunnel) and scans below 1k lanes are
+    dispatch-bound anyway; at large N the floor rises (N/256, capped 16k)
+    so the sparse tail of the ladder doesn't shatter into dispatch-bound
+    microbuckets — at N = 1M the floor is 4096 (occupancy-probe finding,
+    PROFILE_r06_wilcox_1m)."""
+    return int(min(max(1024, _next_pow2(max(n_cells // 256, 1))), 16384))
+
+
 def _run_wilcox_device(
     data: np.ndarray,
     cell_idx_of: List[np.ndarray],
@@ -387,6 +403,7 @@ def _run_wilcox_device(
     exact: str = "auto",
     mesh=None,
     jdata=None,
+    probe_out: Optional[Dict] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Rank-sum for every (pair, gene) via the all-pairs sorted-cumsum
     engine (ops.ranksum_allpairs — one sort per gene, zero per-pair
@@ -400,20 +417,36 @@ def _run_wilcox_device(
     path. ``mesh``: optional device mesh — gene chunks are sharded across
     it (genes are embarrassingly parallel).
 
-    Single-device dense inputs take the sparse-window route: genes bucket
-    by their nonzero count onto a pow-2 window ladder (floor 1024) and each
-    bucket runs the rank-sum kernel at its own window width (zero-block
-    decomposition, ops.ranksum_allpairs) — expression data is mostly zeros,
-    so most genes pay a fraction of the full N-cell scan.
+    Window ladder: genes bucket by nonzero count onto a pow-2 window
+    ladder (floor `_window_floor(N)`) and each bucket runs the rank-sum
+    kernel at its own window width (zero-block decomposition,
+    ops.ranksum_allpairs) — expression data is mostly zeros, so most genes
+    pay a fraction of the full N-cell scan. Dense device input measures
+    nnz on device and sorts full-N rows per bucket; CSR input (r6) builds
+    PRE-COMPACTED windows holding only each gene's stored entries
+    (io.sparsemat.csr_window_rows), so the sort itself shrinks from N to
+    ~nnz — the lever the r5 1M artifact was missing (its sparse input
+    bypassed the ladder entirely and paid 2765 s of full-width sorts).
+
+    ``probe_out``: optional dict (e.g. the wilcox stage's timer record) —
+    receives an ``occupancy`` sub-dict with per-bucket gene counts, window
+    widths, padded-vs-real element ratios, tied-run table heights and
+    overflow counts. With SCC_WILCOX_PROBE=1 each bucket is additionally
+    synced and walled (serializes dispatch — diagnosis runs only), and
+    tied-run counts + a separate sort-only timing are fetched per bucket
+    so sort cost is split out of the contraction attribution.
     """
     import os
+    import time
 
+    from scconsensus_tpu.io.sparsemat import csr_window_rows, is_sparse
     from scconsensus_tpu.ops.ranksum_allpairs import (
         _ALLPAIRS_ELEM_BUDGET,
         RUN_CAP,
         allpairs_ranksum_chunk,
         allpairs_ranksum_runspace_chunk,
         chunk_genes_for_budget,
+        sort_probe,
     )
 
     G, N = data.shape
@@ -445,34 +478,81 @@ def _run_wilcox_device(
         n_dev = int(mesh.devices.size)
         gc = max(gc, n_dev * 8)
 
-    windowed = jdata is not None
-    if windowed:
+    sparse_in = is_sparse(data)
+    windowed = False
+    src = None
+    if jdata is not None:
         # nnz over ALL cells (excluded cells still occupy window slots) and
         # a negativity check (the decomposition needs zeros as the minimum).
         nnz_g, any_neg = jax.device_get((
             jnp.sum(jdata > 0, axis=1), jnp.any(jdata < 0)
         ))
         windowed = not bool(any_neg)
+        src = "dense-device"
+    elif sparse_in:
+        # CSR route: stored-entry counts bound the window (explicit zeros
+        # waste a slot but stay inert — the kernel masks them) and the
+        # negativity check reads only the value array.
+        any_neg = bool(data.nnz and data.data.min() < 0)
+        if not any_neg:
+            nnz_g = np.diff(data.indptr).astype(np.int64)
+            windowed = True
+            src = "csr-compacted"
+
+    probe_on = bool(os.environ.get("SCC_WILCOX_PROBE"))
+    probe: Optional[Dict] = None
+    if probe_out is not None:
+        probe = {
+            "windowed": bool(windowed),
+            "input": src or ("sparse-chunked" if sparse_in else "dense"),
+            "kernel": ("mesh-scan" if mesh is not None
+                       else "runspace" if use_runspace else "scan"),
+            "n_genes": int(G), "n_cells": int(N), "n_clusters": int(K),
+            "probe_synced": probe_on,
+            "buckets": [],
+        }
+        probe_out["occupancy"] = probe
 
     if windowed:
+        floor = _window_floor(N)
+        if probe is not None:
+            probe["window_floor"] = floor
         order = np.argsort(nnz_g, kind="stable").astype(np.int64)
         nnz_sorted = nnz_g[order]
+        compact = src == "csr-compacted"
+
+        def refetch(ids_bad: np.ndarray, window: int):
+            """Kernel inputs for a gene subset (the overflow redo path)."""
+            pad_to = _next_pow2(max(int(ids_bad.size), 256))
+            if compact:
+                vals, wcid = csr_window_rows(
+                    data, ids_bad, window, cid, pad_rows=pad_to
+                )
+                return jnp.asarray(vals), jnp.asarray(wcid), window
+            rows = jnp.take(jdata, jnp.asarray(ids_bad), axis=0)
+            if ids_bad.size < pad_to:
+                rows = jnp.pad(rows, ((0, pad_to - ids_bad.size), (0, 0)))
+            return rows, jcid, window
+
         parts = []  # (gene_ids, (log_p, u, ties)) in sorted-gene order
         overflow = []  # (part idx, ids, window, device n_runs)
+        t_ladder = time.perf_counter()
         g0 = 0
         while g0 < G:
-            # window floor 1024: bounds the distinct compiled shapes (cold
-            # compiles cross the remote-compile tunnel) and scans below 1k
-            # lanes are dispatch-bound anyway
-            w = int(
-                min(_next_pow2(max(int(nnz_sorted[g0]), 1024)), _next_pow2(N))
-            )
-            # block size respects BOTH working sets: the (gcb, K, w) scan
-            # tensors and the (gcb, N) full-width sort buffers — w·K alone
-            # ignores N and could pad a small-K run to a >10 GB sort.
+            w = int(min(_next_pow2(max(int(nnz_sorted[g0]), floor)),
+                        _next_pow2(N)))
+            # the width every (Gc, K, ·) scan/contraction tensor runs at:
+            # compacted chunks are w wide and the kernel runs the full w
+            # even when w > N (pow-2 rounding); dense chunks clamp to N
+            scan_w = w if compact else min(w, N)
+            # compacted rows sort only the window; dense rows sort full N
+            sort_w = w if compact else N
+            # block size respects BOTH working sets: the (gcb, K, scan_w)
+            # kernel tensors and the (gcb, sort_w) sort buffers — w·K alone
+            # ignores the sort and could pad a small-K run to a >10 GB sort.
             gcb = max(8, min(
-                _ALLPAIRS_ELEM_BUDGET // max(w * K, 1),
-                (_ALLPAIRS_ELEM_BUDGET // 2) // max(N, 1),
+                _ALLPAIRS_ELEM_BUDGET // max(scan_w * K, 1),
+                (_ALLPAIRS_ELEM_BUDGET // 2) // max(sort_w, 1),
             ))
             gcb = 1 << (int(gcb).bit_length() - 1)
             gcb = min(gcb, _next_pow2(G))
@@ -482,7 +562,6 @@ def _run_wilcox_device(
                    and (w >= N or nnz_sorted[g1] <= w)):
                 g1 += 1
             ids = order[g0:g1]
-            rows = jnp.take(jdata, jnp.asarray(ids), axis=0)
             # pad to the pow-2 of the ACTUAL block population, not the full
             # budget: a 50-gene window bucket must not sort/scan thousands
             # of padded rows (same fix as the NB exact-task chunks). Floor
@@ -490,29 +569,84 @@ def _run_wilcox_device(
             # compile crosses the remote-compile tunnel (cf. the window
             # floor above)
             gcb_eff = min(gcb, _next_pow2(max(int(ids.size), 256)))
-            if ids.size < gcb_eff:
-                rows = jnp.pad(rows, ((0, gcb_eff - ids.size), (0, 0)))
-            weff = w if w < N else 0
+            t_bucket = time.perf_counter()
+            if compact:
+                vals, wcid = csr_window_rows(
+                    data, ids, w, cid, pad_rows=gcb_eff
+                )
+                rows = jnp.asarray(vals)
+                # the mesh path pads/uploads cid itself (int-preserving,
+                # sharded_de) — uploading here would round-trip it back
+                # to host first
+                kcid = wcid if mesh is not None else jnp.asarray(wcid)
+                weff = w  # compacted input ALWAYS runs the zero-block mode
+            else:
+                rows = jnp.take(jdata, jnp.asarray(ids), axis=0)
+                if ids.size < gcb_eff:
+                    rows = jnp.pad(rows, ((0, gcb_eff - ids.size), (0, 0)))
+                kcid = jcid
+                weff = w if w < N else 0
+            nr_b = None
             if mesh is not None:
                 out = sharded_allpairs_ranksum(
-                    rows, jcid, jn, jpi, jpj, K, mesh=mesh, window=weff,
+                    rows, kcid, jn, jpi, jpj, K, mesh=mesh, window=weff,
                 )
             elif use_runspace:
                 lp_b, u_b, ts_b, nr_b = allpairs_ranksum_runspace_chunk(
-                    rows, jcid, jn, jpi, jpj, K, window=weff,
+                    rows, kcid, jn, jpi, jpj, K, window=weff,
                 )
                 out = (lp_b, u_b, ts_b)
                 overflow.append((len(parts), ids, weff, nr_b))
             else:
                 out = allpairs_ranksum_chunk(
-                    rows, jcid, jn, jpi, jpj, K, window=weff,
+                    rows, kcid, jn, jpi, jpj, K, window=weff,
                 )
+            if probe is not None:
+                real = int(nnz_sorted[g0:g1].sum())
+                brec = {
+                    "window": int(w), "scan_width": int(scan_w),
+                    "sort_width": int(sort_w), "n_genes": int(ids.size),
+                    "padded_rows": int(gcb_eff),
+                    "real_elems": real,
+                    "padded_elems": int(gcb_eff) * int(scan_w),
+                    "pad_ratio": round(
+                        int(gcb_eff) * int(scan_w) / max(real, 1), 3
+                    ),
+                    "nnz_min": int(nnz_sorted[g0]),
+                    "nnz_max": int(nnz_sorted[g1 - 1]),
+                    "table_height": int(min(
+                        RUN_CAP, 1 << max(scan_w // 2 - 1, 1).bit_length()
+                    )) if use_runspace else None,
+                    "overflow_genes": 0,
+                }
+                if probe_on:
+                    jax.block_until_ready(out)
+                    brec["wall_s"] = round(time.perf_counter() - t_bucket, 4)
+                    # split the sort out of the contraction attribution:
+                    # time the same rows through a sort-only jit — warmed
+                    # untimed first, since every bucket shape is distinct
+                    # and a cold compile inside the timed region would
+                    # inflate every sort_s in the committed PROFILE
+                    jax.block_until_ready(sort_probe(rows, kcid))
+                    t_s = time.perf_counter()
+                    jax.block_until_ready(sort_probe(rows, kcid))
+                    brec["sort_s"] = round(time.perf_counter() - t_s, 4)
+                    if nr_b is not None:
+                        nr = np.asarray(jax.device_get(nr_b))[: ids.size]
+                        if nr.size:
+                            brec["tied_runs_p50"] = int(np.median(nr))
+                            brec["tied_runs_max"] = int(nr.max())
+                probe["buckets"].append(brec)
             parts.append((ids, out))
             g0 = g1
         if use_runspace and overflow:
             _redo_overflow_genes(
-                parts, overflow, jdata, jcid, jn, jpi, jpj, K, RUN_CAP,
+                parts, overflow, refetch, jn, jpi, jpj, K, RUN_CAP,
+                probe=probe,
             )
+        if probe is not None and probe_on:
+            jax.block_until_ready([o for _, o in parts])
+            probe["ladder_wall_s"] = round(time.perf_counter() - t_ladder, 4)
         inv = np.empty(G, np.int64)
         inv[np.concatenate([ids for ids, _ in parts])] = np.arange(G)
         jinv = jnp.asarray(inv)
@@ -678,18 +812,22 @@ def pairwise_de(
         from scconsensus_tpu.utils.devcache import device_put_cached
 
         jdata = None if is_sparse(data) else device_put_cached(data)
-        onehot = np.zeros((N, K), np.float32)
-        valid = cell_idx >= 0
-        onehot[np.nonzero(valid)[0], cell_idx[valid]] = 1.0
         if is_sparse(data):
             from scconsensus_tpu.io.sparsemat import aggregates_from_sparse
             from scconsensus_tpu.ops.gates import ClusterAggregates
 
+            onehot = np.zeros((N, K), np.float32)
+            valid = cell_idx >= 0
+            onehot[np.nonzero(valid)[0], cell_idx[valid]] = 1.0
             agg = ClusterAggregates(
                 *(jnp.asarray(a) for a in aggregates_from_sparse(data, onehot))
             )
         else:
-            agg = compute_aggregates(jdata, jnp.asarray(onehot))
+            # cid form: CPU segment sums are O(G·N) vs the one-hot matmul's
+            # O(G·N·K) — the K²-shaped blowup the r5 tm100k artifact measured
+            # (9.8 s at K=44 → 93.5 s at K=80); TPU builds the one-hot on
+            # device (ops.gates.compute_aggregates_cid)
+            agg = compute_aggregates_cid(jdata, jnp.asarray(cell_idx), K)
 
     method = config.method.lower()
     pi, pj = jnp.asarray(pair_i), jnp.asarray(pair_j)
@@ -732,19 +870,24 @@ def pairwise_de(
         # the rank tests, which consume cell_idx_of directly.
         test_agg = agg
         if subsampled and method in ("bimod", "t"):
-            sub_onehot = np.zeros((N, K), np.float32)
-            for k, ci in enumerate(cell_idx_of):
-                sub_onehot[ci, k] = 1.0
             if is_sparse(data):
                 from scconsensus_tpu.io.sparsemat import aggregates_from_sparse
                 from scconsensus_tpu.ops.gates import ClusterAggregates
 
+                sub_onehot = np.zeros((N, K), np.float32)
+                for k, ci in enumerate(cell_idx_of):
+                    sub_onehot[ci, k] = 1.0
                 test_agg = ClusterAggregates(*(
                     jnp.asarray(a)
                     for a in aggregates_from_sparse(data, sub_onehot)
                 ))
             else:
-                test_agg = compute_aggregates(jdata, jnp.asarray(sub_onehot))
+                # folded rebuild: the subsampled groups re-enter as a (N,)
+                # cid vector through the same K-pruned kernel — no second
+                # host (N, K) one-hot materialization/upload
+                test_agg = compute_aggregates_cid(
+                    jdata, jnp.asarray(_cid_from_groups(cell_idx_of, N)), K
+                )
 
         # All (pair, gene) statistics stay on device through BH and the DE
         # call; ONE batched device_get at the end (the axon tunnel moves
@@ -752,16 +895,19 @@ def pairwise_de(
         # the round-2 engine's hidden cost). The all-pairs kernels price
         # every pair anyway, so group-size-skipped pairs are computed and
         # masked to NaN rather than sliced out.
-        with timer.stage(stage_name):
+        with timer.stage(stage_name) as srec:
             u_dev = None
             if method == "bimod":
                 log_p = bimod_lrt_pairs(test_agg, pi, pj)
             elif method == "t":
                 log_p = welch_t_pairs(test_agg, pi, pj)
             else:
+                # the stage record doubles as the occupancy probe sink: the
+                # window-ladder diagnosis rides the ordinary metrics channel
+                # into logs, bench artifacts, and PROFILE_r06_wilcox_1m
                 log_p, u_dev = _run_wilcox_device(
                     data, cell_idx_of, pair_i, pair_j,
-                    mesh=mesh, jdata=jdata,
+                    mesh=mesh, jdata=jdata, probe_out=srec,
                 )
             if method == "roc":
                 # The reference's roc branch never produces a p-value usable

@@ -32,6 +32,7 @@ __all__ = [
     "mean_value",
     "nodg",
     "csr_to_device",
+    "csr_window_rows",
     "aggregates_from_sparse",
 ]
 
@@ -188,6 +189,40 @@ def csr_to_device(m):
         .at[rows, cols]
         .set(vals, mode="drop", unique_indices=True)
     )
+
+
+def csr_window_rows(
+    x, gene_ids: np.ndarray, width: int, cid: np.ndarray,
+    pad_rows: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Compacted rank-sum windows straight from CSR storage: for each gene
+    in ``gene_ids`` (all with ≤ ``width`` stored entries), a (B, width) f32
+    row of its stored values plus a matching (B, width) int32 row of the
+    owning cells' cluster ids (``cid[col]``; padding slots are 0 / −1).
+
+    This is what lets the all-pairs rank-sum ladder scale with nnz instead
+    of N on sparse input: only a gene's stored entries ever enter the
+    device sort — absent cells are implicit zeros the kernel's zero-block
+    corrections account for in closed form (ops.ranksum_allpairs). At the
+    1M-cell 2.85 %-nnz shape this replaces a 1M-wide sort per gene with a
+    ~32k-wide one. ``pad_rows`` ≥ B appends inert all-padding rows so the
+    caller can hit a pow-2 compiled shape without a second pad pass.
+    """
+    B = int(gene_ids.size)
+    rows = max(B, int(pad_rows))
+    vals = np.zeros((rows, width), np.float32)
+    wcid = np.full((rows, width), -1, np.int32)
+    indptr, indices, data = x.indptr, x.indices, x.data
+    for b, g in enumerate(np.asarray(gene_ids)):
+        s, e = int(indptr[g]), int(indptr[g + 1])
+        n = e - s
+        if n > width:
+            raise ValueError(
+                f"gene {int(g)} has {n} stored entries > window {width}"
+            )
+        vals[b, :n] = data[s:e]
+        wcid[b, :n] = cid[indices[s:e]]
+    return vals, wcid
 
 
 def aggregates_from_sparse(x, onehot: np.ndarray) -> Tuple[np.ndarray, ...]:

@@ -247,24 +247,52 @@ def refine(
             deep_split_info.append(info)
 
     if config.compat.return_silhouette:
-        with timer.stage("silhouette"):
+        with timer.stage("silhouette") as sil_rec:
+            approx_si = N > config.approx_threshold and mesh is None
+            # excluded-cell masking (label 0 → −1), shared by every branch
+            labs = [
+                np.where(dynamic_labels[f"deepsplit: {dsv}"] > 0,
+                         dynamic_labels[f"deepsplit: {dsv}"], -1)
+                for dsv in config.deep_split_values
+            ]
             if mesh is not None:
-                for info, dsv in zip(deep_split_info, config.deep_split_values):
-                    key = f"deepsplit: {dsv}"
-                    lab = dynamic_labels[key]
+                for info, lab in zip(deep_split_info, labs):
                     si, _per = mean_cluster_silhouette(
-                        embedding, np.where(lab > 0, lab, -1), mesh=mesh
+                        embedding, lab, mesh=mesh
                     )
                     info["silhouette"] = si
+            elif approx_si:
+                # Past the approx threshold the exact O(N²) pass is the
+                # pipeline's scale tail (154 s at 100k; outright skipped at
+                # 1M in r5) — the pooled O(N·m) estimator reuses the tree
+                # stage's pool when one exists, so the 1M artifact reports
+                # a quality number for the cost of an (N, m) matmul stream.
+                from scconsensus_tpu.ops.silhouette import (
+                    pooled_multi_cut_silhouette,
+                )
+
+                sil_rec["method"] = "pooled-estimator"
+                sil_rec["n_centroids"] = (
+                    int(pool_centroids.shape[0]) if pool_centroids is not None
+                    else config.silhouette_pool_centroids
+                )
+                for info, (si, _per) in zip(
+                    deep_split_info,
+                    pooled_multi_cut_silhouette(
+                        embedding, labs,
+                        n_centroids=config.silhouette_pool_centroids,
+                        seed=config.random_seed,
+                        centroids=pool_centroids,
+                        assign=pool_assign,
+                        sample=config.silhouette_sample,
+                    ),
+                ):
+                    info["silhouette"] = si
+                    info["silhouette_method"] = "pooled-estimator"
             else:
                 # all cuts share one N² distance pass (multi_cut_silhouette)
                 from scconsensus_tpu.ops.silhouette import multi_cut_silhouette
 
-                labs = [
-                    np.where(dynamic_labels[f"deepsplit: {dsv}"] > 0,
-                             dynamic_labels[f"deepsplit: {dsv}"], -1)
-                    for dsv in config.deep_split_values
-                ]
                 for info, (si, _per) in zip(
                     deep_split_info, multi_cut_silhouette(embedding, labs)
                 ):

@@ -22,7 +22,10 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["ClusterAggregates", "compute_aggregates", "pair_gates_fast", "pair_gates_slow"]
+__all__ = [
+    "ClusterAggregates", "compute_aggregates", "compute_aggregates_cid",
+    "pair_gates_fast", "pair_gates_slow",
+]
 
 
 @jax.tree_util.register_dataclass
@@ -69,6 +72,48 @@ def compute_aggregates(data: jnp.ndarray, onehot: jnp.ndarray) -> ClusterAggrega
     sum_sq = jnp.dot(data * data, onehot, precision=hi)
     nnz = jnp.dot((data > 0).astype(data.dtype), onehot, precision=hi)
     return ClusterAggregates(sum_log, sum_expm1, sum_sq, nnz, counts)
+
+
+@partial(jax.jit, static_argnames=("n_clusters",))
+def compute_aggregates_cid(
+    data: jnp.ndarray, cid: jnp.ndarray, n_clusters: int
+) -> ClusterAggregates:
+    """``compute_aggregates`` straight from the (N,) per-cell cluster-id
+    vector (−1 = excluded) — no host (N, K) one-hot ever built or uploaded.
+
+    On CPU each statistic is a segment sum over cells (scatter-add at the
+    cell's cluster id): O(G·N) work independent of K, where the one-hot
+    matmul form prices O(G·N·K) — at the tm100k shape (G = 12k, N = 100k,
+    K = 80 refined clusters) that is an 80× flop cut on the stage the r5
+    artifact measured at 93.5 s. On TPU the one-hot is built ON DEVICE
+    (the K-shaped matmul is MXU work and stays the faster form there) —
+    which still folds away the host-side (N, K) rebuild + upload that the
+    subsampled test-aggregate path used to pay a second time."""
+    K = n_clusters
+    hi = jax.lax.Precision.HIGHEST
+    if jax.default_backend() == "cpu":
+        safe = jnp.where(cid >= 0, cid, K)                  # (N,)
+        counts = jnp.zeros((K + 1,), jnp.float32).at[safe].add(1.0)[:K]
+
+        def seg(x: jnp.ndarray) -> jnp.ndarray:             # (G, N) → (G, K)
+            z = jnp.zeros((x.shape[0], K + 1), jnp.float32)
+            return z.at[:, safe].add(x)[:, :K]
+
+        return ClusterAggregates(
+            seg(data), seg(jnp.expm1(data)), seg(data * data),
+            seg((data > 0).astype(jnp.float32)), counts,
+        )
+    onehot = (
+        cid[:, None] == jnp.arange(K, dtype=cid.dtype)[None, :]
+    ).astype(jnp.float32)                                   # (N, K), device
+    counts = jnp.sum(onehot, axis=0)
+    return ClusterAggregates(
+        jnp.dot(data, onehot, precision=hi),
+        jnp.dot(jnp.expm1(data), onehot, precision=hi),
+        jnp.dot(data * data, onehot, precision=hi),
+        jnp.dot((data > 0).astype(data.dtype), onehot, precision=hi),
+        counts,
+    )
 
 
 @partial(

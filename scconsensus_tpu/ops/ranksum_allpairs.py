@@ -50,6 +50,26 @@ scale) with O(G·N·K) MXU work.
 
 Counts are exact in float32 (N < 2²⁴); the contractions run at HIGHEST
 precision because bf16 mantissas cannot hold rank sums.
+
+Round-6 CPU restructuring (occupancy-probe-driven, PROFILE_r06_wilcox_1m):
+
+  * ``cid`` may now be (Gc, W) — one cluster-id row PER GENE — which is what
+    lets the engine feed PRE-COMPACTED windows built straight from CSR
+    storage (only a gene's stored entries enter the sort; the 1M-cell
+    sparse run previously paid a full-N sort per gene because the window
+    ladder required a dense device matrix to measure nnz).
+  * On the CPU backend the K²-shaped contractions collapse to O(W·K)
+    scatter/gather forms: the one-hot axis of C/Cu is exploited as a
+    scatter index (u_mat rows are segment sums over each cluster's cells),
+    and the tied-run table einsums — whose cost was STATIC table height ×
+    K² regardless of how many runs actually existed, the "table thrash" at
+    wide windows — become per-cell gathers of the table rows. TPU keeps
+    the MXU einsum forms (measured faster there; scatters are not).
+  * Per-pair extraction from the (K, K) statistic matrices is a flat
+    gather on CPU (pair_i·K+pair_j) instead of the (P, K²) one-hot
+    contraction — the latter is K²·P work, ~1.5e12 flops at the tm100k
+    shape (K=80, P=3160, G=12000). TPU keeps the one-hot contraction
+    (gathers measured slower there, see _pairs_finish).
 """
 
 from __future__ import annotations
@@ -65,7 +85,7 @@ from scconsensus_tpu.ops.wilcoxon import wilcoxon_from_ranks
 __all__ = [
     "allpairs_ranksum_chunk", "allpairs_ranksum_runspace_chunk",
     "ranksum_body", "ranksum_body_runspace", "chunk_genes_for_budget",
-    "RUN_CAP",
+    "sort_probe", "RUN_CAP",
 ]
 
 _HIGHEST = jax.lax.Precision.HIGHEST
@@ -94,16 +114,49 @@ def chunk_genes_for_budget(n_cells: int, n_clusters: int,
     return max(8, 1 << (int(gc).bit_length() - 1))  # floor power of two
 
 
+def _use_cpu_forms() -> bool:
+    """Trace-time backend probe selecting the scatter/gather contraction
+    forms (CPU) over the MXU einsum/one-hot forms (TPU). Evaluated when a
+    kernel first compiles — the backend is fixed for the process, so the
+    jit caches stay coherent."""
+    return jax.default_backend() == "cpu"
+
+
+def _cid_rows(chunk: jnp.ndarray, cid: jnp.ndarray) -> jnp.ndarray:
+    """Per-gene cluster-id rows: a shared (N,) vector broadcasts across the
+    chunk; a pre-compacted (Gc, W) array passes through (each gene's window
+    carries its own cells)."""
+    if cid.ndim == 2:
+        return cid
+    return jnp.broadcast_to(cid, chunk.shape)
+
+
+@jax.jit
+def sort_probe(chunk: jnp.ndarray, cid: jnp.ndarray):
+    """The kernels' first stage — the variadic value+cluster-id sort — alone.
+    The engine's occupancy probe (SCC_WILCOX_PROBE=1) times it separately
+    per bucket so sort cost splits out of the contraction attribution."""
+    return jax.lax.sort(
+        (-chunk, _cid_rows(chunk, cid)), dimension=1, num_keys=1
+    )
+
+
 def ranksum_body(
     chunk: jnp.ndarray,     # (Gc, N) gene rows (padded rows are all-zero)
-    cid: jnp.ndarray,       # (N,) int32 cluster index, -1 = excluded cell
+    cid: jnp.ndarray,       # (N,) or (Gc, N) int32 cluster index, -1 = excluded
     n_of: jnp.ndarray,      # (K,) cluster sizes (int32)
     pair_i: jnp.ndarray,    # (P,) cluster index of group 1 per pair
     pair_j: jnp.ndarray,    # (P,)
     n_clusters: int,
     window: int = 0,
+    cpu_forms: bool = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Rank-sum log-p for every (gene, pair) of one gene chunk.
+
+    ``cpu_forms``: None probes the backend (`_use_cpu_forms`); the mesh
+    path passes False — the scatter forms' mixed advanced indexing is
+    rejected inside shard_map on jax 0.4.x, and a sharded program is the
+    MXU-form case by design anyway.
 
     Returns (log_p, u, tie_sum), each (Gc, P). Excluded cells (cid = -1,
     dropped clusters or subsampled-out cells) occupy sorted positions but
@@ -123,20 +176,25 @@ def ranksum_body(
 
     Requires every gene in the chunk to have ≤ ``window`` positive cells
     and no negative values (log-normalized expression); callers bucket
-    genes by nnz (see engine._run_wilcox_device).
+    genes by nnz (see engine._run_wilcox_device). ``window`` may equal (or
+    exceed) the chunk width for PRE-COMPACTED input — rows holding only a
+    gene's stored CSR entries with a matching (Gc, W) ``cid`` — where every
+    absent cell is an implicit zero handled by the same corrections.
     """
     Gc, N = chunk.shape
     K = n_clusters
-    sparse_mode = 0 < window < N
+    sparse_mode = window > 0
+    use_cpu = _use_cpu_forms() if cpu_forms is None else bool(cpu_forms)
+    w_eff = min(window, N) if sparse_mode else N
     # One variadic sort carries the cluster ids along with the values.
     # Sparse mode sorts the negated values: positives first, zeros last.
     key = -chunk if sparse_mode else chunk
     sv, scid = jax.lax.sort(
-        (key, jnp.broadcast_to(cid, chunk.shape)), dimension=1, num_keys=1
+        (key, _cid_rows(chunk, cid)), dimension=1, num_keys=1
     )
     if sparse_mode:
-        sv = sv[:, :window]
-        scid = jnp.where(sv < 0, scid[:, :window], -1)  # window zeros inert
+        sv = sv[:, :w_eff]
+        scid = jnp.where(sv < 0, scid[:, :w_eff], -1)  # window zeros inert
     W = sv.shape[1]
     # (Gc, K, W): cells on the minor (lane) axis.
     C = (scid[:, None, :] == jnp.arange(K, dtype=jnp.int32)[None, :, None]
@@ -162,54 +220,87 @@ def ranksum_body(
     E = T - L                                               # equal counts
 
     V = 0.5 * (L + T)                                       # L + E/2
-    u_mat = jnp.einsum("gkn,gln->gkl", C, V, precision=_HIGHEST)
-
-    # Tie correction Σ_runs(t³−t) per pair from one run-moment contraction:
-    # B[k,l] = Σ_runs r_k² r_l = Σ_p C[k,p]·e(p)·E[l,p] with e(p) the cell's
-    # own-run count (Σ_p C_k e E_l sums r_k·r_k·r_l over each run's k-cells).
-    own_eq = jnp.sum(C * E, axis=1)                         # (Gc, W)
-    B = jnp.einsum(
-        "gkn,gln->gkl", C * own_eq[:, None, :], E, precision=_HIGHEST
-    )
+    if use_cpu:
+        # C is one-hot along k: u_mat[i, :] is the segment sum of V columns
+        # over cluster i's cells — an O(W·K) scatter-add instead of the
+        # O(W·K²) einsum (row K is the trash row for excluded cells).
+        gidx = jnp.arange(Gc, dtype=jnp.int32)[:, None]
+        scid_s = jnp.where(scid >= 0, scid, K)              # (Gc, W)
+        Vt = jnp.swapaxes(V, 1, 2)                          # (Gc, W, K)
+        u_mat = jnp.zeros((Gc, K + 1, K), jnp.float32).at[gidx, scid_s].add(
+            Vt
+        )[:, :K, :]
+        own_eq = jnp.sum(C * E, axis=1)                     # (Gc, W)
+        eEt = jnp.swapaxes(E, 1, 2) * own_eq[:, :, None]    # (Gc, W, K)
+        B = jnp.zeros((Gc, K + 1, K), jnp.float32).at[gidx, scid_s].add(
+            eEt
+        )[:, :K, :]
+    else:
+        u_mat = jnp.einsum("gkn,gln->gkl", C, V, precision=_HIGHEST)
+        # Tie correction Σ_runs(t³−t) per pair from one run-moment
+        # contraction: B[k,l] = Σ_runs r_k² r_l = Σ_p C[k,p]·e(p)·E[l,p]
+        # with e(p) the cell's own-run count (Σ_p C_k e E_l sums
+        # r_k·r_k·r_l over each run's k-cells).
+        own_eq = jnp.sum(C * E, axis=1)                     # (Gc, W)
+        B = jnp.einsum(
+            "gkn,gln->gkl", C * own_eq[:, None, :], E, precision=_HIGHEST
+        )
 
     nnz_k = jnp.sum(C, axis=-1)                             # (Gc, K)
     return _pairs_finish(u_mat, B, nnz_k, n_of, pair_i, pair_j, n_clusters,
-                         sparse_mode)
+                         sparse_mode, use_cpu)
 
 
 def _pairs_finish(u_mat, B, nnz_k, n_of, pair_i, pair_j, n_clusters: int,
-                  sparse_mode: bool):
+                  sparse_mode: bool, use_cpu: bool):
     """Shared tail of the scan and run-space kernels: per-pair extraction
     from the (K, K) statistic matrices, zero-block corrections (sparse
     mode), and the p-value — one implementation so the two formulations
     cannot drift.
 
-    Per-pair extraction is tiny matmuls (TPU gathers on (Gc, K, K) with a
-    1k-wide pair list measured slower than the one-hot contraction)."""
+    Per-pair extraction is tiny matmuls on TPU (gathers on (Gc, K, K) with
+    a 1k-wide pair list measured slower than the one-hot contraction
+    there); on CPU it is a flat gather at pair_i·K+pair_j — the one-hot
+    form is O(Gc·K²·P) flops, which at K=80 / P=3160 / G=12000 (tm100k)
+    is ~1.5e12 flops of pure extraction, dwarfing the statistic itself."""
     Gc = u_mat.shape[0]
     K = n_clusters
     P = pair_i.shape[0]
-    sel_i = jax.nn.one_hot(pair_i, K, dtype=jnp.float32)    # (P, K)
-    sel_j = jax.nn.one_hot(pair_j, K, dtype=jnp.float32)
-    sel_ij = (sel_i[:, :, None] * sel_j[:, None, :]).reshape(P, K * K)
-    sel_ji = (sel_j[:, :, None] * sel_i[:, None, :]).reshape(P, K * K)
-    u = jnp.dot(u_mat.reshape(Gc, K * K), sel_ij.T, precision=_HIGHEST)
     b_diag = jnp.einsum("gkk->gk", B)
-    b_ij = jnp.dot(B.reshape(Gc, K * K), sel_ij.T, precision=_HIGHEST)
-    b_ji = jnp.dot(B.reshape(Gc, K * K), sel_ji.T, precision=_HIGHEST)
-    d_i = jnp.dot(b_diag, sel_i.T, precision=_HIGHEST)      # (Gc, P)
-    d_j = jnp.dot(b_diag, sel_j.T, precision=_HIGHEST)
+    if use_cpu:
+        flat_ij = pair_i * K + pair_j                       # (P,)
+        flat_ji = pair_j * K + pair_i
+        u = jnp.take(u_mat.reshape(Gc, K * K), flat_ij, axis=1)
+        b_ij = jnp.take(B.reshape(Gc, K * K), flat_ij, axis=1)
+        b_ji = jnp.take(B.reshape(Gc, K * K), flat_ji, axis=1)
+        d_i = jnp.take(b_diag, pair_i, axis=1)              # (Gc, P)
+        d_j = jnp.take(b_diag, pair_j, axis=1)
+    else:
+        sel_i = jax.nn.one_hot(pair_i, K, dtype=jnp.float32)  # (P, K)
+        sel_j = jax.nn.one_hot(pair_j, K, dtype=jnp.float32)
+        sel_ij = (sel_i[:, :, None] * sel_j[:, None, :]).reshape(P, K * K)
+        sel_ji = (sel_j[:, :, None] * sel_i[:, None, :]).reshape(P, K * K)
+        u = jnp.dot(u_mat.reshape(Gc, K * K), sel_ij.T, precision=_HIGHEST)
+        b_ij = jnp.dot(B.reshape(Gc, K * K), sel_ij.T, precision=_HIGHEST)
+        b_ji = jnp.dot(B.reshape(Gc, K * K), sel_ji.T, precision=_HIGHEST)
+        d_i = jnp.dot(b_diag, sel_i.T, precision=_HIGHEST)  # (Gc, P)
+        d_j = jnp.dot(b_diag, sel_j.T, precision=_HIGHEST)
 
     n1 = n_of[pair_i].astype(jnp.float32)                   # (P,)
     n2 = n_of[pair_j].astype(jnp.float32)
 
     if sparse_mode:
         # Zero-block corrections. nnz/z per (gene, cluster) from the window
-        # counts; pair columns via the same one-hot contractions.
+        # counts; pair columns via the same extraction as the statistics.
         z_k = jnp.maximum(n_of.astype(jnp.float32)[None, :] - nnz_k, 0.0)
-        nnz_j = jnp.dot(nnz_k, sel_j.T, precision=_HIGHEST)  # (Gc, P)
-        z_i = jnp.dot(z_k, sel_i.T, precision=_HIGHEST)
-        z_j = jnp.dot(z_k, sel_j.T, precision=_HIGHEST)
+        if use_cpu:
+            nnz_j = jnp.take(nnz_k, pair_j, axis=1)         # (Gc, P)
+            z_i = jnp.take(z_k, pair_i, axis=1)
+            z_j = jnp.take(z_k, pair_j, axis=1)
+        else:
+            nnz_j = jnp.dot(nnz_k, sel_j.T, precision=_HIGHEST)
+            z_i = jnp.dot(z_k, sel_i.T, precision=_HIGHEST)
+            z_j = jnp.dot(z_k, sel_j.T, precision=_HIGHEST)
         # u currently holds U′ (descending order = above-or-tied dominance)
         u = n1[None, :] * n2[None, :] - (
             u + z_i * nnz_j + 0.5 * z_i * z_j
@@ -266,21 +357,30 @@ def ranksum_body_runspace(
     kernel saved.)
 
     Cost: one sort + one (Gc, K, W) cumsum (~13 ns/elem) + scatter-built
-    per-run tables + batched gemms — the fills are gone. Returns
+    per-run tables + per-cell table gathers — the fills are gone, and (on
+    CPU, r6) so are the (Gc, T, K)² table einsums: those priced the STATIC
+    table height T = pow2(W/2) at K² flops per row whether or not a run
+    existed, which is what made wide windows "thrash" (at W = 2¹⁷,
+    T = 65536 → ~4e8 flops per gene of mostly-empty table work). The
+    replacement gathers each tied cell's table row (O(W·K)) and scatters
+    the products by the cell's own cluster — identical arithmetic, cost
+    proportional to CELLS, not table capacity. Returns
     (log_p, u, tie_sum, n_tied_runs); entries whose ``n_tied_runs >
     run_cap`` had tail runs merged and are INVALID — the caller re-routes
     those genes to ``ranksum_body`` (engine._run_wilcox_device does).
+    Accepts pre-compacted (Gc, W) ``cid`` rows like ``ranksum_body``.
     """
     Gc, N = chunk.shape
     K = n_clusters
-    sparse_mode = 0 < window < N
+    sparse_mode = window > 0
+    w_eff = min(window, N) if sparse_mode else N
     key = -chunk if sparse_mode else chunk
     sv, scid = jax.lax.sort(
-        (key, jnp.broadcast_to(cid, chunk.shape)), dimension=1, num_keys=1
+        (key, _cid_rows(chunk, cid)), dimension=1, num_keys=1
     )
     if sparse_mode:
-        sv = sv[:, :window]
-        scid = jnp.where(sv < 0, scid[:, :window], -1)
+        sv = sv[:, :w_eff]
+        scid = jnp.where(sv < 0, scid[:, :w_eff], -1)
     W = sv.shape[1]
 
     oh_k = (scid[:, :, None] == jnp.arange(K, dtype=jnp.int32)[None, None, :]
@@ -321,16 +421,42 @@ def ranksum_body_runspace(
         SmC * tstart[:, :, None].astype(jnp.float32)
     )
     Cu = oh_k * (1.0 - tied_f)                              # untied one-hot
-    u_mat = (
-        jnp.einsum("gwi,gwj->gij", Cu, SmC, precision=_HIGHEST)
-        + jnp.einsum("gti,gtj->gij", R, Lg + 0.5 * R, precision=_HIGHEST)
-    )
     untied_k = jnp.sum(Cu, axis=1)                          # (Gc, K)
-    B = jnp.einsum("gtk,gtl->gkl", R * R, R, precision=_HIGHEST)
+    use_cpu = _use_cpu_forms()
+    if use_cpu:
+        # O(W·K) contraction: the one-hot k axis of Cu/oh_k becomes a
+        # scatter index (row K = trash for tied/excluded cells), and the
+        # per-RUN table factors are gathered back per CELL —
+        #   Σ_t R[t,i]·X[t,j] = Σ_{tied w, scid_w=i} X[tid_w, j]
+        #   Σ_t R[t,k]²·R[t,l] = Σ_{tied w, scid_w=k} R[tid_w,k]·R[tid_w,l]
+        # so no arithmetic ever touches an empty table row.
+        valid = scid >= 0
+        tied_valid = tied & valid
+        idx_un = jnp.where(valid & ~tied, scid, K)          # (Gc, W)
+        idx_t = jnp.where(tied_valid, scid, K)
+        tidb = jnp.broadcast_to(tid[:, :, None], (Gc, W, K))
+        Xg = jnp.take_along_axis(Lg + 0.5 * R, tidb, axis=1)  # (Gc, W, K)
+        u_mat = (
+            jnp.zeros((Gc, K + 1, K), jnp.float32)
+            .at[gidx, idx_un].add(SmC)
+            .at[gidx, idx_t].add(Xg)
+        )[:, :K, :]
+        Rg = jnp.take_along_axis(R, tidb, axis=1)           # (Gc, W, K)
+        r_own = jnp.sum(Rg * oh_k, axis=2)                  # (Gc, W)
+        B = jnp.zeros((Gc, K + 1, K), jnp.float32).at[gidx, idx_t].add(
+            Rg * r_own[:, :, None]
+        )[:, :K, :]
+    else:
+        u_mat = (
+            jnp.einsum("gwi,gwj->gij", Cu, SmC, precision=_HIGHEST)
+            + jnp.einsum("gti,gtj->gij", R, Lg + 0.5 * R, precision=_HIGHEST)
+        )
+        B = jnp.einsum("gtk,gtl->gkl", R * R, R, precision=_HIGHEST)
     B = B + untied_k[:, :, None] * jnp.eye(K, dtype=jnp.float32)[None]
     nnz_k = S[:, -1, :]
     log_p, u_out, tie_sum = _pairs_finish(
-        u_mat, B, nnz_k, n_of, pair_i, pair_j, n_clusters, sparse_mode
+        u_mat, B, nnz_k, n_of, pair_i, pair_j, n_clusters, sparse_mode,
+        use_cpu,
     )
     # overflow contract: callers test `> run_cap`, so a gene exceeding the
     # EFFECTIVE table height T (possibly < run_cap at small windows) must
@@ -343,7 +469,7 @@ def ranksum_body_runspace(
 # Single-device jitted entries; the sharded form lives in
 # parallel.sharded_de.sharded_allpairs_ranksum and shard_maps the scan body.
 allpairs_ranksum_chunk = jax.jit(
-    ranksum_body, static_argnames=("n_clusters", "window")
+    ranksum_body, static_argnames=("n_clusters", "window", "cpu_forms")
 )
 allpairs_ranksum_runspace_chunk = jax.jit(
     ranksum_body_runspace, static_argnames=("n_clusters", "window", "run_cap")

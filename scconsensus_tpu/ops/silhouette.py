@@ -26,6 +26,8 @@ __all__ = [
     "silhouette_widths",
     "mean_cluster_silhouette",
     "multi_cut_silhouette",
+    "pooled_multi_cut_silhouette",
+    "pooled_mean_cluster_silhouette",
     "widths_from_cluster_sums",
 ]
 
@@ -128,6 +130,109 @@ def multi_cut_silhouette(
     return out
 
 
+def pooled_multi_cut_silhouette(
+    x: np.ndarray,
+    labels_list,
+    n_centroids: int = 2048,
+    seed: int = 0,
+    block: int = 65536,
+    centroids: np.ndarray = None,
+    assign: np.ndarray = None,
+    sample: int = None,
+) -> list:
+    """Pooled silhouette estimator — O(N·m) instead of O(N²).
+
+    Every cluster's distance sum S(i, k) = Σ_{j∈k} d(i, j) is estimated by
+    collapsing the j side onto m k-means pool centroids (ops.pooling):
+
+        S(i, k) ≈ Σ_p count[p, k] · d(x_i, c_p)  −  d(x_i, c_{p(i)})·[k=own]
+
+    i.e. each candidate neighbor j is priced at its pool centroid; the own-
+    cluster sum drops one self term (the exact formulation excludes
+    d(i, i) = 0, so i's own pooled representation must not be counted).
+    The i side is exact — every evaluated cell uses its true coordinates —
+    so the only error is within-pool spread on the j side, which shrinks as
+    m grows (Secuer's anchor argument, PAPERS.md; the estimator-vs-exact
+    error is pinned by tests/test_scale_pooled.py at small N).
+
+    All cuts share the one (N, m) distance stream (the pooled analog of
+    ``multi_cut_silhouette``); ``centroids``/``assign`` reuse the tree
+    stage's existing pool when the pipeline already built one — the 1M
+    path pays ZERO extra k-means. ``sample`` > 0 evaluates widths on a
+    seeded row subset (per-cluster means stay unbiased; cluster sizes and
+    count tables always use the full population). Returns
+    [(mean_si, per_cluster_dict), …] like ``multi_cut_silhouette``.
+    """
+    from scconsensus_tpu.ops.pooling import kmeans_pool
+
+    x = np.ascontiguousarray(x, np.float32)
+    n = x.shape[0]
+    if centroids is None or assign is None:
+        centroids, assign = kmeans_pool(x, n_centroids, seed=seed)
+    centroids = np.asarray(centroids, np.float32)
+    assign = np.asarray(assign)
+    m = centroids.shape[0]
+
+    if sample is not None and sample < n:
+        rng = np.random.default_rng(seed)
+        eval_idx = np.sort(rng.choice(n, size=int(sample), replace=False))
+    else:
+        eval_idx = np.arange(n)
+
+    # per-cut membership tables from the FULL population
+    cuts = []
+    for labels in labels_list:
+        labels = np.asarray(labels)
+        valid = labels >= 0
+        uniq, inv = np.unique(labels[valid], return_inverse=True)
+        k = uniq.size
+        cm = np.zeros((m, max(k, 1)), np.float32)     # count[p, cluster]
+        np.add.at(cm, (assign[valid], inv), 1.0)
+        counts = cm.sum(axis=0)                        # (k,) full sizes
+        own = np.full(n, -1, np.int64)
+        own[valid] = inv
+        cuts.append((labels, k, cm, counts, own,
+                     np.full(n, np.nan, np.float32)))
+
+    c2 = np.sum(centroids * centroids, axis=1)[None, :]
+    for b0 in range(0, eval_idx.size, block):
+        rows = eval_idx[b0 : b0 + block]
+        xb = x[rows]
+        d2 = (
+            np.sum(xb * xb, axis=1)[:, None]
+            - 2.0 * xb @ centroids.T
+            + c2
+        )
+        np.maximum(d2, 0.0, out=d2)
+        d = np.sqrt(d2, out=d2)                        # (b, m)
+        d_self = d[np.arange(rows.size), assign[rows]]
+        for labels, k, cm, counts, own, w in cuts:
+            if k < 2:
+                continue
+            ob = own[rows]
+            ok = ob >= 0
+            sums = d @ cm                              # (b, k)
+            sums[np.nonzero(ok)[0], ob[ok]] -= d_self[ok]
+            wb = widths_from_cluster_sums(
+                sums[ok], counts, ob[ok]
+            )
+            w[rows[ok]] = wb
+    return [
+        _aggregate_widths(w, labels) for labels, _, _, _, _, w in cuts
+    ]
+
+
+def pooled_mean_cluster_silhouette(
+    x: np.ndarray, labels: np.ndarray, n_centroids: int = 2048,
+    seed: int = 0, **kw,
+) -> Tuple[float, Dict[int, float]]:
+    """Single-cut form of ``pooled_multi_cut_silhouette`` (same aggregation
+    convention as ``mean_cluster_silhouette``)."""
+    return pooled_multi_cut_silhouette(
+        x, [np.asarray(labels)], n_centroids=n_centroids, seed=seed, **kw
+    )[0]
+
+
 def _aggregate_widths(w: np.ndarray, labels: np.ndarray
                       ) -> Tuple[float, Dict[int, float]]:
     """Per-cluster mean widths + mean-of-means (the reference's reported SI)
@@ -135,7 +240,13 @@ def _aggregate_widths(w: np.ndarray, labels: np.ndarray
     convention cannot diverge between them."""
     per: Dict[int, float] = {}
     for u in np.unique(labels[labels >= 0]):
-        per[int(u)] = float(np.nanmean(w[labels == u]))
+        wu = w[labels == u]
+        if not np.any(np.isfinite(wu)):
+            # row-sampled estimator: a cluster none of whose cells were
+            # evaluated has no width estimate — leaving it out reports the
+            # mean over covered clusters instead of NaN-poisoning it
+            continue
+        per[int(u)] = float(np.nanmean(wu))
     if not per:
         return float("nan"), per
     return float(np.mean(list(per.values()))), per

@@ -49,6 +49,7 @@ def _vary(x, axis_name: str):
 
 
 from scconsensus_tpu.ops.distance import distance_tile as _dist_tile
+from scconsensus_tpu.utils.jax_compat import shard_map
 
 
 def _ring_sums_local(x_loc, oh_loc, axis_name: str, n_shards: int):
@@ -95,7 +96,7 @@ def _jitted_ring_sums(mesh: Mesh, axis_name: str):
     the jit cache instead of re-tracing and re-compiling."""
     n_shards = mesh.devices.size
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             partial(_ring_sums_local, axis_name=axis_name, n_shards=n_shards),
             mesh=mesh,
             in_specs=(P(axis_name), P(axis_name)),
@@ -204,7 +205,7 @@ def ring_knn(
 def _jitted_ring_knn(mesh: Mesh, axis_name: str, kk: int):
     n_shards = mesh.devices.size
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             partial(_ring_knn_local, kk=kk, axis_name=axis_name, n_shards=n_shards),
             mesh=mesh,
             in_specs=(P(axis_name), P(axis_name)),

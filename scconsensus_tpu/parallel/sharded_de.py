@@ -30,6 +30,7 @@ from scconsensus_tpu.parallel.mesh import (
     pad_and_shard,
     require_dense,
 )
+from scconsensus_tpu.utils.jax_compat import shard_map
 
 __all__ = [
     "sharded_aggregates", "sharded_wilcox_logp", "sharded_allpairs_ranksum",
@@ -60,24 +61,51 @@ def _agg_local(data_loc, onehot_loc, axis_name: str):
 
 def sharded_aggregates(
     data: np.ndarray,
-    onehot: np.ndarray,
+    onehot: Optional[np.ndarray] = None,
     mesh: Optional[Mesh] = None,
     axis_name: str = CELL_AXIS,
+    cid: Optional[np.ndarray] = None,
+    n_clusters: Optional[int] = None,
 ) -> ClusterAggregates:
     """Cell-sharded ClusterAggregates (same result as ops.gates.compute_aggregates).
 
     data: (G, N) log-normalized; onehot: (N, K). Padding cells (zero onehot
     rows, zero data columns) do not perturb any statistic.
+
+    Alternatively pass ``cid`` (N,) int32 per-cell cluster ids (−1 =
+    excluded) + ``n_clusters`` instead of ``onehot``: each shard builds its
+    local one-hot slice ON DEVICE, so the host never materializes or
+    uploads the (N, K) membership matrix — the r6 fold of the engine's
+    one-hot rebuild, mesh form (ids are 4 bytes/cell vs 4·K).
     """
-    require_dense(data, onehot)
+    require_dense(data)
     mesh = mesh or make_mesh(axis_name=axis_name)
     # pad_and_shard keeps a device-resident jax.Array on device (pad +
     # redistribute in HBM); host numpy pads on host and uploads sharded —
     # on a multi-process mesh each process uploads only its addressable
     # cell blocks
     dp, _ = pad_and_shard(data, mesh, P(None, axis_name), 1)
-    op, _ = pad_and_shard(onehot, mesh, P(axis_name), 0)
-    out = _jitted_aggregates(mesh, axis_name)(dp, op)
+    if cid is not None:
+        if onehot is not None:
+            raise ValueError("pass either onehot or cid, not both")
+        if n_clusters is None:
+            raise ValueError("cid form requires n_clusters")
+        from scconsensus_tpu.parallel.mesh import put_sharded
+
+        # pad with −1 (excluded), NOT 0 — a zero-padded id would count the
+        # phantom cells into cluster 0
+        cid_h = np.asarray(jax.device_get(cid), np.int32).ravel()
+        n_pad = (-cid_h.size) % int(mesh.devices.size)
+        if n_pad:
+            cid_h = np.concatenate(
+                [cid_h, np.full(n_pad, -1, np.int32)]
+            )
+        cp = put_sharded(cid_h, mesh, P(axis_name))
+        out = _jitted_aggregates_cid(mesh, axis_name, int(n_clusters))(dp, cp)
+    else:
+        require_dense(onehot)
+        op, _ = pad_and_shard(onehot, mesh, P(axis_name), 0)
+        out = _jitted_aggregates(mesh, axis_name)(dp, op)
     drain_if_cpu_mesh(mesh, *out)
     return ClusterAggregates(*out)
 
@@ -86,8 +114,30 @@ def sharded_aggregates(
 def _jitted_aggregates(mesh: Mesh, axis_name: str):
     """Cached jitted wrapper — repeat calls hit the jit cache, not a rebuild."""
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             partial(_agg_local, axis_name=axis_name),
+            mesh=mesh,
+            in_specs=(P(None, axis_name), P(axis_name)),
+            out_specs=(P(None),) * 5,
+        )
+    )
+
+
+def _agg_local_cid(data_loc, cid_loc, axis_name: str, n_clusters: int):
+    """cid form of ``_agg_local``: the local one-hot slice materializes on
+    device only (Nl·K), never on host."""
+    oh = (
+        cid_loc[:, None] == jnp.arange(n_clusters, dtype=cid_loc.dtype)[None, :]
+    ).astype(data_loc.dtype)
+    return _agg_local(data_loc, oh, axis_name)
+
+
+@lru_cache(maxsize=32)
+def _jitted_aggregates_cid(mesh: Mesh, axis_name: str, n_clusters: int):
+    return jax.jit(
+        shard_map(
+            partial(_agg_local_cid, axis_name=axis_name,
+                    n_clusters=n_clusters),
             mesh=mesh,
             in_specs=(P(None, axis_name), P(axis_name)),
             out_specs=(P(None),) * 5,
@@ -120,14 +170,34 @@ def sharded_allpairs_ranksum(
     the single-device ``allpairs_ranksum_chunk``. The gene axis is padded to
     the shard count; padded all-zero rows produce NaN and are sliced off.
     ``window``: zero-block decomposition width (see ranksum_body) — genes
-    are local to a shard, so the sparse-window mode shards unchanged.
+    are local to a shard, so the sparse-window mode shards unchanged. A 2-D
+    pre-compacted (Gc, W) ``cid`` (CSR windows, r6) rides the same gene
+    sharding as the chunk; a shared (N,) vector replicates. Gene-axis
+    padding rows carry cid −1 (excluded) and all-zero values, so they are
+    doubly inert: 2-D cid implies window mode, where zero-valued positions
+    are masked out of every cluster before any statistic.
     """
     mesh = mesh or make_mesh(axis_name=axis_name)
     gc = chunk.shape[0]
     # host input pads+uploads; device-resident input pads+redistributes in
     # HBM — either way the jitted shard_map sees a pre-laid-out operand
     chunk, _ = pad_and_shard(chunk, mesh, P(axis_name, None), 0)
-    lp, u, ts = _jitted_allpairs(mesh, axis_name, n_clusters, window)(
+    cid_2d = getattr(cid, "ndim", 1) == 2
+    if cid_2d:
+        # int-preserving pad + upload: pad_and_shard casts to float32 (its
+        # data-tensor contract), which would hand the kernel float cluster
+        # ids — pad the gene axis with −1 (excluded) rows and shard as int32
+        from scconsensus_tpu.parallel.mesh import put_sharded
+
+        cid_h = np.asarray(jax.device_get(cid), np.int32)
+        n_pad = (-cid_h.shape[0]) % int(mesh.devices.size)
+        if n_pad:
+            cid_h = np.pad(
+                cid_h, ((0, n_pad), (0, 0)), constant_values=-1
+            )
+        cid = put_sharded(cid_h, mesh, P(axis_name, None))
+    lp, u, ts = _jitted_allpairs(mesh, axis_name, n_clusters, window,
+                                 cid_2d)(
         chunk, cid, n_of, pair_i, pair_j
     )
     # virtual-CPU meshes deadlock with >1 collective program in flight
@@ -137,18 +207,23 @@ def sharded_allpairs_ranksum(
 
 @lru_cache(maxsize=32)
 def _jitted_allpairs(mesh: Mesh, axis_name: str, n_clusters: int,
-                     window: int = 0):
+                     window: int = 0, cid_2d: bool = False):
     from scconsensus_tpu.ops.ranksum_allpairs import ranksum_body
 
     def local(chunk_loc, cid, n_of, pair_i, pair_j):
+        # cpu_forms=False: the scatter forms' mixed advanced indexing is
+        # rejected inside shard_map on jax 0.4.x, and a sharded program is
+        # the einsum-form case by design (TPU meshes) anyway
         return ranksum_body(chunk_loc, cid, n_of, pair_i, pair_j, n_clusters,
-                            window=window)
+                            window=window, cpu_forms=False)
 
+    cid_spec = P(axis_name, None) if cid_2d else P(None)
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(axis_name, None), P(None), P(None), P(None), P(None)),
+            in_specs=(P(axis_name, None), cid_spec, P(None), P(None),
+                      P(None)),
             out_specs=(P(axis_name, None),) * 3,
         )
     )
@@ -191,7 +266,7 @@ def sharded_wilcox_logp(
 @lru_cache(maxsize=32)
 def _jitted_wilcox(mesh: Mesh, axis_name: str):
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             _wilcox_local,
             mesh=mesh,
             in_specs=(P(axis_name), P(None), P(None), P(None), P(None), P(None)),

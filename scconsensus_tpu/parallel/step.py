@@ -30,6 +30,7 @@ from scconsensus_tpu.ops.wilcoxon import wilcoxon_pairs_tile
 from scconsensus_tpu.parallel.mesh import CELL_AXIS
 from scconsensus_tpu.parallel.ring import _ring_sums_local
 from scconsensus_tpu.parallel.sharded_de import _agg_local, _wilcox_local
+from scconsensus_tpu.utils.jax_compat import shard_map
 
 __all__ = ["distributed_refine_step", "fused_refine_step", "build_step_inputs"]
 
@@ -125,19 +126,19 @@ def distributed_refine_step(
     """
     n_shards = int(mesh.devices.size)
 
-    raw_agg = jax.shard_map(
+    raw_agg = shard_map(
         partial(_agg_local, axis_name=axis_name),
         mesh=mesh,
         in_specs=(P(None, axis_name), P(axis_name)),
         out_specs=(P(None),) * 5,
     )
-    wilcox_fn = jax.shard_map(
+    wilcox_fn = shard_map(
         _wilcox_local,
         mesh=mesh,
         in_specs=(P(axis_name), P(None), P(None), P(None), P(None), P(None)),
         out_specs=P(None, axis_name),
     )
-    sil_fn = jax.shard_map(
+    sil_fn = shard_map(
         partial(_ring_sums_local, axis_name=axis_name, n_shards=n_shards),
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name)),

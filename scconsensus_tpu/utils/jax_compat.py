@@ -1,0 +1,19 @@
+"""Version-tolerant jax API aliases.
+
+The codebase targets the promoted `jax.shard_map` (jax ≥ 0.5); this
+container ships jax 0.4.37 where it still lives in
+`jax.experimental.shard_map`. One alias point instead of nine guarded
+call sites — same spirit as the xla_bootstrap flag probe: the installed
+runtime decides, the code stays single-form.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - exercised only on old jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = ["shard_map"]

@@ -31,10 +31,14 @@ _LOG_LIST_CAP = 16
 def _log_form(rec: Dict[str, Any]) -> Dict[str, Any]:
     """Log-line rendering of a stage record: long lists (e.g. the per-pair
     DE counts at K=44 → 946 entries) are summarized; the STORED record —
-    what metrics/bench consumers read — keeps the full values."""
+    what metrics/bench consumers read — keeps the full values. Recurses
+    into nested dicts (the wilcox stage's ``occupancy`` probe carries a
+    per-bucket list that can run tens of entries at 1M-cell shapes)."""
     out: Dict[str, Any] = {}
     for k, v in rec.items():
-        if isinstance(v, (list, tuple)) and len(v) > _LOG_LIST_CAP:
+        if isinstance(v, dict):
+            out[k] = _log_form(v)
+        elif isinstance(v, (list, tuple)) and len(v) > _LOG_LIST_CAP:
             out[k] = {
                 "n": len(v),
                 "head": list(v[:_LOG_LIST_CAP]),

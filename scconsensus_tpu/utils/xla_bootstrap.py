@@ -7,7 +7,9 @@ workaround set cannot drift between the two bootstrap paths.
 
 from __future__ import annotations
 
+import json
 import os
+import tempfile
 
 # N virtual devices on few physical cores: XLA's default 40 s collective
 # rendezvous terminate-timeout hard-aborts oversubscribed runs (observed at
@@ -19,15 +21,92 @@ _TIMEOUT_FLAGS = (
 )
 
 
+def _jaxlib_xla_binary() -> str | None:
+    """Path of jaxlib's xla_extension shared object, without importing jax
+    (this bootstrap must run before the first jax import)."""
+    import importlib.util
+
+    spec = importlib.util.find_spec("jaxlib")
+    if spec is None or not spec.submodule_search_locations:
+        return None
+    for loc in spec.submodule_search_locations:
+        for name in ("xla_extension.so", "xla_extension.pyd"):
+            p = os.path.join(loc, name)
+            if os.path.exists(p):
+                return p
+    return None
+
+
+def _supported_flags(candidates: tuple) -> dict:
+    """Which candidate XLA flags this jaxlib knows. An UNKNOWN flag in
+    XLA_FLAGS is a hard process abort at first backend init
+    (parse_flags_from_env.cc), so each flag is only ever added after its
+    name is found in the xla_extension binary. Results cache per jaxlib
+    version (the multihost tests respawn interpreters; a ~2 s binary scan
+    per process would dominate small suites)."""
+    try:
+        import importlib.metadata as md
+
+        ver = md.version("jaxlib")
+    except Exception:
+        ver = "unknown"
+    cache = os.path.join(
+        tempfile.gettempdir(), f"scc_xla_flag_probe_{ver}.json"
+    )
+    try:
+        with open(cache) as f:
+            got = json.load(f)
+        if set(got) >= set(candidates):
+            return got
+    except (OSError, ValueError):
+        pass
+    binary = _jaxlib_xla_binary()
+    if binary is None:
+        # can't verify: adding is fatal if wrong, omitting only loses the
+        # raised rendezvous timeout — omit, and do NOT cache (a transient
+        # resolution failure must not permanently disable the flags for
+        # this jaxlib version)
+        return {f: False for f in candidates}
+    needles = {f: f.encode() for f in candidates}
+    sup = {f: False for f in candidates}
+    try:
+        with open(binary, "rb") as fh:
+            while chunk := fh.read(1 << 24):
+                for f, n in needles.items():
+                    if not sup[f] and n in chunk:
+                        sup[f] = True
+                # a short read is the last chunk: stop — seeking back
+                # into it would re-read the same tail forever
+                if all(sup.values()) or len(chunk) < (1 << 24):
+                    break
+                # overlap guard: a needle split across chunk boundaries
+                fh.seek(fh.tell() - 64)
+    except OSError:
+        # transient read failure (e.g. the wheel being replaced under us):
+        # omit the flags this run but do NOT cache the verdict
+        return {f: False for f in candidates}
+    try:
+        tmp = cache + f".{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(sup, f)
+        os.replace(tmp, cache)
+    except OSError:
+        pass
+    return sup
+
+
 def apply_virtual_cpu_xla_flags(n_devices: int) -> None:
     """Set XLA_FLAGS for an n-device virtual CPU mesh. Each flag is guarded
-    by its own name, so a caller's explicit setting always wins."""
+    by its own name, so a caller's explicit setting always wins; timeout
+    flags are version-probed (jaxlib 0.4.36 dropped the cpu collective
+    timeout flags — blindly setting them aborts every process)."""
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         flags = (
             flags + f" --xla_force_host_platform_device_count={n_devices}"
         ).strip()
+    sup = _supported_flags(_TIMEOUT_FLAGS)
     for f in _TIMEOUT_FLAGS:
-        if f not in flags:
+        if f not in flags and sup.get(f):
             flags += f" --{f}=1200"
     os.environ["XLA_FLAGS"] = flags

@@ -54,6 +54,67 @@ def test_sharded_aggregates_ragged_n(rng, mesh):
     np.testing.assert_allclose(got.counts, ref.counts, rtol=0)
 
 
+def test_sharded_aggregates_device_resident(rng, mesh):
+    """A COMMITTED device array through sharded_aggregates: covers
+    pad_and_shard's device branch (pad + redistribute in HBM, no host
+    round-trip — ADVICE r5 item 3, previously unexercised)."""
+    data, _, onehot = _synthetic(rng, n=101)  # non-multiple: device pad path
+    jdata = jax.device_put(data, jax.devices()[0])  # committed
+    joh = jax.device_put(onehot, jax.devices()[0])
+    assert isinstance(jdata, jax.Array)
+    ref = compute_aggregates(jnp.asarray(data), jnp.asarray(onehot))
+    got = sharded_aggregates(jdata, joh, mesh)
+    np.testing.assert_allclose(got.sum_log, ref.sum_log, rtol=1e-5)
+    np.testing.assert_allclose(got.sum_sq, ref.sum_sq, rtol=1e-5)
+    np.testing.assert_allclose(got.nnz, ref.nnz, rtol=0)
+    np.testing.assert_allclose(got.counts, ref.counts, rtol=0)
+
+
+def test_sharded_aggregates_cid_form(rng, mesh):
+    """The r6 cid form (one-hot built per shard on device) must equal the
+    host-one-hot form, excluded cells (−1) contributing nowhere. n chosen
+    non-divisible so the −1 id padding path runs."""
+    data, labels, _ = _synthetic(rng, n=101)
+    cid = labels.astype(np.int32).copy()
+    cid[:7] = -1  # excluded cells
+    k = 4
+    onehot = np.zeros((101, k), np.float32)
+    v = cid >= 0
+    onehot[np.nonzero(v)[0], cid[v]] = 1.0
+    ref = compute_aggregates(jnp.asarray(data), jnp.asarray(onehot))
+    got = sharded_aggregates(data, mesh=mesh, cid=cid, n_clusters=k)
+    np.testing.assert_allclose(got.sum_log, ref.sum_log, rtol=1e-5)
+    np.testing.assert_allclose(got.sum_expm1, ref.sum_expm1, rtol=1e-5)
+    np.testing.assert_allclose(got.nnz, ref.nnz, rtol=0)
+    np.testing.assert_allclose(got.counts, ref.counts, rtol=0)
+
+
+def test_sharded_wilcox_device_resident(rng, mesh):
+    """Committed device input through sharded_wilcox_logp (the other entry
+    ADVICE r5 item 3 flagged as unexercised on the device branch)."""
+    from scconsensus_tpu.ops.wilcoxon import wilcoxon_pairs_tile
+
+    _wilcox_chunk = jax.jit(wilcoxon_pairs_tile)
+    data, labels, _ = _synthetic(rng, n=64, g=26, k=2)  # g % 8 != 0
+    ci = np.nonzero(labels == 0)[0].astype(np.int32)
+    cj = np.nonzero(labels == 1)[0].astype(np.int32)
+    w = ci.size + cj.size
+    idx = np.concatenate([ci, cj])[None, :]
+    m1 = np.zeros((1, w), bool)
+    m1[0, : ci.size] = True
+    m2 = ~m1
+    n1 = np.array([ci.size], np.int32)
+    n2 = np.array([cj.size], np.int32)
+    ref, _, _ = _wilcox_chunk(
+        jnp.asarray(data), jnp.asarray(idx), jnp.asarray(m1),
+        jnp.asarray(m2), jnp.asarray(n1), jnp.asarray(n2),
+    )
+    jdata = jax.device_put(data, jax.devices()[0])  # committed device input
+    got = sharded_wilcox_logp(jdata, idx, m1, m2, n1, n2, mesh)
+    np.testing.assert_allclose(got[0], np.asarray(ref)[0], rtol=1e-4,
+                               atol=1e-4)
+
+
 def test_ring_sums_match_dense(rng, mesh):
     x = rng.normal(size=(50, 5)).astype(np.float32)
     _, labels, onehot = _synthetic(rng, n=50)
@@ -128,6 +189,42 @@ def test_sharded_allpairs_ranksum_matches_serial(rng, mesh):
     for r, g in zip(ref, got):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=1e-5,
                                    atol=1e-5)
+
+
+def test_sharded_allpairs_ranksum_compacted_cid(rng, mesh):
+    """Pre-compacted (Gc, W) int32 cid rows through the mesh path: the
+    gene-axis pad must preserve the int dtype (pad_and_shard's float32
+    cast would hand the kernel float cluster ids) and match the
+    single-device windowed run."""
+    import scipy.sparse as sp
+
+    from scconsensus_tpu.de.engine import _all_pairs
+    from scconsensus_tpu.io.sparsemat import csr_window_rows
+    from scconsensus_tpu.ops.ranksum_allpairs import allpairs_ranksum_chunk
+    from scconsensus_tpu.parallel.sharded_de import sharded_allpairs_ranksum
+
+    k, g, n = 3, 26, 256  # g % 8 != 0: gene-axis pad path runs
+    data = np.zeros((g, n), np.float32)
+    for row in range(g):
+        idx = rng.choice(n, size=40, replace=False)
+        data[row, idx] = np.round(rng.gamma(2.0, size=40) * 4) / 4 + 0.25
+    labels = rng.integers(0, k, n).astype(np.int32)
+    csr = sp.csr_matrix(data)
+    w = 64
+    vals, wcid = csr_window_rows(csr, np.arange(g), w, labels)
+    n_of = np.array([(labels == c).sum() for c in range(k)], np.int32)
+    pi, pj = _all_pairs(k)
+    ref = allpairs_ranksum_chunk(
+        jnp.asarray(vals), jnp.asarray(wcid), jnp.asarray(n_of),
+        jnp.asarray(pi), jnp.asarray(pj), k, window=w,
+    )
+    got = sharded_allpairs_ranksum(
+        jnp.asarray(vals), jnp.asarray(wcid), jnp.asarray(n_of),
+        jnp.asarray(pi), jnp.asarray(pj), k, mesh=mesh, window=w,
+    )
+    for r, gg in zip(ref, got):
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(r),
+                                   rtol=1e-5, atol=1e-5)
 
 
 def test_mesh_refine_matches_serial(mesh):

@@ -54,3 +54,130 @@ def test_refine_switches_to_pooled_above_threshold(rng):
     lab = res.dynamic_labels["deepsplit: 1"]
     m = lab > 0
     assert adjusted_rand_score(truth[m], lab[m]) > 0.9
+
+
+class TestPooledSilhouette:
+    """r6 pooled silhouette estimator: error pinned against the exact
+    O(N²) path at small N (ISSUE r6 tentpole b), then the pipeline wiring
+    above approx_threshold."""
+
+    def _blobs(self, rng, n=4000, k=4, d=8, scale=5.0):
+        centers = rng.normal(scale=scale, size=(k, d))
+        lab = rng.integers(0, k, n)
+        x = (centers[lab] + rng.normal(size=(n, d))).astype(np.float32)
+        return x, lab.astype(np.int64)
+
+    def test_estimator_error_pinned_vs_exact(self, rng):
+        from scconsensus_tpu.ops.silhouette import (
+            mean_cluster_silhouette,
+            pooled_mean_cluster_silhouette,
+        )
+
+        x, lab = self._blobs(rng)
+        si_exact, per_exact = mean_cluster_silhouette(x, lab)
+        si_pool, per_pool = pooled_mean_cluster_silhouette(
+            x, lab, n_centroids=256, seed=1
+        )
+        assert abs(si_pool - si_exact) < 0.03
+        for c in per_exact:
+            assert abs(per_pool[c] - per_exact[c]) < 0.05
+
+    def test_estimator_with_row_sampling(self, rng):
+        from scconsensus_tpu.ops.silhouette import (
+            mean_cluster_silhouette,
+            pooled_mean_cluster_silhouette,
+        )
+
+        x, lab = self._blobs(rng)
+        si_exact, _ = mean_cluster_silhouette(x, lab)
+        si_s, _ = pooled_mean_cluster_silhouette(
+            x, lab, n_centroids=256, seed=1, sample=1200
+        )
+        assert abs(si_s - si_exact) < 0.06
+
+    def test_sampling_missed_cluster_does_not_nan_poison(self):
+        # row sampling is uniform, so a tiny cluster can land zero
+        # evaluated rows: its all-NaN width slice must drop out of the
+        # mean-of-means instead of making the reported scalar NaN
+        from scconsensus_tpu.ops.silhouette import _aggregate_widths
+
+        w = np.array([0.5, 0.7, np.nan, np.nan], np.float32)
+        lab = np.array([0, 0, 1, 1])
+        si, per = _aggregate_widths(w, lab)
+        assert si == pytest.approx(0.6)
+        assert 1 not in per
+
+    def test_excluded_and_singleton_cells(self, rng):
+        from scconsensus_tpu.ops.silhouette import (
+            mean_cluster_silhouette,
+            pooled_mean_cluster_silhouette,
+        )
+
+        x, lab = self._blobs(rng, n=1500, k=3)
+        lab[:40] = -1  # excluded cells must not enter any sum
+        si_exact, _ = mean_cluster_silhouette(x, lab)
+        si_pool, _ = pooled_mean_cluster_silhouette(
+            x, lab, n_centroids=128, seed=2
+        )
+        assert abs(si_pool - si_exact) < 0.04
+
+    def test_multi_cut_shares_one_distance_stream(self, rng):
+        from scconsensus_tpu.ops.silhouette import (
+            multi_cut_silhouette,
+            pooled_multi_cut_silhouette,
+        )
+
+        x, lab = self._blobs(rng, n=2500, k=4)
+        lab2 = lab.copy()
+        lab2[lab2 == 3] = 2  # a coarser second cut
+        exact = multi_cut_silhouette(x, [lab, lab2])
+        pooled = pooled_multi_cut_silhouette(
+            x, [lab, lab2], n_centroids=256, seed=3
+        )
+        for (se, _), (sp_, _) in zip(exact, pooled):
+            assert abs(sp_ - se) < 0.04
+
+    def test_refine_reports_pooled_silhouette_above_threshold(self, rng):
+        from scconsensus_tpu import recluster_de_consensus_fast
+        from scconsensus_tpu.utils.synthetic import synthetic_scrna
+
+        data, truth, _ = synthetic_scrna(
+            n_genes=120, n_cells=2500, n_clusters=3, seed=4
+        )
+        res = recluster_de_consensus_fast(
+            data,
+            np.array([f"c{v}" for v in truth]),
+            deep_split_values=(1, 2),
+            approx_threshold=1000,        # force pooled tree AND silhouette
+            n_pool_centroids=256,
+            mesh=None,
+        )
+        sil_rec = next(
+            r for r in res.metrics["stages"] if r["stage"] == "silhouette"
+        )
+        assert sil_rec["method"] == "pooled-estimator"
+        for info in res.deep_split_info:
+            assert info["silhouette_method"] == "pooled-estimator"
+            assert np.isfinite(info["silhouette"])
+            assert -1.0 <= info["silhouette"] <= 1.0
+
+    def test_refine_exact_below_threshold(self, rng):
+        from scconsensus_tpu import recluster_de_consensus_fast
+        from scconsensus_tpu.utils.synthetic import synthetic_scrna
+
+        data, truth, _ = synthetic_scrna(
+            n_genes=120, n_cells=600, n_clusters=3, seed=6
+        )
+        res = recluster_de_consensus_fast(
+            data,
+            np.array([f"c{v}" for v in truth]),
+            deep_split_values=(1,),
+            mesh=None,
+        )
+        sil_rec = next(
+            r for r in res.metrics["stages"] if r["stage"] == "silhouette"
+        )
+        assert "method" not in sil_rec  # exact path: no estimator tag
+        assert all(
+            "silhouette_method" not in i for i in res.deep_split_info
+        )

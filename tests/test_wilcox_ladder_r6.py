@@ -1,0 +1,218 @@
+"""Round-6 window-ladder restructuring: CSR-compacted windows, the
+occupancy probe, the N-scaled window floor, and the dense overflow-redo
+defensive rebuild (ADVICE r5 item 1)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from scconsensus_tpu.de.engine import (
+    _all_pairs,
+    _run_wilcox,
+    _run_wilcox_device,
+    _window_floor,
+)
+
+
+def _sparse_case(rng, g=30, n=2600, k=4, nnz_frac=0.12):
+    """Tie-heavy mostly-zero matrix whose nnz sits under the 1024 window
+    floor, so the ladder genuinely selects windows < N."""
+    data = np.zeros((g, n), np.float32)
+    for row in range(g):
+        nnz = int(n * nnz_frac * rng.uniform(0.2, 1.0))
+        idx = rng.choice(n, size=nnz, replace=False)
+        data[row, idx] = np.round(rng.gamma(2.0, size=nnz) * 4) / 4 + 0.25
+    lab = rng.integers(0, k, n)
+    lab[:5] = -1
+    cell_idx_of = [np.nonzero(lab == c)[0].astype(np.int32) for c in range(k)]
+    pi, pj = _all_pairs(k)
+    return data, cell_idx_of, pi, pj
+
+
+class TestCsrCompactedLadder:
+    def test_matches_dense_ladder(self, rng):
+        """CSR input (pre-compacted ~nnz-wide windows + per-gene cid rows)
+        must reproduce the dense device ladder exactly — same kernels, same
+        zero-block corrections, different packing."""
+        data, cell_idx_of, pi, pj = self._case(rng)
+        lp_d, u_d = _run_wilcox(data, cell_idx_of, pi, pj, exact="never")
+        lp_s, u_s = _run_wilcox(
+            sp.csr_matrix(data), cell_idx_of, pi, pj, exact="never"
+        )
+        np.testing.assert_array_equal(np.isnan(lp_d), np.isnan(lp_s))
+        m = np.isfinite(lp_d)
+        np.testing.assert_allclose(lp_s[m], lp_d[m], rtol=1e-5, atol=1e-3)
+        np.testing.assert_allclose(u_s, u_d, atol=1e-3)
+
+    def test_matches_dense_ladder_with_explicit_zeros(self, rng):
+        """Explicit stored zeros burn a window slot but must stay inert
+        (the kernel masks window positions whose value is 0)."""
+        data, cell_idx_of, pi, pj = self._case(rng)
+        csr = sp.csr_matrix(data)
+        # turn ~10% of stored entries into explicit zeros IN THE DENSE
+        # TWIN TOO, so both paths describe the same matrix
+        kill = np.arange(csr.nnz) % 10 == 3
+        csr.data[kill] = 0.0
+        dense = csr.toarray()
+        lp_d, u_d = _run_wilcox(dense, cell_idx_of, pi, pj, exact="never")
+        lp_s, u_s = _run_wilcox(csr, cell_idx_of, pi, pj, exact="never")
+        m = np.isfinite(lp_d)
+        np.testing.assert_allclose(lp_s[m], lp_d[m], rtol=1e-5, atol=1e-3)
+        np.testing.assert_allclose(u_s, u_d, atol=1e-3)
+
+    def test_csr_negative_values_fall_back(self, rng):
+        """Negative values defeat the zero-block decomposition; the CSR
+        route must fall back to the chunk-densify path, not mis-rank."""
+        data, cell_idx_of, pi, pj = self._case(rng)
+        data[0, np.nonzero(data[0])[0][:3]] = -0.5
+        lp_d, u_d = _run_wilcox(data, cell_idx_of, pi, pj, exact="never")
+        probe = {}
+        lp_s, u_s = _run_wilcox_device(
+            sp.csr_matrix(data), cell_idx_of, pi, pj, exact="never",
+            probe_out=probe,
+        )
+        assert probe["occupancy"]["windowed"] is False
+        m = np.isfinite(lp_d)
+        np.testing.assert_allclose(
+            np.asarray(lp_s)[m], lp_d[m], rtol=1e-5, atol=1e-3
+        )
+        np.testing.assert_allclose(np.asarray(u_s), u_d, atol=1e-3)
+
+    def _case(self, rng):
+        return _sparse_case(rng)
+
+
+class TestOccupancyProbe:
+    def test_bucket_stats_internally_consistent(self, rng, monkeypatch):
+        """ISSUE r6 satellite: gene counts across buckets sum to G, padding
+        never shrinks below the real population, synced per-bucket walls
+        add up to ≈ the ladder wall."""
+        monkeypatch.setenv("SCC_WILCOX_PROBE", "1")
+        data, cell_idx_of, pi, pj = _sparse_case(rng)
+        probe = {}
+        _run_wilcox_device(
+            sp.csr_matrix(data), cell_idx_of, pi, pj, exact="never",
+            probe_out=probe,
+        )
+        occ = probe["occupancy"]
+        assert occ["windowed"] is True
+        assert occ["input"] == "csr-compacted"
+        assert occ["probe_synced"] is True
+        assert occ["window_floor"] == _window_floor(data.shape[1])
+        buckets = occ["buckets"]
+        assert buckets, "ladder must populate at least one bucket"
+        assert sum(b["n_genes"] for b in buckets) == data.shape[0]
+        for b in buckets:
+            assert b["pad_ratio"] >= 1.0
+            assert b["padded_elems"] >= b["real_elems"]
+            assert b["nnz_min"] <= b["nnz_max"] <= b["window"]
+            assert b["n_genes"] <= b["padded_rows"]
+            assert b["wall_s"] >= 0.0
+            assert b["sort_s"] >= 0.0
+        walls = sum(b["wall_s"] for b in buckets)
+        # per-bucket walls are synced, so they can only undercount the
+        # ladder wall (host-side bucketing/compaction between syncs); no
+        # lower bound — at this tiny shape the host work between syncs
+        # legitimately dominates and a ratio assert would flake under load
+        assert walls <= occ["ladder_wall_s"] + 0.1
+
+    def test_probe_rides_pairwise_de_stage_records(self, rng, monkeypatch):
+        """The probe's consumer contract: pairwise_de's wilcox stage record
+        carries the occupancy dict (bench artifacts read it from there)."""
+        monkeypatch.delenv("SCC_WILCOX_PROBE", raising=False)
+        from scconsensus_tpu.config import ReclusterConfig
+        from scconsensus_tpu.de import pairwise_de
+        from scconsensus_tpu.utils.logging import StageTimer
+
+        data, cell_idx_of, _, _ = _sparse_case(rng, g=20, n=1400, k=3)
+        labels = np.full(data.shape[1], "x")
+        for c, ci in enumerate(cell_idx_of):
+            labels[ci] = f"c{c}"
+        timer = StageTimer()
+        pairwise_de(
+            data, labels, ReclusterConfig(min_cluster_size=2), timer=timer
+        )
+        rec = next(
+            r for r in timer.records if r["stage"] == "wilcox_test"
+        )
+        occ = rec["occupancy"]
+        assert occ["probe_synced"] is False  # unsynced: shape stats only
+        assert sum(b["n_genes"] for b in occ["buckets"]) == data.shape[0]
+        assert all("wall_s" not in b for b in occ["buckets"])
+
+
+class TestWindowFloor:
+    def test_floor_scales_with_n(self):
+        assert _window_floor(1_000) == 1024
+        assert _window_floor(100_000) == 1024
+        assert _window_floor(300_000) == 2048
+        assert _window_floor(1_000_000) == 4096
+        # memory guard: the floor never exceeds 16k lanes
+        assert _window_floor(50_000_000) == 16384
+
+
+class TestDenseOverflowRedo:
+    def test_redo_with_none_jdata_dense_input(self, rng, monkeypatch):
+        """ADVICE r5 item 1: _redo_overflow_dense's non-sparse branch used
+        to crash on jdata=None (a NoneType slice) — a caller relying on
+        _gene_chunks's upload-on-demand contract only hit it in the rare
+        overflow case. Patch RUN_CAP small so tie-heavy dense input drives
+        the redo, pass jdata=None, and pin the answers against the pure
+        scan kernel run."""
+        import scconsensus_tpu.ops.ranksum_allpairs as ra
+
+        g, n, k = 10, 500, 3
+        data = np.round(np.abs(rng.normal(size=(g, n))) * 5).astype(
+            np.float32
+        )
+        data[rng.random((g, n)) < 0.4] = 0.0
+        data[:, 0] = -0.25  # negatives: keeps the dense path un-windowed
+        lab = rng.integers(0, k, n)
+        cell_idx_of = [np.nonzero(lab == c)[0].astype(np.int32)
+                       for c in range(k)]
+        pi, pj = _all_pairs(k)
+        monkeypatch.setattr(ra, "RUN_CAP", 4)
+        lp_rs, u_rs = _run_wilcox_device(
+            data, cell_idx_of, pi, pj, exact="never", jdata=None
+        )
+        monkeypatch.setenv("SCC_NO_RUNSPACE", "1")
+        lp_sc, u_sc = _run_wilcox_device(
+            data, cell_idx_of, pi, pj, exact="never", jdata=None
+        )
+        lp_rs, lp_sc = np.asarray(lp_rs), np.asarray(lp_sc)
+        np.testing.assert_array_equal(np.isnan(lp_rs), np.isnan(lp_sc))
+        m = np.isfinite(lp_sc)
+        np.testing.assert_allclose(lp_rs[m], lp_sc[m], rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(u_rs), np.asarray(u_sc), atol=1e-3
+        )
+
+
+class TestCsrOverflowRedo:
+    def test_windowed_csr_overflow_redo(self, rng, monkeypatch):
+        """The windowed redo path's refetch closure must rebuild CSR-
+        compacted windows for the flagged genes (not dense rows)."""
+        import scconsensus_tpu.ops.ranksum_allpairs as ra
+
+        data, cell_idx_of, pi, pj = _sparse_case(
+            rng, g=16, n=2000, k=3, nnz_frac=0.2
+        )
+        monkeypatch.setattr(ra, "RUN_CAP", 4)
+        probe = {}
+        lp_rs, u_rs = _run_wilcox_device(
+            sp.csr_matrix(data), cell_idx_of, pi, pj, exact="never",
+            probe_out=probe,
+        )
+        assert sum(
+            b["overflow_genes"] for b in probe["occupancy"]["buckets"]
+        ) > 0, "case must actually drive the redo"
+        monkeypatch.setenv("SCC_NO_RUNSPACE", "1")
+        lp_sc, u_sc = _run_wilcox_device(
+            sp.csr_matrix(data), cell_idx_of, pi, pj, exact="never"
+        )
+        lp_rs, lp_sc = np.asarray(lp_rs), np.asarray(lp_sc)
+        m = np.isfinite(lp_sc)
+        np.testing.assert_allclose(lp_rs[m], lp_sc[m], rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(u_rs), np.asarray(u_sc), atol=1e-3
+        )

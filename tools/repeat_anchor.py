@@ -1,0 +1,82 @@
+"""Repeated-run error bars for a cheap bench anchor (VERDICT r5 #6).
+
+Single-run numbers on the single-core host carry unexplained
+process-state variance (the r5 tm100k record has 687 s vs 836 s for the
+same synced stage in one process); for anchors cheap enough to repeat,
+the round-6 policy (BASELINE.md) is median-of-≥3 with the spread on the
+record. This runner executes `bench.py` N times sequentially under
+SCC_BENCH_PLATFORM=cpu, parses the one-line JSON records, and commits
+median + min/max + per-run values (full records included) to
+SCALE_r06_cpu_<config>_repeats.json.
+
+Run:  python tools/repeat_anchor.py [config] [n_runs]
+      (defaults: cite8k, 3)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+
+def main() -> None:
+    config = sys.argv[1] if len(sys.argv) > 1 else "cite8k"
+    n_runs = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    env = dict(os.environ, SCC_BENCH_CONFIG=config, SCC_BENCH_PLATFORM="cpu")
+    runs = []
+    for i in range(n_runs):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(base, "bench.py")],
+            capture_output=True, text=True, env=env,
+        )
+        wall = time.perf_counter() - t0
+        rec = None
+        for line in reversed((proc.stdout or "").strip().splitlines()):
+            try:
+                rec = json.loads(line)
+                break
+            except ValueError:
+                continue
+        if rec is None or proc.returncode != 0:
+            raise SystemExit(
+                f"run {i}: rc={proc.returncode}, no JSON record\n"
+                f"{(proc.stderr or '')[-2000:]}"
+            )
+        print(f"[repeat] run {i}: value={rec.get('value')} "
+              f"({wall:.1f}s incl. interpreter)", flush=True)
+        runs.append(rec)
+    values = [float(r["value"]) for r in runs]
+    med = statistics.median(values)
+    out = {
+        "metric": f"{config} {runs[0].get('metric', 'bench')} — "
+                  f"median of {n_runs} sequential runs (BASELINE.md "
+                  "measurement policy, round 6)",
+        "value": round(med, 3),
+        "unit": runs[0].get("unit", "seconds"),
+        "vs_baseline": runs[0].get("vs_baseline"),
+        "extra": {
+            "policy": "median-of-n; per-run values and spread committed",
+            "n_runs": n_runs,
+            "values": [round(v, 3) for v in values],
+            "spread_s": round(max(values) - min(values), 3),
+            "min": round(min(values), 3),
+            "max": round(max(values), 3),
+            "stdev": round(statistics.stdev(values), 3) if n_runs > 1 else 0.0,
+            "runs": runs,
+        },
+    }
+    path = os.path.join(base, f"SCALE_r06_cpu_{config}_repeats.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: out[k] for k in ("metric", "value", "unit")}
+                     | {"spread_s": out["extra"]["spread_s"]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,20 +1,25 @@
-"""1M-cell sparse-in FULL-pipeline proof (VERDICT r4 #5).
+"""1M-cell sparse-in FULL-pipeline proof (VERDICT r4 #5; r6 refresh).
 
 The brain1m bench config times the clustering tail only (pooled
 distance+linkage+cut+silhouette on an embedding). This runner exercises the
 never-densify contract (SURVEY.md §2b N12) at its design scale through the
 WHOLE product pipeline: sparse CSR 1M×G expression matrix → consensus →
-all-pairs DE (chunked sparse path) → union → PCA embed → pooled Ward →
-dynamic cuts → NODG — the path the reference densifies at
-R/reclusterDEConsensus.R:32 and must never be densified here.
+all-pairs DE (CSR-compacted window ladder, r6) → union → PCA embed →
+pooled Ward → dynamic cuts → pooled silhouette estimator → NODG — the
+path the reference densifies at R/reclusterDEConsensus.R:32 and must
+never be densified here. r6 changes vs the r5 artifact: the rank-sum
+ladder sorts ~nnz-wide CSR-compacted windows instead of full-N rows, and
+silhouette is REPORTED (pooled estimator) instead of skipped.
 
 The matrix is generated DIRECTLY in CSR form (per-gene nonzero draws;
 no dense intermediate at any point). Evidence artifact:
-SCALE_r05_cpu_<cells//1000>k_fullpipe_sparse.json (the 1M run writes
-SCALE_r05_cpu_1000k_fullpipe_sparse.json) with the stage dict, peak RSS,
-and the dense-equivalent size it never allocated.
+SCALE_r06_cpu_<cells//1000>k_fullpipe_sparse.json with the stage dict,
+peak RSS, and the dense-equivalent size it never allocated. With
+SCC_WILCOX_PROBE=1 the run is a synced occupancy DIAGNOSIS (per-bucket
+walls serialize dispatch) and additionally writes
+PROFILE_r06_wilcox_1m.json with the full window-ladder occupancy record.
 
-Run:  python tools/run_sparse_1m.py           (CPU, ~1-2 h on one core)
+Run:  python tools/run_sparse_1m.py           (CPU, ~30-60 min)
 Env:  SCC_1M_CELLS / SCC_1M_GENES override the shape (testing).
 """
 
@@ -108,29 +113,42 @@ def main() -> None:
     print(f"[1m] consensus: {len(set(consensus))} labels in "
           f"{consensus_s:.1f}s", flush=True)
 
-    # silhouette at 1M is O(N²) — out of scope for this proof (the brain1m
-    # config prices the clustering tail separately); everything else runs.
+    # r6: silhouette runs (pooled estimator reusing the tree stage's pool;
+    # the exact O(N²) path is only taken below approx_threshold)
     t0 = time.perf_counter()
     res = recluster_de_consensus_fast(
         mat, consensus,
         q_val_thrs=0.05,
         approx_threshold=50_000,           # force the pooled tree path
-        compat=CompatFlags(return_silhouette=False),
+        compat=CompatFlags(),
         mesh=None,
     )
     refine_s = time.perf_counter() - t0
 
+    stage_recs = res.metrics.get("stages", [])
     stages = {
         s["stage"]: round(s["wall_s"], 3)
-        for s in res.metrics.get("stages", [])
+        for s in stage_recs
         if "wall_s" in s
     }
+    occupancy = next(
+        (s["occupancy"] for s in stage_recs
+         if s.get("stage") == "wilcox_test" and "occupancy" in s), None
+    )
+    probed = bool(os.environ.get("SCC_WILCOX_PROBE"))
     peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
     dense_gb = n_cells * n_genes * 4 / 1e9
+    sil = [
+        {k: d[k] for k in ("deep_split", "silhouette", "silhouette_method")
+         if k in d}
+        for d in res.deep_split_info
+    ]
     record = {
         "metric": f"{n_cells//1000}k-cell sparse-in FULL pipeline "
-                  "(consensus+DE+union+embed+pooled recluster+nodg) "
-                  "wall-clock",
+                  "(consensus+DE+union+embed+pooled recluster"
+                  "+pooled silhouette+nodg) wall-clock"
+                  + (" PROBED (per-bucket syncs serialize dispatch)"
+                     if probed else ""),
         "value": round(refine_s + consensus_s, 3),
         "unit": "seconds",
         "vs_baseline": None,  # no reference number exists (BASELINE.md)
@@ -146,14 +164,30 @@ def main() -> None:
             "peak_rss_gb": round(peak_rss_gb, 2),
             "dense_equivalent_gb": round(dense_gb, 1),
             "never_densified": bool(peak_rss_gb < dense_gb),
-            "silhouette": "skipped (O(N^2); priced separately by brain1m)",
+            "silhouette": sil,
             "total_wall_s": round(time.perf_counter() - t_all, 1),
         },
     }
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
     out = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "..",
-        f"SCALE_r05_cpu_{n_cells//1000}k_fullpipe_sparse.json",
+        base, f"SCALE_r06_cpu_{n_cells//1000}k_fullpipe_sparse.json"
     )
+    if probed:
+        # a probed wall is a diagnosis, not a benchmark: route the full
+        # occupancy record to the PROFILE artifact and leave the SCALE
+        # artifact to an unprobed run
+        out = os.path.join(
+            base, f"PROFILE_r06_wilcox_{n_cells//1000 // 1000}m.json"
+            if n_cells >= 1_000_000
+            else f"PROFILE_r06_wilcox_{n_cells//1000}k.json"
+        )
+        record["extra"]["occupancy"] = occupancy
+    elif occupancy is not None:
+        # unprobed runs still carry the cheap (unsynced) bucket shape stats
+        record["extra"]["occupancy_buckets"] = occupancy.get("buckets")
+        record["extra"]["occupancy_meta"] = {
+            k: v for k, v in occupancy.items() if k != "buckets"
+        }
     with open(out, "w") as f:
         json.dump(record, f, indent=1)
     print(json.dumps(record), flush=True)
