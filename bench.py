@@ -55,6 +55,13 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# The one versioned record shape every emitter in this repo shares
+# (obs.export; summarize_evidence validates the version on ingest). Light
+# import: obs.export never imports jax, so the orchestrator process stays
+# backend-free.
+from scconsensus_tpu.config import env_flag  # noqa: E402
+from scconsensus_tpu.obs.export import build_run_record  # noqa: E402
+
 BASELINE_SECONDS = 30.0
 
 
@@ -92,7 +99,7 @@ ATTEMPT_PLANS = {
 }
 # test hook: scales every attempt timeout (e.g. 0.01 to exercise the
 # timeout/fallback path without waiting out real windows)
-_TIMEOUT_SCALE = float(os.environ.get("SCC_BENCH_TIMEOUT_SCALE", "1"))
+_TIMEOUT_SCALE = float(env_flag("SCC_BENCH_TIMEOUT_SCALE"))
 
 
 def log(msg: str) -> None:
@@ -105,14 +112,21 @@ _MAX_FAILURES = 3
 
 
 def _trim_line(parsed: dict) -> str:
-    """Serialize the final record, dropping the least important extras until
+    """Serialize the final record, dropping the least important parts until
     the line fits a driver that only sees the last ~2 KB of output.
-    Operates on a copy: callers re-emit cumulative records."""
-    parsed = json.loads(json.dumps(parsed))
-    drop_order = ("prior_failures", "pallas_vs_xla", "mfu",
-                  "edger_error", "wilcox_error", "wilcox_stages",
-                  "edger_stages", "best_partial", "failures")
+    Operates on a copy: callers re-emit cumulative records; the untrimmed
+    record (full span tree included) lives in the checkpoint file."""
+    parsed = json.loads(json.dumps(parsed, default=str))
     line = json.dumps(parsed)
+    # spans first: the tree is the biggest block and belongs in the
+    # checkpoint/evidence file, not the stdout tail
+    if len(line) > 1500 and parsed.get("spans"):
+        parsed["spans"] = []
+        parsed.setdefault("extra", {})["truncated"] = True
+        line = json.dumps(parsed)
+    drop_order = ("wilcox_occupancy", "prior_failures", "pallas_vs_xla",
+                  "mfu", "edger_error", "wilcox_error", "wilcox_stages",
+                  "edger_stages", "best_partial", "failures")
     for key in drop_order:
         if len(line) <= 1500:
             break
@@ -129,10 +143,10 @@ def _trim_line(parsed: dict) -> str:
 def _ckpt_path() -> str:
     """Per-config checkpoint path, so quick-config test runs can never
     clobber flagship TPU evidence."""
-    override = os.environ.get("SCC_BENCH_CKPT")
+    override = env_flag("SCC_BENCH_CKPT")
     if override:
         return override
-    name = os.environ.get("SCC_BENCH_CONFIG", "flagship")
+    name = env_flag("SCC_BENCH_CONFIG")
     here = os.path.dirname(os.path.abspath(__file__))
     return os.path.join(here, f"BENCH_CHECKPOINT_{name}.json")
 
@@ -197,7 +211,7 @@ def _section(extra: dict, name: str, fn):
     """Run one flagship section; on failure record a truncated error and
     keep going (VERDICT r2 #3: sections must not couple). Returns the
     section's value or None."""
-    if os.environ.get("SCC_BENCH_CRASH") == name:
+    if env_flag("SCC_BENCH_CRASH") == name:
         extra[f"{name}_error"] = "injected crash (SCC_BENCH_CRASH)"
         log(f"[bench] section '{name}': injected crash")
         return None
@@ -237,9 +251,9 @@ def _device_gen() -> bool:
     flagship scale plus a ~1.5 GB upload — over the remote-TPU tunnel the
     upload alone can outlast a tunnel window, which is how round 3's
     capture died. On-device gen moves only KBs."""
-    if _DEVICE_GEN_BROKEN or os.environ.get("SCC_BENCH_HOST_GEN"):
+    if _DEVICE_GEN_BROKEN or env_flag("SCC_BENCH_HOST_GEN"):
         return False
-    if os.environ.get("SCC_BENCH_DEVICE_GEN"):
+    if env_flag("SCC_BENCH_DEVICE_GEN"):
         return True
     import jax
 
@@ -366,15 +380,23 @@ def run_brain1m(n_cells=1_000_000, n_pcs=15, n_clusters=24):
         )
 
     def once():
+        from scconsensus_tpu.obs.trace import Tracer
+
+        tracer = Tracer()
         t0 = time.perf_counter()
-        tree, assign, cents = pooled_ward_linkage(x, n_centroids=4096, seed=1)
-        cut = cutree_hybrid(tree, cents, deep_split=1, min_cluster_size=2)
-        cells = cut[assign]
-        sub = rng.choice(n_cells, size=50_000, replace=False)  # SI on a sample
-        si, _ = mean_cluster_silhouette(x[sub], cells[sub])
+        with tracer.span("pooled_ward", n_cells=n_cells):
+            tree, assign, cents = pooled_ward_linkage(
+                x, n_centroids=4096, seed=1
+            )
+        with tracer.span("cut"):
+            cut = cutree_hybrid(tree, cents, deep_split=1, min_cluster_size=2)
+            cells = cut[assign]
+        with tracer.span("silhouette"):
+            sub = rng.choice(n_cells, size=50_000, replace=False)  # sampled
+            si, _ = mean_cluster_silhouette(x[sub], cells[sub])
         dt = time.perf_counter() - t0
         return dt, {"clusters": len(set(cells[cells > 0].tolist())),
-                    "silhouette": round(si, 3)}
+                    "silhouette": round(si, 3)}, tracer.span_records()
 
     return once
 
@@ -603,23 +625,23 @@ DEGRADED = {
 def worker() -> None:
     # test hook: simulate a hung backend init (worker dies having written
     # nothing, so recovery must come from a prior checkpoint)
-    hang = float(os.environ.get("SCC_BENCH_HANG", "0"))
+    hang = float(env_flag("SCC_BENCH_HANG"))
     if hang:
         time.sleep(hang)
 
     import jax
 
-    plat = os.environ.get("SCC_BENCH_PLATFORM")
+    plat = env_flag("SCC_BENCH_PLATFORM")
     if plat:
         jax.config.update("jax_platforms", plat)
     jax.config.update(
         "jax_compilation_cache_dir",
-        os.environ.get("SCC_JAX_CACHE_DIR", _JAX_CACHE_DIR),
+        env_flag("SCC_JAX_CACHE_DIR") or _JAX_CACHE_DIR,
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
-    name = os.environ.get("SCC_BENCH_CONFIG", "flagship")
-    degraded = bool(os.environ.get("SCC_BENCH_DEGRADED"))
+    name = env_flag("SCC_BENCH_CONFIG")
+    degraded = bool(env_flag("SCC_BENCH_DEGRADED"))
     cfg = dict(CONFIGS[name])
     if degraded and name in DEGRADED:
         cfg.update(DEGRADED[name])
@@ -644,33 +666,36 @@ def worker() -> None:
             # metric string says exactly what ran (VERDICT r4 weak #5).
             reduced = extra.get("degraded") or extra.get("size_reduced")
             cold = b1m_state.get("phase") == "cold"
-            return {
-                "metric": f"{bn // 1000}k-cell pooled distance+linkage+cut+"
-                          "silhouette throughput (clustering tail only)"
-                          + (" COLD (incl. XLA compiles)" if cold else ""),
-                "value": round(bn / secs) if secs else -1.0,
-                "unit": "cells/sec",
-                "vs_baseline": (round((bn / secs) / (1_000_000 / 300.0), 3)
-                                if secs and not reduced else None),
-                "extra": extra,
-            }
+            return build_run_record(
+                metric=f"{bn // 1000}k-cell pooled distance+linkage+cut+"
+                       "silhouette throughput (clustering tail only)"
+                       + (" COLD (incl. XLA compiles)" if cold else ""),
+                value=round(bn / secs) if secs else -1.0,
+                unit="cells/sec",
+                vs_baseline=(round((bn / secs) / (1_000_000 / 300.0), 3)
+                             if secs and not reduced else None),
+                extra=extra,
+                spans=b1m_state.get("spans") or [],
+            )
 
-        b1m_state = {"secs": None, "phase": "cold"}
+        b1m_state = {"secs": None, "phase": "cold", "spans": None}
         _install_term_handler(lambda: _b1m_record(b1m_state["secs"]))
         once = run_brain1m(n_cells=bn)
-        cold_s, cold_info = once()
+        cold_s, cold_info, cold_spans = once()
         log(f"[bench] cold run: {cold_s:.2f}s {cold_info}")
         extra["cold_s"] = round(cold_s, 3)
         b1m_state["secs"] = cold_s
+        b1m_state["spans"] = cold_spans
         extra.update(cold_info)
-        if os.environ.get("SCC_BENCH_COLD"):
+        if env_flag("SCC_BENCH_COLD"):
             elapsed, info = cold_s, cold_info
         else:
             _emit_partial(_b1m_record(cold_s))
-            elapsed, info = once()
+            elapsed, info, steady_spans = once()
             # secs BEFORE phase: a SIGTERM between the two must not emit
             # the cold number under a steady-labeled metric
             b1m_state["secs"] = elapsed
+            b1m_state["spans"] = steady_spans
             b1m_state["phase"] = "steady"
         log(f"[bench] steady: {elapsed:.2f}s {info}")
         extra.update(info)
@@ -680,10 +705,10 @@ def worker() -> None:
         return
 
     if name == "flagship":  # env overrides for ad-hoc scaling runs
-        cfg["n_cells"] = int(os.environ.get("SCC_BENCH_CELLS", cfg["n_cells"]))
-        cfg["n_genes"] = int(os.environ.get("SCC_BENCH_GENES", cfg["n_genes"]))
+        cfg["n_cells"] = int(env_flag("SCC_BENCH_CELLS") or cfg["n_cells"])
+        cfg["n_genes"] = int(env_flag("SCC_BENCH_GENES") or cfg["n_genes"])
         cfg["n_clusters"] = int(
-            os.environ.get("SCC_BENCH_CLUSTERS", cfg["n_clusters"])
+            env_flag("SCC_BENCH_CLUSTERS") or cfg["n_clusters"]
         )
     refine_kw = cfg.pop("refine_kw", {})
     log(f"[bench] generating synthetic data: {cfg}")
@@ -699,7 +724,7 @@ def worker() -> None:
     if kind == "flagship":
         n_cells = cfg["n_cells"]
         size = f"{n_cells // 1000}k" if n_cells >= 1000 else str(n_cells)
-        state = {"edger": None, "wilcox": None}
+        state = {"edger": None, "wilcox": None, "spans": None}
 
         def _record():
             """Cumulative flagship record from whatever has finished."""
@@ -733,8 +758,11 @@ def worker() -> None:
                 metric = f"{size}-cell flagship: no section finished (see extra)"
                 value = -1.0
                 vsb = None
-            return {"metric": metric, "value": value, "unit": "seconds",
-                    "vs_baseline": vsb, "extra": extra}
+            return build_run_record(
+                metric=metric, value=value, unit="seconds",
+                vs_baseline=vsb, extra=extra,
+                spans=state.get("spans") or [],
+            )
 
         def _ckpt():
             _emit_partial(_record())
@@ -752,18 +780,26 @@ def worker() -> None:
         # headline: the literal north-star workload — slow-path edgeR
         def _edger():
             once_edger = run_refine_config(**cfg, method="edgeR", **refine_kw)
-            cold_s, _ = once_edger()
+            cold_s, cold_res = once_edger()
             log(f"[bench] edgeR cold (incl. XLA compiles): {cold_s:.2f}s")
             extra["edger_cold_s"] = round(cold_s, 3)
-            if os.environ.get("SCC_BENCH_COLD"):
+            # cold spans so a COLD record (or a SIGTERM before steady-state
+            # lands) still carries a span tree; steady overwrites below.
+            # Keep only the spans — the full cold result must not stay
+            # resident through the measured steady run
+            state["spans"] = cold_res.metrics.get("spans")
+            del cold_res
+            if env_flag("SCC_BENCH_COLD"):
                 return cold_s
             _ckpt()  # the cold number survives even if steady-state dies
-            if os.environ.get("SCC_BENCH_CRASH") == "edger_steady":
+            if env_flag("SCC_BENCH_CRASH") == "edger_steady":
                 raise RuntimeError("injected crash (SCC_BENCH_CRASH)")
             elapsed, result = once_edger()
             log(f"[bench] edgeR steady-state: {elapsed:.2f}s")
             extra["edger_stages"] = _stage_dict(result)
             extra["union_size"] = int(result.de_gene_union_idx.size)
+            # the headline workload's span tree rides the run record
+            state["spans"] = result.metrics.get("spans") or state["spans"]
             return elapsed
 
         state["edger"] = _section(extra, "edger", _edger)
@@ -779,6 +815,16 @@ def worker() -> None:
             log(f"[bench] wilcox fast-path steady-state: {fast_s:.2f}s")
             extra["wilcox_s"] = round(fast_s, 3)
             extra["wilcox_stages"] = _stage_dict(fast_res)
+            # the migrated occupancy metrics (window ladder) ride the
+            # flagship record too, not just the refine configs
+            occ = next(
+                (s["occupancy"] for s in fast_res.metrics.get("stages", [])
+                 if "occupancy" in s), None,
+            )
+            if occ is not None:
+                extra["wilcox_occupancy"] = occ
+            if not state["spans"]:  # edgeR section died: wilcox spans stand in
+                state["spans"] = fast_res.metrics.get("spans")
             return fast_s
 
         state["wilcox"] = _section(extra, "wilcox", _wilcox)
@@ -790,7 +836,7 @@ def worker() -> None:
             if mfu is not None:
                 extra["mfu"] = mfu
             _ckpt()
-        if platform == "tpu" or os.environ.get("SCC_BENCH_PALLAS"):
+        if platform == "tpu" or env_flag("SCC_BENCH_PALLAS"):
             pv = _section(extra, "pallas", pallas_vs_xla_probe)
             if pv is not None:
                 extra["pallas_vs_xla"] = pv
@@ -804,25 +850,29 @@ def worker() -> None:
 
     def _refine_record(secs):
         cold = refine_state.get("phase") == "cold"
-        return {
-            "metric": (
+        return build_run_record(
+            metric=(
                 f"{n_cells // 1000}k" if n_cells >= 1000 else str(n_cells)
             ) + f"-cell end-to-end consensus+recluster wall-clock ({name})"
             + (" COLD (incl. XLA compiles)" if cold else ""),
-            "value": round(secs, 3) if secs else -1.0,
-            "unit": "seconds",
-            "vs_baseline": _vsb(secs, extra),
-            "extra": extra,
-        }
+            value=round(secs, 3) if secs else -1.0,
+            unit="seconds",
+            vs_baseline=_vsb(secs, extra),
+            extra=extra,
+            spans=refine_state.get("spans") or [],
+        )
 
-    refine_state = {"secs": None, "phase": "cold"}
+    refine_state = {"secs": None, "phase": "cold", "spans": None}
     _install_term_handler(lambda: _refine_record(refine_state["secs"]))
     once = run_refine_config(**cfg, **refine_kw)
-    cold_s, _ = once()
+    cold_s, cold_res = once()
     log(f"[bench] cold run (includes XLA compiles): {cold_s:.2f}s")
     extra["cold_s"] = round(cold_s, 3)
     refine_state["secs"] = cold_s
-    if os.environ.get("SCC_BENCH_COLD"):
+    # spans only; drop the cold result before the measured steady run
+    refine_state["spans"] = cold_res.metrics.get("spans")
+    del cold_res
+    if env_flag("SCC_BENCH_COLD"):
         elapsed = cold_s
     else:
         _emit_partial(_refine_record(cold_s))
@@ -830,6 +880,7 @@ def worker() -> None:
         # secs BEFORE phase: a SIGTERM between the two must not emit the
         # cold number under a steady-labeled metric
         refine_state["secs"] = elapsed
+        refine_state["spans"] = result.metrics.get("spans")
         refine_state["phase"] = "steady"
         log(f"[bench] steady-state run: {elapsed:.2f}s; union="
             f"{result.de_gene_union_idx.size} genes; "
@@ -1005,7 +1056,7 @@ def _run_attempt(label: str, env_over: dict, timeout_s: int):
                 target=_drain, args=(proc.stdout,), daemon=True
             )
             reader.start()
-            stall_s = float(os.environ.get("SCC_BENCH_STALL_S", "1200"))
+            stall_s = float(env_flag("SCC_BENCH_STALL_S"))
             deadline = t0 + timeout_s
             outcome = None
             err_size = [0]
@@ -1149,9 +1200,10 @@ def _orchestrator_term_handler(t_start: float):
                     proc.kill()
             rec = _read_ckpt(t_start)
             if rec is None:
-                rec = {"metric": "bench terminated before any checkpoint",
-                       "value": -1, "unit": "seconds", "vs_baseline": None,
-                       "extra": {"terminated": True}}
+                rec = build_run_record(
+                    metric="bench terminated before any checkpoint",
+                    value=-1, extra={"terminated": True},
+                )
             rec.setdefault("extra", {})["partial"] = True
             rec["extra"]["terminated"] = True
             print(_trim_line(rec), flush=True)
@@ -1172,12 +1224,12 @@ def main() -> None:
     if "--quick" in args:
         os.environ.setdefault("SCC_BENCH_CONFIG", "quick")
         plan = ATTEMPT_PLANS["quick"]
-    elif os.environ.get("SCC_BENCH_PLATFORM") == "cpu":
+    elif env_flag("SCC_BENCH_PLATFORM") == "cpu":
         # caller already pinned CPU: a single bounded attempt, no fallback
         plan = [("cpu", {}, 2400)]
     else:
         plan = ATTEMPT_PLANS["default"]
-    if os.environ.get("SCC_BENCH_NO_FORK"):
+    if env_flag("SCC_BENCH_NO_FORK"):
         worker()
         return
 
@@ -1189,7 +1241,7 @@ def main() -> None:
         """An attempt is CPU-bound if its override pins CPU — or if the
         ambient env does and the override doesn't reclaim it."""
         return env_over.get(
-            "SCC_BENCH_PLATFORM", os.environ.get("SCC_BENCH_PLATFORM")
+            "SCC_BENCH_PLATFORM", env_flag("SCC_BENCH_PLATFORM")
         ) == "cpu"
 
     def _probe_disqualified(p: str, no_cpu_mode: bool) -> bool:
@@ -1201,18 +1253,17 @@ def main() -> None:
     # SCC_BENCH_NO_CPU_FALLBACK=1: an accelerator-evidence run (the tunnel
     # watcher) — a CPU-degraded record must never overwrite TPU evidence,
     # so a dead tunnel fails fast instead of rerouting to CPU.
-    no_cpu = bool(os.environ.get("SCC_BENCH_NO_CPU_FALLBACK"))
+    no_cpu = bool(env_flag("SCC_BENCH_NO_CPU_FALLBACK"))
     if no_cpu:
         # an attempt is CPU-bound if its override pins CPU — or if the
         # ambient env does and the override doesn't reclaim it
         plan = [(l, e, t) for l, e, t in plan if not _is_cpu_attempt(e)]
         if not plan:  # e.g. --quick, whose only attempt is CPU-pinned
-            print(json.dumps({
-                "metric": "no accelerator attempt in plan "
-                          "(no-cpu-fallback mode)",
-                "value": -1, "unit": "seconds", "vs_baseline": None,
-                "extra": {},
-            }))
+            print(json.dumps(build_run_record(
+                metric="no accelerator attempt in plan "
+                       "(no-cpu-fallback mode)",
+                value=-1,
+            )))
             return
     if plan is ATTEMPT_PLANS["default"] or no_cpu:
         probe = _probe_backend()
@@ -1221,11 +1272,11 @@ def main() -> None:
         # CPU backend: the run exists to produce accelerator evidence.
         if _probe_disqualified(probe, no_cpu):
             if no_cpu:
-                print(json.dumps({
-                    "metric": "backend probe failed (no-cpu-fallback mode)",
-                    "value": -1, "unit": "seconds", "vs_baseline": None,
-                    "extra": {"backend_probe": probe},
-                }))
+                print(json.dumps(build_run_record(
+                    metric="backend probe failed (no-cpu-fallback mode)",
+                    value=-1,
+                    extra={"backend_probe": probe},
+                )))
                 return
             # tunnel down: don't burn the primary/retry windows on a hung
             # backend init — go straight to the bounded CPU fallback
@@ -1270,11 +1321,14 @@ def main() -> None:
             # The stdout line `parsed` came from may already be trimmed
             # (the worker trims for the tail window); the worker's final
             # on-disk checkpoint is untrimmed. Merge so the evidence file
-            # keeps the full extras (mfu/stages) plus orchestrator stamps.
+            # keeps the full extras (mfu/stages) AND the span tree plus
+            # orchestrator stamps.
             disk = _read_ckpt(t_start)
             if disk is not None and disk.get("value") == parsed.get("value"):
                 parsed["extra"] = {**disk.get("extra", {}),
                                    **parsed.get("extra", {})}
+                if not parsed.get("spans") and disk.get("spans"):
+                    parsed["spans"] = disk["spans"]
             _write_ckpt(parsed)
             print(_trim_line(parsed))
             return
@@ -1284,13 +1338,11 @@ def main() -> None:
     # Every attempt failed. If any attempt left a value<=0 partial, surface
     # the freshest checkpoint's extras (platform, cold numbers) in the
     # failure record; then emit a structured line, never a traceback.
-    rec = {
-        "metric": "bench failed on every attempt (see extra.failures)",
-        "value": -1,
-        "unit": "seconds",
-        "vs_baseline": None,
-        "extra": {"failures": failures[-_MAX_FAILURES:]},
-    }
+    rec = build_run_record(
+        metric="bench failed on every attempt (see extra.failures)",
+        value=-1,
+        extra={"failures": failures[-_MAX_FAILURES:]},
+    )
     if probe is not None:
         rec["extra"]["backend_probe"] = probe
     best = _read_ckpt(t_start)

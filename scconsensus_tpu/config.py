@@ -10,7 +10,135 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Optional, Sequence, Tuple
+import os
+from typing import Any, Dict, Mapping, Optional, Sequence, Tuple
+
+
+# --------------------------------------------------------------------------
+# SCC_* environment-flag registry
+# --------------------------------------------------------------------------
+# Every SCC_ env flag the package (and the bench/tools emitters) reads, in
+# one place: name, type, default, one-line doc. Reads go through
+# ``env_flag()`` so a typo'd or undeclared flag fails loudly instead of
+# silently doing nothing; tests/test_env_registry.py greps the source tree
+# and fails on any SCC_ literal not registered here.
+#
+# Bool parsing: unset/""/"0"/"false"/"off"/"no" → False, anything else →
+# True ("SCC_STAGE_SYNC=0" now disables, where the old bare
+# ``bool(os.environ.get(...))`` read any nonempty string as truthy).
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvFlag:
+    name: str
+    type: type
+    default: Any
+    doc: str
+
+
+_FALSY = ("", "0", "false", "off", "no", "none")
+
+ENV_FLAGS: Dict[str, EnvFlag] = {
+    f.name: f
+    for f in [
+        # --- observability (obs/) ---
+        EnvFlag("SCC_TRACE_SYNC", str, "stage",
+                "Tracer device-sync policy: 'stage' (drain at stage-span "
+                "boundaries; default), 'all' (every span — diagnosis runs), "
+                "'off' (dispatch intervals, the pre-obs behavior)."),
+        EnvFlag("SCC_STAGE_SYNC", bool, False,
+                "Legacy alias: force at least stage-boundary drains even "
+                "when SCC_TRACE_SYNC=off."),
+        EnvFlag("SCC_TRACE_DIR", str, None,
+                "If set, refine() exports <dir>/run_record.json + "
+                "<dir>/trace.json (Chrome trace events; open in Perfetto) "
+                "at the end of every pipeline run."),
+        EnvFlag("SCC_OBS_TRANSFERS", bool, False,
+                "Wrap refine() in obs.device.TransferWatch: count explicit "
+                "host<->device transfer bytes and flag oversized host "
+                "fetches on the run record."),
+        # --- DE engine ---
+        EnvFlag("SCC_WILCOX_PROBE", bool, False,
+                "Synced per-bucket occupancy DIAGNOSIS of the Wilcoxon "
+                "window ladder (serializes dispatch; tied-run counts and a "
+                "sort-only timing are fetched per bucket)."),
+        EnvFlag("SCC_NO_RUNSPACE", bool, False,
+                "Disable the CPU tied-run rank-sum kernel; pin the scan "
+                "kernel on every backend (mesh-overhead comparisons)."),
+        EnvFlag("SCC_EDGER_PROFILE", bool, False,
+                "Per-phase synced wall-clocks for the NB/edgeR driver."),
+        # --- bench.py harness ---
+        EnvFlag("SCC_BENCH_CONFIG", str, "flagship",
+                "Bench config: flagship|pbmc68k|cite8k|tm100k|brain1m|quick."),
+        EnvFlag("SCC_BENCH_PLATFORM", str, None,
+                "Pin the jax platform for bench runs (cpu|tpu)."),
+        EnvFlag("SCC_BENCH_DEGRADED", bool, False,
+                "Run the reduced-size CPU fallback shapes."),
+        EnvFlag("SCC_BENCH_COLD", bool, False,
+                "Report the cold-compile run instead of steady-state."),
+        EnvFlag("SCC_BENCH_CELLS", int, None,
+                "Override flagship n_cells."),
+        EnvFlag("SCC_BENCH_GENES", int, None,
+                "Override flagship n_genes."),
+        EnvFlag("SCC_BENCH_CLUSTERS", int, None,
+                "Override flagship n_clusters."),
+        EnvFlag("SCC_BENCH_NO_FORK", bool, False,
+                "Run the measurement in-process (no orchestrator)."),
+        EnvFlag("SCC_BENCH_CRASH", str, None,
+                "Inject a failure into one flagship section "
+                "(edger|edger_steady|wilcox|mfu|pallas) — tests the "
+                "partial-result contract."),
+        EnvFlag("SCC_BENCH_TIMEOUT_SCALE", float, 1.0,
+                "Scale every orchestrator attempt timeout (test hook)."),
+        EnvFlag("SCC_BENCH_HANG", float, 0.0,
+                "Worker sleeps this long before doing anything (test hook "
+                "for the stall watchdog)."),
+        EnvFlag("SCC_BENCH_STALL_S", float, 1200.0,
+                "Abort an attempt after this long without worker progress."),
+        EnvFlag("SCC_BENCH_HOST_GEN", bool, False,
+                "Opt out of on-device synthetic data generation."),
+        EnvFlag("SCC_BENCH_DEVICE_GEN", bool, False,
+                "Force on-device synthetic data generation everywhere."),
+        EnvFlag("SCC_BENCH_PALLAS", bool, False,
+                "Run the pallas-vs-xla probe off-TPU too."),
+        EnvFlag("SCC_BENCH_NO_CPU_FALLBACK", bool, False,
+                "Accelerator-evidence mode: fail fast instead of rerouting "
+                "to the CPU-degraded attempt."),
+        EnvFlag("SCC_BENCH_CKPT", str, None,
+                "Override the bench checkpoint file path."),
+        EnvFlag("SCC_JAX_CACHE_DIR", str, None,
+                "Override the persistent XLA compile-cache dir."),
+        # --- tools/ ---
+        EnvFlag("SCC_1M_CELLS", int, 1_000_000,
+                "run_sparse_1m.py: cell count override (testing)."),
+        EnvFlag("SCC_1M_GENES", int, 3000,
+                "run_sparse_1m.py: gene count override (testing)."),
+        EnvFlag("SCC_1M_PLATFORM", str, "cpu",
+                "run_sparse_1m.py: jax platform for the run."),
+        EnvFlag("SCC_WATCHER_DEADLINE", float, 0.0,
+                "tpu_capture_watcher.sh: epoch-seconds deadline (0 = none)."),
+        # --- tests ---
+        EnvFlag("SCC_TEST_TPU", bool, False,
+                "Run the test suite against the real chip instead of the "
+                "CPU-pinned default."),
+    ]
+}
+
+
+def env_flag(name: str, env: Optional[Mapping[str, str]] = None) -> Any:
+    """Typed read of a registered SCC_* flag (KeyError on unregistered
+    names — register in ENV_FLAGS first). Unset flags return the
+    registered default; reads are dynamic (no import-time caching), so
+    tests can monkeypatch the environment."""
+    spec = ENV_FLAGS[name]
+    raw = (os.environ if env is None else env).get(name)
+    if raw is None:
+        return spec.default
+    if spec.type is bool:
+        return raw.strip().lower() not in _FALSY
+    if spec.type in (int, float):
+        return spec.type(raw)
+    return raw
 
 
 @dataclasses.dataclass

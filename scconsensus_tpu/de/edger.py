@@ -65,7 +65,6 @@ reproduces that literal arithmetic; fixed mode tests on expm1(data).
 from __future__ import annotations
 
 import dataclasses
-import os
 import time
 from functools import partial
 from typing import List, Tuple
@@ -112,20 +111,32 @@ class EdgerPairResult:
 
 
 class _PhaseProfiler:
-    """SCC_EDGER_PROFILE=1: per-phase wall-clocks for the NB driver, with a
-    device sync at each boundary (so async dispatch can't smear phases).
+    """SCC_EDGER_PROFILE=1 (env-flag registry, config.py): per-phase
+    wall-clocks for the NB driver, with a device sync at each boundary (so
+    async dispatch can't smear phases). Phase walls additionally land as
+    gauges on the ambient tracer span (the edger_nb stage), so a profiled
+    bench run carries them in its run record, not just on stderr.
     Zero overhead when disabled — no syncs, no timing."""
 
     def __init__(self) -> None:
-        self.enabled = bool(os.environ.get("SCC_EDGER_PROFILE"))
+        from scconsensus_tpu.config import env_flag
+
+        self.enabled = bool(env_flag("SCC_EDGER_PROFILE"))
         self._t = time.perf_counter() if self.enabled else 0.0
 
     def mark(self, label: str) -> None:
         if not self.enabled:
             return
-        (jax.device_put(0.0) + 0).block_until_ready()  # drain the queue
+        from scconsensus_tpu.obs.trace import device_drain
+
+        device_drain()  # phase boundary: retire the queued phase work
         now = time.perf_counter()
         print(f"[edger-profile] {label}: {now - self._t:.3f}s", flush=True)
+        from scconsensus_tpu.obs.trace import current_span
+
+        sp = current_span()
+        if sp is not None:
+            sp.metrics.gauge(f"phase_{label}_s").set(round(now - self._t, 4))
         self._t = now
 
 

@@ -428,17 +428,22 @@ def _run_wilcox_device(
     ~nnz — the lever the r5 1M artifact was missing (its sparse input
     bypassed the ladder entirely and paid 2765 s of full-width sorts).
 
-    ``probe_out``: optional dict (e.g. the wilcox stage's timer record) —
-    receives an ``occupancy`` sub-dict with per-bucket gene counts, window
-    widths, padded-vs-real element ratios, tied-run table heights and
-    overflow counts. With SCC_WILCOX_PROBE=1 each bucket is additionally
-    synced and walled (serializes dispatch — diagnosis runs only), and
-    tied-run counts + a separate sort-only timing are fetched per bucket
-    so sort cost is split out of the contraction attribution.
+    ``probe_out``: optional dict or Span (e.g. the wilcox stage's tracer
+    span) — receives an ``occupancy`` sub-dict with per-bucket gene
+    counts, window widths, padded-vs-real element ratios, tied-run table
+    heights and overflow counts. Each ladder bucket additionally runs
+    inside a ``wilcox_bucket`` child span carrying the same quantities as
+    first-class gauges (obs.metrics), with ladder-level histograms
+    aggregated onto the stage span. With SCC_WILCOX_PROBE=1 (env-flag
+    registry, config.py) each bucket is additionally synced and walled
+    (serializes dispatch — diagnosis runs only), and tied-run counts + a
+    separate sort-only timing are fetched per bucket so sort cost is
+    split out of the contraction attribution.
     """
-    import os
     import time
 
+    from scconsensus_tpu.config import env_flag
+    from scconsensus_tpu.obs import trace as obs_trace
     from scconsensus_tpu.io.sparsemat import csr_window_rows, is_sparse
     from scconsensus_tpu.ops.ranksum_allpairs import (
         _ALLPAIRS_ELEM_BUDGET,
@@ -470,7 +475,7 @@ def _run_wilcox_device(
     use_runspace = (
         mesh is None
         and jax.default_backend() == "cpu"
-        and not os.environ.get("SCC_NO_RUNSPACE")
+        and not env_flag("SCC_NO_RUNSPACE")
     )
     if mesh is not None:
         from scconsensus_tpu.parallel.sharded_de import sharded_allpairs_ranksum
@@ -499,7 +504,7 @@ def _run_wilcox_device(
             windowed = True
             src = "csr-compacted"
 
-    probe_on = bool(os.environ.get("SCC_WILCOX_PROBE"))
+    probe_on = bool(env_flag("SCC_WILCOX_PROBE"))
     probe: Optional[Dict] = None
     if probe_out is not None:
         probe = {
@@ -570,73 +575,93 @@ def _run_wilcox_device(
             # floor above)
             gcb_eff = min(gcb, _next_pow2(max(int(ids.size), 256)))
             t_bucket = time.perf_counter()
-            if compact:
-                vals, wcid = csr_window_rows(
-                    data, ids, w, cid, pad_rows=gcb_eff
-                )
-                rows = jnp.asarray(vals)
-                # the mesh path pads/uploads cid itself (int-preserving,
-                # sharded_de) — uploading here would round-trip it back
-                # to host first
-                kcid = wcid if mesh is not None else jnp.asarray(wcid)
-                weff = w  # compacted input ALWAYS runs the zero-block mode
-            else:
-                rows = jnp.take(jdata, jnp.asarray(ids), axis=0)
-                if ids.size < gcb_eff:
-                    rows = jnp.pad(rows, ((0, gcb_eff - ids.size), (0, 0)))
-                kcid = jcid
-                weff = w if w < N else 0
-            nr_b = None
-            if mesh is not None:
-                out = sharded_allpairs_ranksum(
-                    rows, kcid, jn, jpi, jpj, K, mesh=mesh, window=weff,
-                )
-            elif use_runspace:
-                lp_b, u_b, ts_b, nr_b = allpairs_ranksum_runspace_chunk(
-                    rows, kcid, jn, jpi, jpj, K, window=weff,
-                )
-                out = (lp_b, u_b, ts_b)
-                overflow.append((len(parts), ids, weff, nr_b))
-            else:
-                out = allpairs_ranksum_chunk(
-                    rows, kcid, jn, jpi, jpj, K, window=weff,
-                )
-            if probe is not None:
+            with obs_trace.span(
+                "wilcox_bucket", window=int(w), n_genes=int(ids.size),
+            ) as bspan:
+                if compact:
+                    vals, wcid = csr_window_rows(
+                        data, ids, w, cid, pad_rows=gcb_eff
+                    )
+                    rows = jnp.asarray(vals)
+                    # the mesh path pads/uploads cid itself (int-preserving,
+                    # sharded_de) — uploading here would round-trip it back
+                    # to host first
+                    kcid = wcid if mesh is not None else jnp.asarray(wcid)
+                    weff = w  # compacted input ALWAYS runs zero-block mode
+                else:
+                    rows = jnp.take(jdata, jnp.asarray(ids), axis=0)
+                    if ids.size < gcb_eff:
+                        rows = jnp.pad(
+                            rows, ((0, gcb_eff - ids.size), (0, 0))
+                        )
+                    kcid = jcid
+                    weff = w if w < N else 0
+                nr_b = None
+                if mesh is not None:
+                    out = sharded_allpairs_ranksum(
+                        rows, kcid, jn, jpi, jpj, K, mesh=mesh, window=weff,
+                    )
+                elif use_runspace:
+                    lp_b, u_b, ts_b, nr_b = allpairs_ranksum_runspace_chunk(
+                        rows, kcid, jn, jpi, jpj, K, window=weff,
+                    )
+                    out = (lp_b, u_b, ts_b)
+                    overflow.append((len(parts), ids, weff, nr_b))
+                else:
+                    out = allpairs_ranksum_chunk(
+                        rows, kcid, jn, jpi, jpj, K, window=weff,
+                    )
+                # the former SCC_WILCOX_PROBE payload, as first-class span
+                # metrics (always on — these are cheap host-side stats)
                 real = int(nnz_sorted[g0:g1].sum())
-                brec = {
-                    "window": int(w), "scan_width": int(scan_w),
-                    "sort_width": int(sort_w), "n_genes": int(ids.size),
-                    "padded_rows": int(gcb_eff),
-                    "real_elems": real,
-                    "padded_elems": int(gcb_eff) * int(scan_w),
-                    "pad_ratio": round(
-                        int(gcb_eff) * int(scan_w) / max(real, 1), 3
-                    ),
-                    "nnz_min": int(nnz_sorted[g0]),
-                    "nnz_max": int(nnz_sorted[g1 - 1]),
-                    "table_height": int(min(
-                        RUN_CAP, 1 << max(scan_w // 2 - 1, 1).bit_length()
-                    )) if use_runspace else None,
-                    "overflow_genes": 0,
-                }
-                if probe_on:
-                    jax.block_until_ready(out)
-                    brec["wall_s"] = round(time.perf_counter() - t_bucket, 4)
-                    # split the sort out of the contraction attribution:
-                    # time the same rows through a sort-only jit — warmed
-                    # untimed first, since every bucket shape is distinct
-                    # and a cold compile inside the timed region would
-                    # inflate every sort_s in the committed PROFILE
-                    jax.block_until_ready(sort_probe(rows, kcid))
-                    t_s = time.perf_counter()
-                    jax.block_until_ready(sort_probe(rows, kcid))
-                    brec["sort_s"] = round(time.perf_counter() - t_s, 4)
-                    if nr_b is not None:
-                        nr = np.asarray(jax.device_get(nr_b))[: ids.size]
-                        if nr.size:
-                            brec["tied_runs_p50"] = int(np.median(nr))
-                            brec["tied_runs_max"] = int(nr.max())
-                probe["buckets"].append(brec)
+                padded = int(gcb_eff) * int(scan_w)
+                pad_ratio = round(padded / max(real, 1), 3)
+                bm = bspan.metrics
+                bm.gauge("window").set(int(w))
+                bm.gauge("scan_width").set(int(scan_w))
+                bm.gauge("sort_width").set(int(sort_w))
+                bm.gauge("padded_rows").set(int(gcb_eff))
+                bm.gauge("pad_ratio").set(pad_ratio)
+                bm.gauge("nnz_min").set(int(nnz_sorted[g0]))
+                bm.gauge("nnz_max").set(int(nnz_sorted[g1 - 1]))
+                bm.counter("genes").add(int(ids.size))
+                bm.counter("real_elems").add(real)
+                bm.counter("padded_elems").add(padded)
+                if probe is not None:
+                    brec = {
+                        "window": int(w), "scan_width": int(scan_w),
+                        "sort_width": int(sort_w), "n_genes": int(ids.size),
+                        "padded_rows": int(gcb_eff),
+                        "real_elems": real,
+                        "padded_elems": padded,
+                        "pad_ratio": pad_ratio,
+                        "nnz_min": int(nnz_sorted[g0]),
+                        "nnz_max": int(nnz_sorted[g1 - 1]),
+                        "table_height": int(min(
+                            RUN_CAP, 1 << max(scan_w // 2 - 1, 1).bit_length()
+                        )) if use_runspace else None,
+                        "overflow_genes": 0,
+                    }
+                    if probe_on:
+                        jax.block_until_ready(out)
+                        brec["wall_s"] = round(
+                            time.perf_counter() - t_bucket, 4
+                        )
+                        # split the sort out of the contraction attribution:
+                        # time the same rows through a sort-only jit — warmed
+                        # untimed first, since every bucket shape is distinct
+                        # and a cold compile inside the timed region would
+                        # inflate every sort_s in the committed PROFILE
+                        jax.block_until_ready(sort_probe(rows, kcid))
+                        t_s = time.perf_counter()
+                        jax.block_until_ready(sort_probe(rows, kcid))
+                        brec["sort_s"] = round(time.perf_counter() - t_s, 4)
+                        if nr_b is not None:
+                            nr = np.asarray(jax.device_get(nr_b))[: ids.size]
+                            if nr.size:
+                                brec["tied_runs_p50"] = int(np.median(nr))
+                                brec["tied_runs_max"] = int(nr.max())
+                    probe["buckets"].append(brec)
             parts.append((ids, out))
             g0 = g1
         if use_runspace and overflow:
@@ -647,6 +672,19 @@ def _run_wilcox_device(
         if probe is not None and probe_on:
             jax.block_until_ready([o for _, o in parts])
             probe["ladder_wall_s"] = round(time.perf_counter() - t_ladder, 4)
+        if probe is not None and hasattr(probe_out, "metrics"):
+            # ladder-level aggregates on the wilcox stage span: the
+            # occupancy payload's distributional view as typed metrics
+            sm = probe_out.metrics
+            sm.counter("ladder_buckets").add(len(probe["buckets"]))
+            sm.counter("genes").add(
+                sum(b["n_genes"] for b in probe["buckets"])
+            )
+            hw = sm.histogram("bucket_window")
+            hp = sm.histogram("bucket_pad_ratio")
+            for b in probe["buckets"]:
+                hw.observe(b["window"])
+                hp.observe(b["pad_ratio"])
         inv = np.empty(G, np.int64)
         inv[np.concatenate([ids for ids, _ in parts])] = np.arange(G)
         jinv = jnp.asarray(inv)
@@ -662,20 +700,24 @@ def _run_wilcox_device(
         outs = []
         overflow = []  # (outs idx, g0, g1, device n_runs)
         for g0, g1, chunk in _gene_chunks(data, gc, jdata=jdata):
-            if mesh is not None:
-                outs.append((g0, g1, sharded_allpairs_ranksum(
-                    chunk, jcid, jn, jpi, jpj, K, mesh=mesh
-                )))
-            elif use_runspace:
-                lp_b, u_b, ts_b, nr_b = allpairs_ranksum_runspace_chunk(
-                    chunk, jcid, jn, jpi, jpj, K
-                )
-                overflow.append((len(outs), g0, g1, nr_b))
-                outs.append((g0, g1, (lp_b, u_b, ts_b)))
-            else:
-                outs.append((g0, g1, allpairs_ranksum_chunk(
-                    chunk, jcid, jn, jpi, jpj, K
-                )))
+            with obs_trace.span(
+                "wilcox_chunk", g0=int(g0), g1=int(g1),
+            ) as csp:
+                csp.metrics.counter("genes").add(int(g1 - g0))
+                if mesh is not None:
+                    outs.append((g0, g1, sharded_allpairs_ranksum(
+                        chunk, jcid, jn, jpi, jpj, K, mesh=mesh
+                    )))
+                elif use_runspace:
+                    lp_b, u_b, ts_b, nr_b = allpairs_ranksum_runspace_chunk(
+                        chunk, jcid, jn, jpi, jpj, K
+                    )
+                    overflow.append((len(outs), g0, g1, nr_b))
+                    outs.append((g0, g1, (lp_b, u_b, ts_b)))
+                else:
+                    outs.append((g0, g1, allpairs_ranksum_chunk(
+                        chunk, jcid, jn, jpi, jpj, K
+                    )))
         if use_runspace and overflow:
             _redo_overflow_dense(
                 outs, overflow, data, gc, jdata, jcid, jn, jpi, jpj, K,

@@ -76,7 +76,72 @@ def refine(
         ``jax.sharding.Mesh``, or None for the serial single-device path.
         Mesh runs shard the rank-test gene chunks and the silhouette ring;
         results are identical to serial (asserted in tests/test_parallel.py).
+
+    Observability: every stage runs inside a tracer span (submitted +
+    device-synced walls; obs.trace). SCC_OBS_TRANSFERS=1 additionally
+    counts explicit host↔device transfer bytes onto the result metrics;
+    SCC_TRACE_DIR=<dir> exports <dir>/run_record.json and a Perfetto-
+    openable <dir>/trace.json after the run (even a failed one, for
+    post-mortems).
     """
+    from contextlib import nullcontext
+
+    from scconsensus_tpu.config import env_flag
+
+    timer = timer or StageTimer(get_logger())
+    watch = None
+    if env_flag("SCC_OBS_TRANSFERS"):
+        from scconsensus_tpu.obs.device import TransferWatch
+
+        watch = TransferWatch()
+    try:
+        with (watch if watch is not None else nullcontext()):
+            result = _refine_impl(data, labels, config, gene_names, timer,
+                                  mesh)
+    finally:
+        trace_dir = env_flag("SCC_TRACE_DIR")
+        if trace_dir:
+            _export_trace(trace_dir, timer, watch)
+    if watch is not None:
+        result.metrics["transfers"] = watch.report()
+    return result
+
+
+def _export_trace(trace_dir: str, timer: StageTimer, watch) -> None:
+    """Best-effort post-run export; never kills the pipeline result."""
+    try:
+        import os
+
+        from scconsensus_tpu.obs.export import (
+            build_run_record,
+            write_chrome_trace,
+            write_json_atomic,
+        )
+
+        os.makedirs(trace_dir, exist_ok=True)
+        tracer = timer.tracer
+        rec = build_run_record(
+            metric="refine() pipeline trace",
+            value=round(tracer.total_s(), 4),
+            unit="seconds",
+            tracer=tracer,
+            transfers=watch.report() if watch is not None else None,
+        )
+        write_json_atomic(os.path.join(trace_dir, "run_record.json"), rec)
+        write_chrome_trace(os.path.join(trace_dir, "trace.json"),
+                           tracer.span_records())
+    except Exception as e:  # pragma: no cover - defensive
+        get_logger().warning("trace export failed: %r", e)
+
+
+def _refine_impl(
+    data: np.ndarray,
+    labels: Sequence,
+    config: ReclusterConfig,
+    gene_names: Optional[Sequence[str]],
+    timer: StageTimer,
+    mesh,
+) -> ReclusterResult:
     from scconsensus_tpu.io.sparsemat import (
         as_csr,
         is_jax,
@@ -85,8 +150,7 @@ def refine(
         rows_dense,
     )
 
-    logger = get_logger()
-    timer = timer or StageTimer(logger)
+    logger = timer.logger
     store = ArtifactStore(config.artifact_dir)
     if mesh == "auto":
         from scconsensus_tpu.parallel.mesh import auto_mesh

@@ -1,0 +1,46 @@
+"""Unified observability subsystem — the single source of perf truth.
+
+Four layers, consumed together through one versioned run-record schema:
+
+  * ``obs.trace``   — nested-span tracer with explicit device-sync
+    boundaries (submitted vs device-synced walls per span);
+  * ``obs.metrics`` — typed counters/gauges/histograms keyed by span
+    (gene counts, pad ratios, tied-run tables, nnz — the payloads the
+    SCC_WILCOX_PROBE side channel used to smuggle through env flags);
+  * ``obs.device``  — live/peak device-memory samplers, compile-event
+    listeners (jax.monitoring), and a transfer-bytes guard flagging
+    unexpected host round-trips;
+  * ``obs.export``  — the ``scc-run-record`` schema plus a Chrome
+    trace-event exporter (any run opens in Perfetto).
+
+``utils.logging.StageTimer`` remains as a thin back-compat shim over
+``Tracer``; ``bench.py`` and the ``tools/`` emitters all build their
+artifacts through ``obs.export.build_run_record``.
+"""
+
+from scconsensus_tpu.obs.trace import Span, Tracer, current_tracer, span
+from scconsensus_tpu.obs.metrics import MetricSet
+from scconsensus_tpu.obs.export import (
+    SCHEMA_NAME,
+    SCHEMA_VERSION,
+    build_run_record,
+    chrome_trace,
+    validate_run_record,
+    write_chrome_trace,
+    write_json_atomic,
+)
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "current_tracer",
+    "span",
+    "MetricSet",
+    "SCHEMA_NAME",
+    "SCHEMA_VERSION",
+    "build_run_record",
+    "chrome_trace",
+    "validate_run_record",
+    "write_chrome_trace",
+    "write_json_atomic",
+]

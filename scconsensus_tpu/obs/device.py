@@ -1,0 +1,237 @@
+"""Device-side samplers: memory, compile events, transfer bytes.
+
+Nothing in the pre-obs stack captured device memory, compile events, or
+host↔device transfer volume — the exact signals a TPU pipeline needs to
+keep scaling (a silent host round-trip through the ~36 MB/s axon tunnel
+costs more than most kernels). Three probes, all best-effort and
+backend-tolerant (every accessor degrades to None/empty rather than raise):
+
+  * :func:`memory_snapshot` — live/peak HBM from ``Device.memory_stats()``
+    (TPU/GPU; CPU backends return None) plus :func:`host_peak_rss_bytes`
+    as the host-side fallback every record can carry;
+  * :func:`install_compile_listener` — a ``jax.monitoring`` duration
+    listener counting compile events and total compile seconds, snapshot
+    via :func:`compile_mark` / :func:`compile_stats`;
+  * :class:`TransferWatch` — a scoped wrapper over ``jax.device_put`` /
+    ``jax.device_get`` that accumulates transfer bytes per direction and
+    flags single host-bound fetches above a threshold (the "unexpected
+    host round-trip" guard).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "memory_snapshot",
+    "host_peak_rss_bytes",
+    "install_compile_listener",
+    "compile_mark",
+    "compile_stats",
+    "TransferWatch",
+]
+
+
+# --------------------------------------------------------------------------
+# memory
+# --------------------------------------------------------------------------
+
+def memory_snapshot(device=None) -> Optional[Dict[str, int]]:
+    """Live/peak device memory of one device (default: first local device).
+    None when no backend is up or the backend has no memory_stats (CPU)."""
+    try:
+        import sys
+
+        if "jax" not in sys.modules:
+            # never the first jax touch: an orchestrator-side record must
+            # not trigger backend/plugin init just to sample memory
+            return None
+        jax = sys.modules["jax"]
+
+        d = device if device is not None else jax.local_devices()[0]
+        ms = d.memory_stats()
+        if not ms:
+            return None
+        out = {
+            k: int(ms[k])
+            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+            if k in ms
+        }
+        return out or None
+    except Exception:
+        return None
+
+
+def host_peak_rss_bytes() -> Optional[int]:
+    """Peak resident set size of this process (ru_maxrss is KiB on Linux)."""
+    try:
+        import resource
+        import sys
+
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(ru) if sys.platform == "darwin" else int(ru) * 1024
+    except Exception:
+        return None
+
+
+# --------------------------------------------------------------------------
+# compile events (jax.monitoring)
+# --------------------------------------------------------------------------
+
+_COMPILE_LOCK = threading.Lock()
+_COMPILE_EVENTS: List[Tuple[str, float]] = []
+_LISTENER_STATE = {"installed": None}  # None = not attempted yet
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    # jax emits many duration events; keep only compilation-shaped ones
+    # ('/jax/core/compile/...', backend_compile, pjit compilation, ...)
+    if "compil" in event:
+        with _COMPILE_LOCK:
+            _COMPILE_EVENTS.append((event, float(duration)))
+
+
+def install_compile_listener() -> bool:
+    """Register the compile-duration listener once per process. Returns
+    whether a listener is active (False on jax builds without
+    ``jax.monitoring`` duration listeners). Never the first jax touch: if
+    jax has not been imported yet the attempt is deferred (not cached), so
+    a later tracer created after jax is up still installs it."""
+    import sys
+
+    with _COMPILE_LOCK:
+        if _LISTENER_STATE["installed"] is not None:
+            return _LISTENER_STATE["installed"]
+        if "jax" not in sys.modules:
+            return False
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_duration_secs_listener(_on_duration)
+            _LISTENER_STATE["installed"] = True
+        except Exception:
+            _LISTENER_STATE["installed"] = False
+        return _LISTENER_STATE["installed"]
+
+
+def compile_mark() -> int:
+    """Opaque position in the compile-event stream; pass to
+    :func:`compile_stats` to aggregate only the events after it."""
+    with _COMPILE_LOCK:
+        return len(_COMPILE_EVENTS)
+
+
+def compile_stats(since: int = 0) -> Dict[str, Any]:
+    """Aggregate compile events observed after ``since``."""
+    with _COMPILE_LOCK:
+        events = _COMPILE_EVENTS[since:]
+    by_event: Dict[str, Dict[str, float]] = {}
+    for name, secs in events:
+        rec = by_event.setdefault(name, {"n": 0, "total_s": 0.0})
+        rec["n"] += 1
+        rec["total_s"] += secs
+    for rec in by_event.values():
+        rec["total_s"] = round(rec["total_s"], 4)
+    return {
+        "events": len(events),
+        "total_s": round(sum(s for _, s in events), 4),
+        "by_event": by_event,
+    }
+
+
+# --------------------------------------------------------------------------
+# transfer-bytes guard
+# --------------------------------------------------------------------------
+
+def _tree_nbytes(tree) -> int:
+    try:
+        import jax
+
+        return sum(
+            int(getattr(leaf, "nbytes", 0) or 0)
+            for leaf in jax.tree_util.tree_leaves(tree)
+        )
+    except Exception:
+        return 0
+
+
+class TransferWatch:
+    """Scoped accounting of explicit host↔device transfers.
+
+    Wraps ``jax.device_put`` / ``jax.device_get`` for the duration of the
+    context and accumulates bytes per direction. Fetches larger than
+    ``flag_host_bytes`` are recorded as *flags* with the ambient span's
+    name — the signature of an accidental (P, G)-sized host round-trip
+    the lazy-fetch machinery exists to prevent.
+
+    Best-effort by design: implicit transfers (``np.asarray`` on a device
+    array, donated buffers, compiled-program outputs) bypass these entry
+    points and are not counted. The count is a lower bound; the FLAGS are
+    what matter operationally.
+    """
+
+    def __init__(self, flag_host_bytes: int = 64 << 20):
+        self.flag_host_bytes = int(flag_host_bytes)
+        self.to_device_bytes = 0
+        self.to_host_bytes = 0
+        self.to_device_calls = 0
+        self.to_host_calls = 0
+        self.flags: List[Dict[str, Any]] = []
+        self._orig_put = None
+        self._orig_get = None
+        self._lock = threading.Lock()
+
+    def _span_name(self) -> Optional[str]:
+        try:
+            from scconsensus_tpu.obs.trace import current_span
+
+            sp = current_span()
+            return sp.name if sp is not None else None
+        except Exception:
+            return None
+
+    def __enter__(self) -> "TransferWatch":
+        import jax
+
+        self._orig_put = jax.device_put
+        self._orig_get = jax.device_get
+        watch = self
+
+        def put(x, *a, **kw):
+            with watch._lock:
+                watch.to_device_calls += 1
+                watch.to_device_bytes += _tree_nbytes(x)
+            return watch._orig_put(x, *a, **kw)
+
+        def get(x, *a, **kw):
+            nb = _tree_nbytes(x)
+            with watch._lock:
+                watch.to_host_calls += 1
+                watch.to_host_bytes += nb
+                if nb > watch.flag_host_bytes:
+                    watch.flags.append({
+                        "bytes": nb,
+                        "span": watch._span_name(),
+                    })
+            return watch._orig_get(x, *a, **kw)
+
+        jax.device_put = put
+        jax.device_get = get
+        return self
+
+    def __exit__(self, *exc) -> None:
+        import jax
+
+        jax.device_put = self._orig_put
+        jax.device_get = self._orig_get
+
+    def report(self) -> Dict[str, Any]:
+        return {
+            "to_device_bytes": self.to_device_bytes,
+            "to_device_calls": self.to_device_calls,
+            "to_host_bytes": self.to_host_bytes,
+            "to_host_calls": self.to_host_calls,
+            "flag_host_bytes": self.flag_host_bytes,
+            "flags": self.flags,
+        }

@@ -1,0 +1,272 @@
+"""The canonical run-record schema + Chrome trace-event export.
+
+``bench.py``, ``tools/run_sparse_1m.py``, and ``tools/repeat_anchor.py``
+each used to emit differently-shaped JSON. Every emitter now builds its
+artifact through :func:`build_run_record`, and every ingester
+(``tools/summarize_evidence.py``, cross-round diff tooling) validates with
+:func:`validate_run_record` / :func:`check_schema_version`.
+
+schema ``scc-run-record`` version 1 — top-level keys:
+
+  schema            "scc-run-record" (constant)
+  schema_version    1 (integer; ingesters error on unknown versions)
+  metric/value/unit/vs_baseline
+                    the legacy driver headline, unchanged (the driver
+                    parses the last JSON line of a run's output)
+  run               {created_unix, platform?, jax_version?, argv?}
+  spans             [span records: name, span_id, parent_id, depth, kind,
+                    t0_s, wall_submitted_s, wall_synced_s|null, synced,
+                    attrs?, metrics?, device_mem?]
+  device            {memory: per-device live/peak HBM or null,
+                     host_peak_rss_bytes, compile: {events, total_s, ...}?,
+                     transfers: TransferWatch.report()?}
+  extra             free-form emitter extras (legacy ``extra`` dict)
+
+The Chrome trace export (:func:`chrome_trace`) converts the span tree to
+``traceEvents`` complete ("X") events — open the file in Perfetto
+(https://ui.perfetto.dev) or chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "SCHEMA_NAME",
+    "SCHEMA_VERSION",
+    "build_run_record",
+    "validate_run_record",
+    "check_schema_version",
+    "chrome_trace",
+    "write_chrome_trace",
+    "write_json_atomic",
+]
+
+SCHEMA_NAME = "scc-run-record"
+SCHEMA_VERSION = 1
+
+
+def _device_section(tracer=None,
+                    transfers: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    from scconsensus_tpu.obs import device as obs_device
+
+    out: Dict[str, Any] = {
+        "memory": obs_device.memory_snapshot(),
+        "host_peak_rss_bytes": obs_device.host_peak_rss_bytes(),
+    }
+    if tracer is not None:
+        cs = tracer.compile_stats()
+        if cs is not None:
+            out["compile"] = cs
+    if transfers is not None:
+        out["transfers"] = transfers
+    return out
+
+
+def build_run_record(
+    metric: str,
+    value,
+    unit: str = "seconds",
+    vs_baseline=None,
+    extra: Optional[Dict[str, Any]] = None,
+    spans: Optional[List[Dict[str, Any]]] = None,
+    tracer=None,
+    device: Optional[Dict[str, Any]] = None,
+    transfers: Optional[Dict[str, Any]] = None,
+    platform: Optional[str] = None,
+) -> Dict[str, Any]:
+    """One schema-v1 run record. Pass ``tracer`` to take spans + compile
+    stats from it; or pre-built ``spans`` (e.g. a resumed pipeline's
+    ``result.metrics["spans"]``); or neither (orchestrator-side records
+    written before any measurement ran)."""
+    if spans is None:
+        spans = tracer.span_records() if tracer is not None else []
+    extra = dict(extra or {})
+    run: Dict[str, Any] = {"created_unix": round(time.time(), 3)}
+    plat = platform or extra.get("platform")
+    if plat is not None:
+        run["platform"] = plat
+    import sys
+
+    if "jax" in sys.modules:  # never import jax here: orchestrator-side
+        try:                  # records must not trigger plugin registration
+            run["jax_version"] = sys.modules["jax"].__version__
+        except Exception:
+            pass
+    return {
+        "schema": SCHEMA_NAME,
+        "schema_version": SCHEMA_VERSION,
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": vs_baseline,
+        "run": run,
+        "spans": spans,
+        "device": device if device is not None
+        else _device_section(tracer, transfers),
+        "extra": extra,
+    }
+
+
+def check_schema_version(rec: Dict[str, Any], source: str = "record") -> str:
+    """Classify a record for ingesters: returns 'legacy' for pre-schema
+    artifacts (no ``schema`` key), 'v<N>' for a known version, and raises
+    ValueError on an unknown schema name or version — an ingester must
+    never silently misread a future schema."""
+    if not isinstance(rec, dict) or "schema" not in rec:
+        return "legacy"
+    name = rec.get("schema")
+    if name != SCHEMA_NAME:
+        raise ValueError(f"{source}: unknown schema {name!r}")
+    ver = rec.get("schema_version")
+    if ver != SCHEMA_VERSION:
+        raise ValueError(
+            f"{source}: unsupported {SCHEMA_NAME} version {ver!r} "
+            f"(this tool knows version {SCHEMA_VERSION})"
+        )
+    return f"v{ver}"
+
+
+def validate_run_record(rec: Dict[str, Any]) -> None:
+    """Structural validation of a schema-v1 record; raises ValueError with
+    the first violation. The test suite and every ingester share this one
+    checker so 'schema-valid' means the same thing everywhere."""
+    if check_schema_version(rec) == "legacy":
+        raise ValueError("record has no schema field")
+    for key in ("metric", "value", "unit", "vs_baseline", "run", "spans",
+                "device", "extra"):
+        if key not in rec:
+            raise ValueError(f"run record missing key {key!r}")
+    if not isinstance(rec["metric"], str) or not rec["metric"]:
+        raise ValueError("metric must be a non-empty string")
+    if not isinstance(rec["run"], dict) or "created_unix" not in rec["run"]:
+        raise ValueError("run section must carry created_unix")
+    if not isinstance(rec["spans"], list):
+        raise ValueError("spans must be a list")
+    all_ids = {
+        s.get("span_id") for s in rec["spans"] if isinstance(s, dict)
+    }
+    for i, s in enumerate(rec["spans"]):
+        where = f"spans[{i}]"
+        if not isinstance(s, dict):
+            raise ValueError(f"{where} is not an object")
+        for key in ("name", "span_id", "depth", "kind", "t0_s",
+                    "wall_submitted_s", "synced"):
+            if key not in s:
+                raise ValueError(f"{where} missing {key!r}")
+        if not isinstance(s["name"], str) or not s["name"]:
+            raise ValueError(f"{where}: name must be a non-empty string")
+        if s["t0_s"] < 0 or s["wall_submitted_s"] < 0:
+            raise ValueError(f"{where}: negative timing")
+        ws = s.get("wall_synced_s")
+        if ws is not None and ws < 0:
+            raise ValueError(f"{where}: negative synced wall")
+        if s["synced"] and ws is None:
+            raise ValueError(f"{where}: synced span without wall_synced_s")
+        parent = s.get("parent_id")
+        if parent is not None and parent not in all_ids:
+            raise ValueError(f"{where}: dangling parent_id {parent}")
+    if not isinstance(rec["device"], dict):
+        raise ValueError("device section must be an object")
+
+
+# --------------------------------------------------------------------------
+# Chrome trace events (Perfetto / chrome://tracing)
+# --------------------------------------------------------------------------
+
+def chrome_trace(spans: List[Dict[str, Any]],
+                 process_name: str = "scconsensus_tpu") -> Dict[str, Any]:
+    """Span records → Chrome trace-event JSON (complete "X" events, µs).
+
+    Each span becomes one event spanning [t0, t0 + wall] where the wall is
+    the device-synced one when recorded (honest compute attribution) else
+    the submitted one. Children close before their parent by construction,
+    so events nest under Perfetto's containment rules. Events are emitted
+    sorted by timestamp.
+    """
+    events: List[Dict[str, Any]] = [{
+        "ph": "M", "pid": 0, "tid": 0, "ts": 0,
+        "name": "process_name", "args": {"name": process_name},
+    }]
+    for s in spans:
+        wall = s.get("wall_synced_s")
+        if wall is None:
+            wall = s["wall_submitted_s"]
+        args: Dict[str, Any] = {
+            "kind": s.get("kind"),
+            "synced": s.get("synced"),
+            "wall_submitted_s": s.get("wall_submitted_s"),
+        }
+        if s.get("wall_synced_s") is not None:
+            args["wall_synced_s"] = s["wall_synced_s"]
+        for src in ("attrs", "metrics"):
+            v = s.get(src)
+            if v:
+                # scalars only: Perfetto renders args flat, and a 1M-shape
+                # occupancy dict would bloat every event row
+                args.update({
+                    k: x for k, x in v.items()
+                    if isinstance(x, (int, float, str, bool))
+                })
+        events.append({
+            "ph": "X",
+            "pid": 0,
+            "tid": 0,
+            "cat": s.get("kind", "span"),
+            "name": s["name"],
+            "ts": round(s["t0_s"] * 1e6, 3),
+            "dur": round(max(wall, 0.0) * 1e6, 3),
+            "args": args,
+        })
+    events.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+ATOMIC_TMP_PREFIX = ".scc-tmp-"
+
+
+def atomic_write(path: str, write_fn) -> None:
+    """The one atomic-write primitive every artifact writer shares:
+    ``write_fn(tmp_path)`` produces the full content at a unique temp path
+    in the destination dir (same filesystem, so ``os.replace`` is atomic),
+    the temp file is fsynced, then renamed over the destination. An
+    interrupted writer can leave a stale ``.scc-tmp-*`` file but never a
+    truncated artifact under a real name."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=ATOMIC_TMP_PREFIX, dir=d)
+    os.close(fd)
+    try:
+        # mkstemp creates 0600; restore the umask-default mode so shared
+        # artifact dirs / CI collectors can read the renamed file
+        umask = os.umask(0)
+        os.umask(umask)
+        os.chmod(tmp, 0o666 & ~umask)
+        write_fn(tmp)
+        with open(tmp, "rb") as f:
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def write_json_atomic(path: str, obj: Any, indent: int = 1) -> None:
+    """Atomic JSON export (see :func:`atomic_write`)."""
+    def _w(tmp: str) -> None:
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=indent, default=str)
+
+    atomic_write(path, _w)
+
+
+def write_chrome_trace(path: str, spans: List[Dict[str, Any]],
+                       process_name: str = "scconsensus_tpu") -> None:
+    write_json_atomic(path, chrome_trace(spans, process_name))

@@ -1,0 +1,387 @@
+"""Nested-span tracer with explicit device-sync boundaries.
+
+Every prior perf round hand-rolled its own timing: ``StageTimer`` measured
+dispatch intervals unless ``SCC_STAGE_SYNC`` was set, the r6 Wilcoxon ladder
+carried its own synced per-bucket walls "with a separate sort split", and the
+edgeR driver had a third private profiler. This module generalizes all of
+them: a span is entered, work is submitted, and at exit the tracer records
+BOTH the submitted wall (host dispatch time) and — for sync-eligible spans —
+the device-synced wall (a ``block_until_ready`` sentinel drains the queue at
+the boundary), so JAX async dispatch can never land one span's compute on
+whichever later span first blocks.
+
+Spans nest: a ``stage``-kind span (the pipeline's de/embed/tree/... stages)
+may contain ``detail``-kind children (gene-chunk loops, ladder buckets,
+sharded dispatches). The tracer keeps the whole tree; the legacy
+``StageTimer.records`` view surfaces only the stage spans.
+
+Ambient access: entering a span publishes its tracer to a contextvar, so
+deep engine code (``de.engine`` chunk loops, ``parallel.sharded_de``) opens
+child spans via the module-level :func:`span` without threading a tracer
+through every signature. With no active tracer that function is a recorded
+no-op (a throwaway span), so library code can instrument unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import logging
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from scconsensus_tpu.config import env_flag
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "span",
+    "current_tracer",
+    "current_span",
+    "device_drain",
+    "summarize_record",
+]
+
+_ACTIVE: contextvars.ContextVar = contextvars.ContextVar(
+    "scc_active_tracer", default=None
+)
+
+_LOG_LIST_CAP = 16
+
+
+def summarize_record(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """Log-line rendering of a record: long lists (e.g. the per-pair DE
+    counts at K=44 → 946 entries) are summarized; the STORED record — what
+    metrics/bench consumers read — keeps the full values. Recurses into
+    nested dicts (the wilcox stage's ``occupancy`` probe carries a
+    per-bucket list that can run tens of entries at 1M-cell shapes)."""
+    out: Dict[str, Any] = {}
+    for k, v in rec.items():
+        if isinstance(v, dict):
+            out[k] = summarize_record(v)
+        elif isinstance(v, (list, tuple)) and len(v) > _LOG_LIST_CAP:
+            out[k] = {
+                "n": len(v),
+                "head": list(v[:_LOG_LIST_CAP]),
+                "sum": sum(v) if v and isinstance(v[0], (int, float)) else None,
+            }
+        else:
+            out[k] = v
+    return out
+
+
+def device_drain() -> bool:
+    """Submit-and-block a sentinel op: when it returns, every previously
+    dispatched device computation has retired. Returns False when no
+    backend is up (shutdown, import-time use) — attribution only, never an
+    error. Never the FIRST jax touch: with jax unimported there is nothing
+    queued, and a drain must not drag a jax-free process (orchestrators,
+    consensus-only flows) through backend init."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return False
+    try:
+        jax = sys.modules["jax"]
+        (jax.device_put(0.0) + 0).block_until_ready()
+        return True
+    except Exception:
+        return False
+
+
+_WARNED_SYNC_VALUES = set()
+
+
+def _sync_mode() -> str:
+    """Resolve the tracer sync policy from the env-flag registry:
+    'stage' (default — drain at stage-span boundaries), 'all' (every
+    span; diagnosis runs), or 'off' (dispatch intervals, the pre-obs
+    behavior). Legacy SCC_STAGE_SYNC=1 forces at least 'stage'. An
+    unrecognized value (e.g. a typo'd 'al') warns once and runs the
+    default — a silent fallback would hand a diagnosis run dispatch
+    walls and misattribute exactly what the subsystem exists to pin."""
+    v = str(env_flag("SCC_TRACE_SYNC") or "").strip().lower()
+    if v in ("off", "0", "none", "false", "no"):
+        return "stage" if env_flag("SCC_STAGE_SYNC") else "off"
+    if v == "all":
+        return "all"
+    if v not in ("", "stage", "1", "true", "on", "yes"):
+        if v not in _WARNED_SYNC_VALUES:
+            _WARNED_SYNC_VALUES.add(v)
+            logging.getLogger("scconsensus_tpu").warning(
+                "unrecognized SCC_TRACE_SYNC=%r; using 'stage' "
+                "(valid: stage|all|off)", v,
+            )
+    return "stage"
+
+
+class Span:
+    """One timed region. Dict-style access reads/writes ``attrs`` so legacy
+    writers (``rec["union_size"] = ...``, the engine's ``probe_out`` sink)
+    work on a Span exactly as they did on the old StageTimer record dict."""
+
+    __slots__ = (
+        "name", "span_id", "parent_id", "depth", "kind", "attrs",
+        "t0_s", "wall_submitted_s", "wall_synced_s", "synced",
+        "device_mem", "_metrics", "_token", "_t_enter",
+    )
+
+    def __init__(self, name: str, span_id: int, parent_id: Optional[int],
+                 depth: int, kind: str, attrs: Dict[str, Any]):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.depth = depth
+        self.kind = kind
+        self.attrs = attrs
+        self.t0_s = 0.0
+        self.wall_submitted_s = 0.0
+        self.wall_synced_s: Optional[float] = None
+        self.synced = False
+        self.device_mem: Optional[Dict[str, Any]] = None
+        self._metrics = None
+        self._token = None
+        self._t_enter = 0.0
+
+    # -- dict-style back-compat surface -----------------------------------
+    def __setitem__(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def __getitem__(self, key: str) -> Any:
+        return self.attrs[key]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.attrs
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.attrs.get(key, default)
+
+    def setdefault(self, key: str, default: Any = None) -> Any:
+        return self.attrs.setdefault(key, default)
+
+    def update(self, *a, **kw) -> None:
+        self.attrs.update(*a, **kw)
+
+    # -- typed metrics -----------------------------------------------------
+    @property
+    def metrics(self):
+        """Lazily created :class:`~scconsensus_tpu.obs.metrics.MetricSet`."""
+        if self._metrics is None:
+            from scconsensus_tpu.obs.metrics import MetricSet
+
+            self._metrics = MetricSet()
+        return self._metrics
+
+    # -- views -------------------------------------------------------------
+    @property
+    def wall_s(self) -> float:
+        """Headline wall: device-synced when a sync ran, else submitted."""
+        return (self.wall_synced_s if self.wall_synced_s is not None
+                else self.wall_submitted_s)
+
+    def stage_record(self) -> Dict[str, Any]:
+        """Legacy StageTimer-shaped record (``{"stage", ..., "wall_s"}``)."""
+        rec: Dict[str, Any] = {"stage": self.name, **self.attrs}
+        rec["wall_s"] = round(self.wall_s, 4)
+        rec["wall_submitted_s"] = round(self.wall_submitted_s, 4)
+        if self.wall_synced_s is not None:
+            rec["wall_synced_s"] = round(self.wall_synced_s, 4)
+        if self.synced:
+            rec["synced"] = True
+        return rec
+
+    def record(self) -> Dict[str, Any]:
+        """Full span record (the run-record schema's ``spans[]`` entry)."""
+        rec: Dict[str, Any] = {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "depth": self.depth,
+            "kind": self.kind,
+            "t0_s": round(self.t0_s, 6),
+            "wall_submitted_s": round(self.wall_submitted_s, 6),
+            "wall_synced_s": (round(self.wall_synced_s, 6)
+                              if self.wall_synced_s is not None else None),
+            "synced": self.synced,
+        }
+        if self.attrs:
+            rec["attrs"] = self.attrs
+        if self._metrics is not None and not self._metrics.empty():
+            rec["metrics"] = self._metrics.to_dict()
+        if self.device_mem is not None:
+            rec["device_mem"] = self.device_mem
+        return rec
+
+
+class _NullSpan(Span):
+    """Sink for module-level :func:`span` with no active tracer: accepts
+    attrs/metrics, records nothing."""
+
+    def __init__(self):
+        super().__init__("<null>", -1, None, 0, "detail", {})
+
+
+class Tracer:
+    """Collects a span tree for one run.
+
+    ``sync``: 'stage' | 'all' | 'off' (default from the SCC_TRACE_SYNC
+    registry flag). ``annotate=True`` additionally wraps each span in
+    ``jax.profiler.TraceAnnotation`` so spans show up in XLA/TPU traces.
+    ``sample_device=True`` snapshots live/peak device memory at each
+    sync-eligible span exit (no-op on backends without memory_stats).
+    """
+
+    def __init__(self, logger: Optional[logging.Logger] = None,
+                 sync: Optional[str] = None, annotate: bool = False,
+                 sample_device: bool = True):
+        self.t_origin = time.perf_counter()
+        self.spans: List[Span] = []          # finished spans, completion order
+        self.logger = logger
+        self.sync = sync if sync in ("stage", "all", "off") else _sync_mode()
+        self.annotate = annotate
+        self.sample_device = sample_device
+        self._stack: List[Span] = []
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self._compile_mark = None
+        try:
+            from scconsensus_tpu.obs import device as obs_device
+
+            # only mark when a listener is live: a zero-event compile_stats
+            # from a listenerless tracer would claim the run compiled
+            # nothing when it compiled dozens of programs
+            if obs_device.install_compile_listener():
+                self._compile_mark = obs_device.compile_mark()
+        except Exception:
+            pass
+
+    # -- span lifecycle ----------------------------------------------------
+    def _should_sync(self, kind: str, override: Optional[bool]) -> bool:
+        if override is not None:
+            return override
+        if self.sync == "all":
+            return True
+        if self.sync == "stage":
+            return kind == "stage"
+        return False
+
+    @contextmanager
+    def span(self, name: str, kind: str = "stage",
+             sync: Optional[bool] = None, **attrs: Any):
+        with self._lock:
+            parent = self._stack[-1] if self._stack else None
+            sp = Span(
+                name, next(self._ids),
+                parent.span_id if parent is not None else None,
+                len(self._stack), kind, dict(attrs),
+            )
+            self._stack.append(sp)
+        do_sync = self._should_sync(kind, sync)
+        ann = None
+        if self.annotate:
+            try:
+                import jax.profiler
+
+                ann = jax.profiler.TraceAnnotation(name)
+                ann.__enter__()
+            except Exception:
+                ann = None
+        if do_sync:
+            # entry boundary: queued work from the PREDECESSOR retires now,
+            # so it cannot be billed to this span
+            device_drain()
+        sp._token = _ACTIVE.set(self)
+        sp._t_enter = time.perf_counter()
+        sp.t0_s = sp._t_enter - self.t_origin
+        try:
+            yield sp
+        finally:
+            now = time.perf_counter()
+            sp.wall_submitted_s = now - sp._t_enter
+            if do_sync and device_drain():
+                sp.synced = True
+                sp.wall_synced_s = time.perf_counter() - sp._t_enter
+            if sp.synced and self.sample_device:
+                try:
+                    from scconsensus_tpu.obs import device as obs_device
+
+                    sp.device_mem = obs_device.memory_snapshot()
+                except Exception:
+                    pass
+            if ann is not None:
+                ann.__exit__(None, None, None)
+            _ACTIVE.reset(sp._token)
+            with self._lock:
+                if self._stack and self._stack[-1] is sp:
+                    self._stack.pop()
+                self.spans.append(sp)
+            if self.logger is not None and kind == "stage":
+                self.logger.info(
+                    "stage %s",
+                    json.dumps(summarize_record(sp.stage_record()),
+                               default=str),
+                )
+
+    # -- views -------------------------------------------------------------
+    def stage_records(self) -> List[Dict[str, Any]]:
+        return [s.stage_record() for s in self.spans if s.kind == "stage"]
+
+    def span_records(self) -> List[Dict[str, Any]]:
+        return [s.record() for s in self.spans]
+
+    def total_s(self) -> float:
+        return sum(s.wall_s for s in self.spans if s.kind == "stage")
+
+    def compile_stats(self) -> Optional[Dict[str, Any]]:
+        """Compile events observed since this tracer was created (None when
+        the jax.monitoring listener could not be installed)."""
+        if self._compile_mark is None:
+            return None
+        from scconsensus_tpu.obs import device as obs_device
+
+        return obs_device.compile_stats(since=self._compile_mark)
+
+    def as_dict(self) -> Dict[str, Any]:
+        from scconsensus_tpu.obs.export import SCHEMA_NAME, SCHEMA_VERSION
+
+        out: Dict[str, Any] = {
+            "stages": self.stage_records(),
+            "total_s": self.total_s(),
+            "spans": self.span_records(),
+            "schema": SCHEMA_NAME,
+            "schema_version": SCHEMA_VERSION,
+        }
+        cs = self.compile_stats()
+        if cs is not None:
+            out["compile"] = cs
+        return out
+
+
+def current_tracer() -> Optional[Tracer]:
+    """The tracer of the innermost active span, or None."""
+    return _ACTIVE.get()
+
+
+def current_span() -> Optional[Span]:
+    """The innermost active span of the ambient tracer, or None."""
+    tr = _ACTIVE.get()
+    if tr is None:
+        return None
+    with tr._lock:
+        return tr._stack[-1] if tr._stack else None
+
+
+@contextmanager
+def span(name: str, kind: str = "detail", sync: Optional[bool] = None,
+         **attrs: Any):
+    """Open a child span on the ambient tracer (no-op sink when none is
+    active) — the instrumentation entry point for deep engine code."""
+    tr = _ACTIVE.get()
+    if tr is None:
+        yield _NullSpan()
+        return
+    with tr.span(name, kind=kind, sync=sync, **attrs) as sp:
+        yield sp

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from scconsensus_tpu.obs import trace as obs_trace
 from scconsensus_tpu.ops.gates import ClusterAggregates
 from scconsensus_tpu.ops.wilcoxon import wilcoxon_pairs_tile
 from scconsensus_tpu.parallel.mesh import (
@@ -80,34 +81,39 @@ def sharded_aggregates(
     """
     require_dense(data)
     mesh = mesh or make_mesh(axis_name=axis_name)
-    # pad_and_shard keeps a device-resident jax.Array on device (pad +
-    # redistribute in HBM); host numpy pads on host and uploads sharded —
-    # on a multi-process mesh each process uploads only its addressable
-    # cell blocks
-    dp, _ = pad_and_shard(data, mesh, P(None, axis_name), 1)
-    if cid is not None:
-        if onehot is not None:
-            raise ValueError("pass either onehot or cid, not both")
-        if n_clusters is None:
-            raise ValueError("cid form requires n_clusters")
-        from scconsensus_tpu.parallel.mesh import put_sharded
+    with obs_trace.span(
+        "sharded_aggregates", n_shards=int(mesh.devices.size),
+    ):
+        # pad_and_shard keeps a device-resident jax.Array on device (pad +
+        # redistribute in HBM); host numpy pads on host and uploads sharded
+        # — on a multi-process mesh each process uploads only its
+        # addressable cell blocks
+        dp, _ = pad_and_shard(data, mesh, P(None, axis_name), 1)
+        if cid is not None:
+            if onehot is not None:
+                raise ValueError("pass either onehot or cid, not both")
+            if n_clusters is None:
+                raise ValueError("cid form requires n_clusters")
+            from scconsensus_tpu.parallel.mesh import put_sharded
 
-        # pad with −1 (excluded), NOT 0 — a zero-padded id would count the
-        # phantom cells into cluster 0
-        cid_h = np.asarray(jax.device_get(cid), np.int32).ravel()
-        n_pad = (-cid_h.size) % int(mesh.devices.size)
-        if n_pad:
-            cid_h = np.concatenate(
-                [cid_h, np.full(n_pad, -1, np.int32)]
-            )
-        cp = put_sharded(cid_h, mesh, P(axis_name))
-        out = _jitted_aggregates_cid(mesh, axis_name, int(n_clusters))(dp, cp)
-    else:
-        require_dense(onehot)
-        op, _ = pad_and_shard(onehot, mesh, P(axis_name), 0)
-        out = _jitted_aggregates(mesh, axis_name)(dp, op)
-    drain_if_cpu_mesh(mesh, *out)
-    return ClusterAggregates(*out)
+            # pad with −1 (excluded), NOT 0 — a zero-padded id would count
+            # the phantom cells into cluster 0
+            cid_h = np.asarray(jax.device_get(cid), np.int32).ravel()
+            n_pad = (-cid_h.size) % int(mesh.devices.size)
+            if n_pad:
+                cid_h = np.concatenate(
+                    [cid_h, np.full(n_pad, -1, np.int32)]
+                )
+            cp = put_sharded(cid_h, mesh, P(axis_name))
+            out = _jitted_aggregates_cid(
+                mesh, axis_name, int(n_clusters)
+            )(dp, cp)
+        else:
+            require_dense(onehot)
+            op, _ = pad_and_shard(onehot, mesh, P(axis_name), 0)
+            out = _jitted_aggregates(mesh, axis_name)(dp, op)
+        drain_if_cpu_mesh(mesh, *out)
+        return ClusterAggregates(*out)
 
 
 @lru_cache(maxsize=32)
@@ -179,30 +185,36 @@ def sharded_allpairs_ranksum(
     """
     mesh = mesh or make_mesh(axis_name=axis_name)
     gc = chunk.shape[0]
-    # host input pads+uploads; device-resident input pads+redistributes in
-    # HBM — either way the jitted shard_map sees a pre-laid-out operand
-    chunk, _ = pad_and_shard(chunk, mesh, P(axis_name, None), 0)
-    cid_2d = getattr(cid, "ndim", 1) == 2
-    if cid_2d:
-        # int-preserving pad + upload: pad_and_shard casts to float32 (its
-        # data-tensor contract), which would hand the kernel float cluster
-        # ids — pad the gene axis with −1 (excluded) rows and shard as int32
-        from scconsensus_tpu.parallel.mesh import put_sharded
+    with obs_trace.span(
+        "sharded_ranksum", n_shards=int(mesh.devices.size),
+        n_genes=int(gc), window=int(window),
+    ):
+        # host input pads+uploads; device-resident input pads+redistributes
+        # in HBM — either way the jitted shard_map sees a pre-laid-out
+        # operand
+        chunk, _ = pad_and_shard(chunk, mesh, P(axis_name, None), 0)
+        cid_2d = getattr(cid, "ndim", 1) == 2
+        if cid_2d:
+            # int-preserving pad + upload: pad_and_shard casts to float32
+            # (its data-tensor contract), which would hand the kernel float
+            # cluster ids — pad the gene axis with −1 (excluded) rows and
+            # shard as int32
+            from scconsensus_tpu.parallel.mesh import put_sharded
 
-        cid_h = np.asarray(jax.device_get(cid), np.int32)
-        n_pad = (-cid_h.shape[0]) % int(mesh.devices.size)
-        if n_pad:
-            cid_h = np.pad(
-                cid_h, ((0, n_pad), (0, 0)), constant_values=-1
-            )
-        cid = put_sharded(cid_h, mesh, P(axis_name, None))
-    lp, u, ts = _jitted_allpairs(mesh, axis_name, n_clusters, window,
-                                 cid_2d)(
-        chunk, cid, n_of, pair_i, pair_j
-    )
-    # virtual-CPU meshes deadlock with >1 collective program in flight
-    drain_if_cpu_mesh(mesh, lp, u, ts)
-    return lp[:gc], u[:gc], ts[:gc]
+            cid_h = np.asarray(jax.device_get(cid), np.int32)
+            n_pad = (-cid_h.shape[0]) % int(mesh.devices.size)
+            if n_pad:
+                cid_h = np.pad(
+                    cid_h, ((0, n_pad), (0, 0)), constant_values=-1
+                )
+            cid = put_sharded(cid_h, mesh, P(axis_name, None))
+        lp, u, ts = _jitted_allpairs(mesh, axis_name, n_clusters, window,
+                                     cid_2d)(
+            chunk, cid, n_of, pair_i, pair_j
+        )
+        # virtual-CPU meshes deadlock with >1 collective program in flight
+        drain_if_cpu_mesh(mesh, lp, u, ts)
+        return lp[:gc], u[:gc], ts[:gc]
 
 
 @lru_cache(maxsize=32)
@@ -247,20 +259,25 @@ def sharded_wilcox_logp(
     require_dense(data)
     mesh = mesh or make_mesh(axis_name=axis_name)
     G = data.shape[0]
-    # device-resident input pads/redistributes in HBM; host input uploads
-    dp, _ = pad_and_shard(data, mesh, P(axis_name, None), 0)
-    # replicated small inputs stay host numpy: uncommitted values replicate
-    # onto any mesh, where a jnp.asarray would commit to local device 0 and
-    # be rejected by a cross-process jit
-    log_p = _jitted_wilcox(mesh, axis_name)(
-        dp,
-        np.asarray(idx, np.int32),
-        np.asarray(m1),
-        np.asarray(m2),
-        np.asarray(n1, np.int32),
-        np.asarray(n2, np.int32),
-    )
-    return np.asarray(log_p)[:, :G]
+    with obs_trace.span(
+        "sharded_wilcox_logp", n_shards=int(mesh.devices.size),
+        n_genes=int(G),
+    ):
+        # device-resident input pads/redistributes in HBM; host input
+        # uploads
+        dp, _ = pad_and_shard(data, mesh, P(axis_name, None), 0)
+        # replicated small inputs stay host numpy: uncommitted values
+        # replicate onto any mesh, where a jnp.asarray would commit to
+        # local device 0 and be rejected by a cross-process jit
+        log_p = _jitted_wilcox(mesh, axis_name)(
+            dp,
+            np.asarray(idx, np.int32),
+            np.asarray(m1),
+            np.asarray(m2),
+            np.asarray(n1, np.int32),
+            np.asarray(n2, np.int32),
+        )
+        return np.asarray(log_p)[:, :G]
 
 
 @lru_cache(maxsize=32)

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from scconsensus_tpu.obs import trace as obs_trace
 from scconsensus_tpu.ops.distance import distance_tile
 from scconsensus_tpu.ops.gates import ClusterAggregates, compute_aggregates, pair_gates_fast
 from scconsensus_tpu.ops.multipletests import bh_adjust_masked
@@ -82,7 +83,21 @@ def _build_step(agg_fn, wilcox_fn, sil_fn, *, min_pct, log_fc_thrs, q_val_thrs, 
             "counts": agg.counts,
         }
 
-    return jax.jit(step)
+    jitted = jax.jit(step)
+
+    def traced_step(*args, **kw):
+        # one span per step invocation (submitted wall = dispatch; a
+        # 'stage'-sync tracer leaves inner spans unsynced, so the jitted
+        # program's async pipelining is untouched)
+        with obs_trace.span("refine_step") as sp:
+            out = jitted(*args, **kw)
+            sp["n_outputs"] = len(out)
+            return out
+
+    # preserve the jit surface the driver's compile checks use
+    traced_step.lower = jitted.lower
+    traced_step.__wrapped__ = jitted
+    return traced_step
 
 
 def fused_refine_step(

@@ -15,11 +15,23 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
+from scconsensus_tpu.obs.export import (
+    ATOMIC_TMP_PREFIX as _TMP_PREFIX,
+    atomic_write as _atomic_bytes_writer,
+)
+
 __all__ = ["ArtifactStore", "input_fingerprint"]
+
+# Stage saves atomically via obs.export.atomic_write (the shared
+# mkstemp+fsync+os.replace primitive): a half-written ``de.npz`` would
+# poison every resume, so interrupted writers leave only stale
+# ``.scc-tmp-*`` files, swept (when old) on the next store open.
+_STALE_TMP_AGE_S = 3600.0
 
 
 def input_fingerprint(data, labels) -> Dict[str, Any]:
@@ -63,6 +75,25 @@ class ArtifactStore:
         self.root = root
         if root is not None:
             os.makedirs(root, exist_ok=True)
+            self._sweep_stale_tmp()
+
+    def _sweep_stale_tmp(self) -> None:
+        """Remove temp files orphaned by an interrupted writer — they are
+        never valid artifacts (stages resume from the real names only).
+        Only temps older than ``_STALE_TMP_AGE_S`` go: a second process
+        opening the same store must not yank a live writer's in-flight
+        temp out from under its fsync."""
+        try:
+            cutoff = time.time() - _STALE_TMP_AGE_S
+            for e in os.scandir(self.root):
+                if (e.name.startswith(_TMP_PREFIX) and e.is_file()
+                        and e.stat().st_mtime < cutoff):
+                    try:
+                        os.unlink(e.path)
+                    except OSError:
+                        pass
+        except OSError:
+            pass
 
     @property
     def enabled(self) -> bool:
@@ -120,9 +151,11 @@ class ArtifactStore:
 
     @staticmethod
     def _write_pin(path: str, config: Any, inputs: Optional[Dict[str, Any]]):
-        with open(path + ".tmp", "w") as f:
-            json.dump({"config": config, "inputs": inputs}, f, indent=2)
-        os.replace(path + ".tmp", path)
+        def _w(tmp):
+            with open(tmp, "w") as f:
+                json.dump({"config": config, "inputs": inputs}, f, indent=2)
+
+        _atomic_bytes_writer(path, _w)
 
     def has(self, stage: str) -> bool:
         """True iff the stage's array artifact exists (the resume key).
@@ -134,16 +167,30 @@ class ArtifactStore:
 
     def save(self, stage: str, arrays: Optional[Dict[str, np.ndarray]] = None,
              meta: Optional[Dict[str, Any]] = None) -> None:
+        """Atomic per-file writes, meta BEFORE arrays: ``has()`` keys resume
+        on the ``.npz``, so the only observable intermediate state (meta
+        present, arrays absent) reads as stage-not-complete and recomputes.
+        The reverse order could briefly expose arrays with a stale sidecar.
+        """
         if not self.enabled:
             return
         npz, js = self._paths(stage)
-        if arrays is not None:
-            np.savez_compressed(npz + ".tmp.npz", **{k: np.asarray(v) for k, v in arrays.items()})
-            os.replace(npz + ".tmp.npz", npz)
         if meta is not None:
-            with open(js + ".tmp", "w") as f:
-                json.dump(meta, f, indent=2, default=str)
-            os.replace(js + ".tmp", js)
+            def _wj(tmp):
+                with open(tmp, "w") as f:
+                    json.dump(meta, f, indent=2, default=str)
+
+            _atomic_bytes_writer(js, _wj)
+        if arrays is not None:
+            def _wz(tmp):
+                # savez_compressed appends .npz when the name lacks it; an
+                # explicit file handle writes exactly to the temp path
+                with open(tmp, "wb") as f:
+                    np.savez_compressed(
+                        f, **{k: np.asarray(v) for k, v in arrays.items()}
+                    )
+
+            _atomic_bytes_writer(npz, _wz)
 
     def load(self, stage: str):
         npz, js = self._paths(stage)

@@ -1,52 +1,31 @@
-"""Structured logging + per-stage timing.
+"""Structured logging + the StageTimer back-compat shim.
 
 The reference's observability is bare ``print()`` progress lines
-(R/reclusterDEConsensus.R:172-178; SURVEY.md §5.1/§5.5). Here every pipeline
-stage emits a structured record {stage, wall_s, extra metrics} through a
-standard logger, and the collected records double as the benchmark output.
+(R/reclusterDEConsensus.R:172-178; SURVEY.md §5.1/§5.5). Tracing now lives
+in :mod:`scconsensus_tpu.obs.trace`; ``StageTimer`` remains as a thin shim
+over :class:`~scconsensus_tpu.obs.trace.Tracer` so existing callers (and
+external code built against the old API) keep working: ``stage()`` opens a
+stage-kind span, ``records`` is the legacy list-of-dicts view, and
+``as_dict()`` additionally carries the full span tree + schema version for
+the run-record exporters.
+
+Device-sync policy moved to the tracer (SCC_TRACE_SYNC in the config.py
+env-flag registry): stage boundaries drain the device queue by default, so
+stage walls are honest compute attribution instead of dispatch intervals —
+what SCC_STAGE_SYNC=1 used to opt into.
 """
 
 from __future__ import annotations
 
-import json
 import logging
-import os
-import time
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
+from scconsensus_tpu.obs.trace import Tracer
+
 __all__ = ["get_logger", "StageTimer"]
 
-# SCC_STAGE_SYNC=1: drain the device queue at every stage boundary so stage
-# walls are honest compute attribution instead of dispatch intervals (JAX
-# async dispatch otherwise lands queued work on whichever stage first
-# blocks — a 78 s "bh_adjust" was really the rank-sum queue draining).
-# Costs one device round-trip per stage; off by default.
-_STAGE_SYNC = bool(os.environ.get("SCC_STAGE_SYNC"))
-
 _FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
-_LOG_LIST_CAP = 16
-
-
-def _log_form(rec: Dict[str, Any]) -> Dict[str, Any]:
-    """Log-line rendering of a stage record: long lists (e.g. the per-pair
-    DE counts at K=44 → 946 entries) are summarized; the STORED record —
-    what metrics/bench consumers read — keeps the full values. Recurses
-    into nested dicts (the wilcox stage's ``occupancy`` probe carries a
-    per-bucket list that can run tens of entries at 1M-cell shapes)."""
-    out: Dict[str, Any] = {}
-    for k, v in rec.items():
-        if isinstance(v, dict):
-            out[k] = _log_form(v)
-        elif isinstance(v, (list, tuple)) and len(v) > _LOG_LIST_CAP:
-            out[k] = {
-                "n": len(v),
-                "head": list(v[:_LOG_LIST_CAP]),
-                "sum": sum(v) if v and isinstance(v[0], (int, float)) else None,
-            }
-        else:
-            out[k] = v
-    return out
 
 
 def get_logger(name: str = "scconsensus_tpu") -> logging.Logger:
@@ -61,50 +40,30 @@ def get_logger(name: str = "scconsensus_tpu") -> logging.Logger:
 
 
 class StageTimer:
-    """Collects per-stage wall-clock + metrics; optionally wraps stages in
-    ``jax.profiler.TraceAnnotation`` so stages show up in TPU traces."""
+    """Back-compat facade over ``obs.trace.Tracer``.
 
-    def __init__(self, logger: Optional[logging.Logger] = None, trace: bool = False):
-        self.records: List[Dict[str, Any]] = []
+    ``trace=True`` maps to the tracer's ``annotate`` (stages wrapped in
+    ``jax.profiler.TraceAnnotation`` so they show up in TPU traces).
+    """
+
+    def __init__(self, logger: Optional[logging.Logger] = None,
+                 trace: bool = False, tracer: Optional[Tracer] = None):
         self.logger = logger or get_logger()
-        self.trace = trace
-
-    @staticmethod
-    def _drain() -> None:
-        if not _STAGE_SYNC:
-            return
-        try:
-            import jax
-
-            (jax.device_put(0.0) + 0).block_until_ready()
-        except Exception:  # no backend yet / shutdown: attribution only
-            pass
+        self.tracer = tracer or Tracer(logger=self.logger, annotate=trace)
+        if tracer is not None and tracer.logger is None:
+            tracer.logger = self.logger
 
     @contextmanager
     def stage(self, name: str, **metrics: Any):
-        ann = None
-        if self.trace:
-            import jax.profiler
+        with self.tracer.span(name, kind="stage", **metrics) as sp:
+            yield sp
 
-            ann = jax.profiler.TraceAnnotation(name)
-            ann.__enter__()
-        self._drain()
-        t0 = time.perf_counter()
-        rec: Dict[str, Any] = {"stage": name, **metrics}
-        try:
-            yield rec
-        finally:
-            self._drain()
-            rec["wall_s"] = round(time.perf_counter() - t0, 4)
-            if ann is not None:
-                ann.__exit__(None, None, None)
-            self.records.append(rec)
-            if _STAGE_SYNC:
-                rec["synced"] = True
-            self.logger.info("stage %s", json.dumps(_log_form(rec), default=str))
+    @property
+    def records(self) -> List[Dict[str, Any]]:
+        return self.tracer.stage_records()
 
     def total_s(self) -> float:
-        return sum(r.get("wall_s", 0.0) for r in self.records)
+        return self.tracer.total_s()
 
     def as_dict(self) -> Dict[str, Any]:
-        return {"stages": self.records, "total_s": self.total_s()}
+        return self.tracer.as_dict()

@@ -1,0 +1,174 @@
+"""ArtifactStore atomicity + interrupt/resume (ISSUE 2 satellites).
+
+Kill a pipeline mid-stage, assert the store holds no partial artifacts,
+then re-run against the same store and assert completed stages are skipped
+and the final arrays are identical to an uninterrupted run.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from scconsensus_tpu.config import ReclusterConfig
+from scconsensus_tpu.models.pipeline import refine
+from scconsensus_tpu.utils.artifacts import _TMP_PREFIX, ArtifactStore
+from scconsensus_tpu.utils.synthetic import noisy_labeling, synthetic_scrna
+
+
+@pytest.fixture()
+def small_case():
+    data, truth, _ = synthetic_scrna(
+        n_genes=80, n_cells=200, n_clusters=3, n_markers_per_cluster=8,
+        seed=11,
+    )
+    labels = noisy_labeling(truth, 0.05, seed=2)
+    return data, labels
+
+
+def _assert_store_clean(root):
+    """No temp files; every artifact parses completely."""
+    names = os.listdir(root)
+    leftovers = [n for n in names if n.startswith(_TMP_PREFIX)
+                 or ".tmp" in n]
+    assert not leftovers, f"partial artifacts left behind: {leftovers}"
+    for n in names:
+        path = os.path.join(root, n)
+        if n.endswith(".npz"):
+            with np.load(path, allow_pickle=False) as z:
+                for k in z.files:
+                    z[k]  # a truncated zip raises here
+        elif n.endswith(".json"):
+            json.load(open(path))
+
+
+class TestAtomicWrites:
+    def test_save_never_leaves_partial_on_crash(self, tmp_path, monkeypatch):
+        store = ArtifactStore(str(tmp_path))
+        # fail INSIDE the array serialization, after the temp file exists
+        real_savez = np.savez_compressed
+
+        def boom(*a, **kw):
+            raise RuntimeError("disk full (injected)")
+
+        monkeypatch.setattr(np, "savez_compressed", boom)
+        with pytest.raises(RuntimeError):
+            store.save("de", arrays={"x": np.arange(4)})
+        monkeypatch.setattr(np, "savez_compressed", real_savez)
+        assert not store.has("de")
+        _assert_store_clean(str(tmp_path))
+        # a later save of the same stage succeeds normally
+        store.save("de", arrays={"x": np.arange(4)})
+        assert store.has("de")
+        arrays, _ = store.load("de")
+        np.testing.assert_array_equal(arrays["x"], np.arange(4))
+
+    def test_stale_tmp_files_swept_on_open(self, tmp_path):
+        stale = tmp_path / f"{_TMP_PREFIX}deadbeef"
+        stale.write_bytes(b"half-written garbage")
+        fresh = tmp_path / f"{_TMP_PREFIX}inflight"
+        fresh.write_bytes(b"another process, mid-write")
+        old = os.path.getmtime(stale) - 7200
+        os.utime(stale, (old, old))
+        ArtifactStore(str(tmp_path))
+        assert not stale.exists()
+        # a FRESH temp may belong to a live concurrent writer: keep it
+        assert fresh.exists()
+
+
+class TestInterruptResume:
+    def test_interrupt_mid_stage_then_resume_identical(
+        self, tmp_path, small_case, monkeypatch
+    ):
+        data, labels = small_case
+        config = ReclusterConfig(
+            deep_split_values=(1, 2), artifact_dir=str(tmp_path / "store")
+        )
+
+        # 1. uninterrupted reference run (no store)
+        ref = refine(data, labels, ReclusterConfig(deep_split_values=(1, 2)),
+                     mesh=None)
+
+        # 2. interrupted run: die inside the cuts stage, AFTER de/union/
+        #    embed/tree artifacts were saved
+        import scconsensus_tpu.models.pipeline as pl
+
+        real_cutree = pl.cutree_hybrid
+        calls = {"n": 0}
+
+        def dying_cutree(*a, **kw):
+            calls["n"] += 1
+            raise KeyboardInterrupt("simulated ctrl-C mid-stage")
+
+        monkeypatch.setattr(pl, "cutree_hybrid", dying_cutree)
+        with pytest.raises(KeyboardInterrupt):
+            refine(data, labels, config, mesh=None)
+        assert calls["n"] == 1
+        store_dir = str(tmp_path / "store")
+        _assert_store_clean(store_dir)
+        store = ArtifactStore(store_dir)
+        for done in ("de", "union", "embed", "tree"):
+            assert store.has(done), f"pre-interrupt stage {done} not saved"
+        assert not store.has("cuts")
+
+        # 3. resume: completed stages must be SKIPPED (poison their
+        #    compute paths to prove it), the interrupted stage recomputes
+        monkeypatch.setattr(pl, "cutree_hybrid", real_cutree)
+
+        def poisoned_de(*a, **kw):
+            raise AssertionError("de stage re-ran on resume")
+
+        monkeypatch.setattr(pl, "pairwise_de", poisoned_de)
+        monkeypatch.setattr(
+            pl, "ward_linkage",
+            lambda *a, **kw: (_ for _ in ()).throw(
+                AssertionError("tree stage re-ran on resume")
+            ),
+        )
+        res = refine(data, labels, config, mesh=None)
+
+        # 4. identical outputs vs the uninterrupted run
+        np.testing.assert_array_equal(
+            res.de_gene_union_idx, ref.de_gene_union_idx
+        )
+        np.testing.assert_allclose(
+            res.embedding, ref.embedding, rtol=1e-5, atol=1e-5
+        )
+        for key in ref.dynamic_labels:
+            np.testing.assert_array_equal(
+                res.dynamic_labels[key], ref.dynamic_labels[key]
+            )
+        np.testing.assert_array_equal(res.nodg, ref.nodg)
+
+    def test_interrupt_during_de_leaves_no_de_artifact(
+        self, tmp_path, small_case, monkeypatch
+    ):
+        """Die INSIDE the DE save path (mid np.savez): resume must
+        recompute DE from scratch rather than load a truncated artifact."""
+        data, labels = small_case
+        config = ReclusterConfig(
+            deep_split_values=(1,), artifact_dir=str(tmp_path / "store")
+        )
+        real_savez = np.savez_compressed
+        state = {"armed": True}
+
+        def dying_savez(*a, **kw):
+            if state["armed"]:
+                state["armed"] = False
+                raise KeyboardInterrupt("killed mid-write")
+            return real_savez(*a, **kw)
+
+        monkeypatch.setattr(np, "savez_compressed", dying_savez)
+        with pytest.raises(KeyboardInterrupt):
+            refine(data, labels, config, mesh=None)
+        store_dir = str(tmp_path / "store")
+        _assert_store_clean(store_dir)
+        assert not ArtifactStore(store_dir).has("de")
+        # resume completes and matches a storeless run
+        res = refine(data, labels, config, mesh=None)
+        ref = refine(data, labels,
+                     ReclusterConfig(deep_split_values=(1,)), mesh=None)
+        np.testing.assert_array_equal(
+            res.de_gene_union_idx, ref.de_gene_union_idx
+        )

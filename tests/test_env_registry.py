@@ -1,0 +1,85 @@
+"""SCC_* env-flag registry (config.ENV_FLAGS) — parsing + lint.
+
+The lint test greps every Python source in the package, bench.py, and
+tools/ for SCC_ literals and fails on any flag not present in the registry:
+a new env side channel must be declared (name, type, default, doc) before
+it can ship.
+"""
+
+import pathlib
+import re
+
+import pytest
+
+from scconsensus_tpu.config import ENV_FLAGS, EnvFlag, env_flag
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+_SCC_RE = re.compile(r"\bSCC_[A-Z0-9_]+\b")
+
+
+def _scanned_sources():
+    yield from (REPO / "scconsensus_tpu").rglob("*.py")
+    yield REPO / "bench.py"
+    yield from (REPO / "tools").glob("*.py")
+
+
+class TestRegistryLint:
+    def test_every_scc_literal_is_registered(self):
+        unregistered = {}
+        for path in _scanned_sources():
+            text = path.read_text()
+            for name in set(_SCC_RE.findall(text)):
+                if name not in ENV_FLAGS:
+                    unregistered.setdefault(name, []).append(
+                        str(path.relative_to(REPO))
+                    )
+        assert not unregistered, (
+            "SCC_ flags not in config.ENV_FLAGS (register name/type/"
+            f"default/doc before use): {unregistered}"
+        )
+
+    def test_registry_entries_are_documented(self):
+        for name, spec in ENV_FLAGS.items():
+            assert isinstance(spec, EnvFlag)
+            assert spec.name == name
+            assert spec.type in (bool, int, float, str)
+            assert spec.doc and len(spec.doc) > 10, f"{name}: missing doc"
+
+    def test_known_flags_present(self):
+        for name in ("SCC_WILCOX_PROBE", "SCC_NO_RUNSPACE",
+                     "SCC_EDGER_PROFILE", "SCC_STAGE_SYNC",
+                     "SCC_TRACE_SYNC", "SCC_TRACE_DIR",
+                     "SCC_OBS_TRANSFERS"):
+            assert name in ENV_FLAGS
+
+
+class TestEnvFlagParsing:
+    def test_unset_returns_default(self):
+        assert env_flag("SCC_TRACE_SYNC", env={}) == "stage"
+        assert env_flag("SCC_WILCOX_PROBE", env={}) is False
+        assert env_flag("SCC_1M_CELLS", env={}) == 1_000_000
+
+    def test_bool_parsing_falsy_strings(self):
+        for raw in ("0", "false", "off", "no", ""):
+            assert env_flag("SCC_WILCOX_PROBE",
+                            env={"SCC_WILCOX_PROBE": raw}) is False
+        assert env_flag("SCC_WILCOX_PROBE",
+                        env={"SCC_WILCOX_PROBE": "1"}) is True
+
+    def test_numeric_parsing(self):
+        assert env_flag("SCC_1M_CELLS", env={"SCC_1M_CELLS": "512"}) == 512
+        assert env_flag(
+            "SCC_BENCH_TIMEOUT_SCALE",
+            env={"SCC_BENCH_TIMEOUT_SCALE": "0.25"},
+        ) == 0.25
+
+    def test_unregistered_flag_raises(self):
+        with pytest.raises(KeyError):
+            env_flag("SCC_NOT_A_REAL_FLAG")
+
+    def test_monkeypatched_env_is_seen_dynamically(self, monkeypatch):
+        monkeypatch.setenv("SCC_NO_RUNSPACE", "1")
+        assert env_flag("SCC_NO_RUNSPACE") is True
+        monkeypatch.delenv("SCC_NO_RUNSPACE")
+        assert env_flag("SCC_NO_RUNSPACE") is False

@@ -1,0 +1,333 @@
+"""Observability subsystem: tracer, metrics, schema, Chrome trace export.
+
+The fast trace smoke test (ISSUE 2 CI satellite) runs a tiny synthetic
+pipeline and asserts the emitted run record is schema-valid with >= 6 stage
+spans carrying nonzero device-synced walls, and that the Chrome trace
+export is structurally valid (events nest, timestamps monotone, every
+pipeline stage present).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from scconsensus_tpu.obs.export import (
+    SCHEMA_NAME,
+    SCHEMA_VERSION,
+    build_run_record,
+    check_schema_version,
+    chrome_trace,
+    validate_run_record,
+)
+from scconsensus_tpu.obs.metrics import Counter, Gauge, Histogram, MetricSet
+from scconsensus_tpu.obs.trace import Tracer, current_tracer, span
+
+
+# --------------------------------------------------------------------------
+# tracer
+# --------------------------------------------------------------------------
+
+class TestTracer:
+    def test_nested_spans_record_parentage_and_depth(self):
+        tr = Tracer(sync="off")
+        with tr.span("outer") as o:
+            with tr.span("inner", kind="detail") as i:
+                assert i.parent_id == o.span_id
+                assert i.depth == 1
+        recs = {s["name"]: s for s in tr.span_records()}
+        assert recs["inner"]["parent_id"] == recs["outer"]["span_id"]
+        assert recs["outer"]["parent_id"] is None
+        # children complete before parents
+        assert [s["name"] for s in tr.span_records()] == ["inner", "outer"]
+
+    def test_stage_spans_sync_by_default(self):
+        tr = Tracer()  # default policy: 'stage'
+        with tr.span("s", kind="stage"):
+            pass
+        with tr.span("d", kind="detail"):
+            pass
+        recs = {s["name"]: s for s in tr.span_records()}
+        assert recs["s"]["synced"] is True
+        assert recs["s"]["wall_synced_s"] > 0
+        assert recs["d"]["synced"] is False
+        assert recs["d"]["wall_synced_s"] is None
+
+    def test_sync_off_records_submitted_only(self):
+        tr = Tracer(sync="off")
+        with tr.span("s", kind="stage"):
+            pass
+        (rec,) = tr.span_records()
+        assert rec["synced"] is False and rec["wall_submitted_s"] >= 0
+
+    def test_ambient_module_span(self):
+        tr = Tracer(sync="off")
+        with tr.span("stage_a"):
+            assert current_tracer() is tr
+            with span("deep_detail", foo=1) as d:
+                d["bar"] = 2
+        assert current_tracer() is None
+        names = [s["name"] for s in tr.span_records()]
+        assert names == ["deep_detail", "stage_a"]
+        deep = tr.span_records()[0]
+        assert deep["attrs"] == {"foo": 1, "bar": 2}
+
+    def test_module_span_without_tracer_is_noop(self):
+        with span("orphan") as sp:
+            sp["x"] = 1  # must accept writes silently
+            sp.metrics.counter("c").add(1)
+
+    def test_dict_style_access_on_span(self):
+        tr = Tracer(sync="off")
+        with tr.span("s", init=7) as sp:
+            sp["k"] = "v"
+            sp.setdefault("k2", []).append(3)
+            assert "k" in sp and sp.get("missing") is None
+            assert sp["init"] == 7
+        rec = tr.stage_records()[0]
+        assert rec["stage"] == "s" and rec["k"] == "v" and rec["k2"] == [3]
+
+    def test_stage_records_exclude_detail_spans(self):
+        tr = Tracer(sync="off")
+        with tr.span("stage_x"):
+            with tr.span("detail_y", kind="detail"):
+                pass
+        assert [r["stage"] for r in tr.stage_records()] == ["stage_x"]
+
+    def test_as_dict_carries_schema_and_spans(self):
+        tr = Tracer(sync="off")
+        with tr.span("a"):
+            pass
+        d = tr.as_dict()
+        assert d["schema"] == SCHEMA_NAME
+        assert d["schema_version"] == SCHEMA_VERSION
+        assert len(d["spans"]) == 1
+        assert d["total_s"] >= 0
+
+
+# --------------------------------------------------------------------------
+# metrics
+# --------------------------------------------------------------------------
+
+class TestMetrics:
+    def test_counter_accumulates_and_rejects_negative(self):
+        c = Counter()
+        c.add(2).add(3)
+        assert c.to_dict() == {"type": "counter", "value": 5.0}
+        with pytest.raises(ValueError):
+            c.add(-1)
+
+    def test_gauge_last_write_wins(self):
+        g = Gauge()
+        g.set(1.0)
+        g.set(9.0)
+        assert g.to_dict()["value"] == 9.0
+
+    def test_histogram_buckets_and_stats(self):
+        h = Histogram(bounds=[1, 10, 100])
+        for v in (0.5, 5, 50, 5000):
+            h.observe(v)
+        d = h.to_dict()
+        assert d["n"] == 4 and d["min"] == 0.5 and d["max"] == 5000
+        assert d["buckets"] == {"1.0": 1, "10.0": 1, "100.0": 1, "+inf": 1}
+
+    def test_metricset_create_on_use_and_type_guard(self):
+        ms = MetricSet()
+        ms.counter("n").add(1)
+        ms.gauge("w").set(2)
+        with pytest.raises(TypeError):
+            ms.gauge("n")
+        d = ms.to_dict()
+        assert d["n"]["type"] == "counter" and d["w"]["type"] == "gauge"
+
+
+# --------------------------------------------------------------------------
+# schema
+# --------------------------------------------------------------------------
+
+class TestRunRecordSchema:
+    def test_build_and_validate_roundtrip(self):
+        tr = Tracer()
+        with tr.span("stage_a"):
+            pass
+        rec = build_run_record(
+            "unit-test metric", 1.23, tracer=tr, extra={"platform": "cpu"}
+        )
+        validate_run_record(rec)  # must not raise
+        assert rec["schema"] == SCHEMA_NAME
+        assert rec["run"]["platform"] == "cpu"
+        assert rec["device"]["host_peak_rss_bytes"] > 0
+        # json-serializable end to end
+        validate_run_record(json.loads(json.dumps(rec)))
+
+    def test_legacy_records_classify_as_legacy(self):
+        assert check_schema_version({"metric": "m", "value": 1}) == "legacy"
+
+    def test_unknown_schema_version_errors(self):
+        rec = build_run_record("m", 1)
+        rec["schema_version"] = SCHEMA_VERSION + 1
+        with pytest.raises(ValueError, match="unsupported"):
+            check_schema_version(rec)
+        with pytest.raises(ValueError):
+            validate_run_record(rec)
+
+    def test_unknown_schema_name_errors(self):
+        with pytest.raises(ValueError, match="unknown schema"):
+            check_schema_version({"schema": "someone-elses-schema"})
+
+    def test_validate_rejects_structural_damage(self):
+        rec = build_run_record("m", 1)
+        rec["spans"] = [{"name": "x"}]  # missing timing keys
+        with pytest.raises(ValueError, match="missing"):
+            validate_run_record(rec)
+        rec = build_run_record("m", 1)
+        rec["spans"] = [{
+            "name": "x", "span_id": 0, "parent_id": 99, "depth": 0,
+            "kind": "stage", "t0_s": 0.0, "wall_submitted_s": 0.0,
+            "synced": False,
+        }]
+        with pytest.raises(ValueError, match="dangling parent"):
+            validate_run_record(rec)
+
+
+# --------------------------------------------------------------------------
+# transfer guard
+# --------------------------------------------------------------------------
+
+class TestTransferWatch:
+    def test_counts_bytes_and_flags_large_host_fetches(self):
+        import jax
+
+        from scconsensus_tpu.obs.device import TransferWatch
+
+        x = np.ones((64, 64), np.float32)
+        with TransferWatch(flag_host_bytes=1024) as w:
+            dx = jax.device_put(x)
+            _ = jax.device_get(dx)
+        rep = w.report()
+        assert rep["to_device_bytes"] >= x.nbytes
+        assert rep["to_host_bytes"] >= x.nbytes
+        assert rep["flags"] and rep["flags"][0]["bytes"] >= x.nbytes
+        # patches restored on exit
+        assert jax.device_put.__module__ != TransferWatch.__module__
+
+    def test_small_fetches_not_flagged(self):
+        import jax
+
+        from scconsensus_tpu.obs.device import TransferWatch
+
+        with TransferWatch(flag_host_bytes=1 << 20) as w:
+            _ = jax.device_get(jax.device_put(np.ones(4, np.float32)))
+        assert w.report()["flags"] == []
+
+
+# --------------------------------------------------------------------------
+# the tier-1 trace smoke test (ISSUE 2 acceptance)
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def traced_pipeline_metrics():
+    from scconsensus_tpu import recluster_de_consensus_fast
+    from scconsensus_tpu.utils.synthetic import noisy_labeling, synthetic_scrna
+
+    data, truth, _ = synthetic_scrna(
+        n_genes=100, n_cells=240, n_clusters=3, n_markers_per_cluster=10,
+        seed=0,
+    )
+    labels = noisy_labeling(truth, 0.05, seed=1)
+    res = recluster_de_consensus_fast(data, labels, mesh=None)
+    return res.metrics
+
+
+class TestTraceSmoke:
+    def test_run_record_schema_valid_with_stage_spans(
+        self, traced_pipeline_metrics
+    ):
+        m = traced_pipeline_metrics
+        rec = build_run_record(
+            "trace smoke", 1.0, spans=m["spans"], extra={"platform": "cpu"}
+        )
+        validate_run_record(rec)
+        stage_spans = [s for s in rec["spans"] if s["kind"] == "stage"]
+        assert len(stage_spans) >= 6
+        # device-synced walls: present and nonzero on every stage span
+        assert all(s["synced"] for s in stage_spans)
+        assert all(s["wall_synced_s"] > 0 for s in stage_spans)
+        # submitted wall <= synced wall (the sync can only add)
+        assert all(
+            s["wall_submitted_s"] <= s["wall_synced_s"] + 1e-9
+            for s in stage_spans
+        )
+
+    def test_legacy_stage_view_matches_spans(self, traced_pipeline_metrics):
+        m = traced_pipeline_metrics
+        legacy = {r["stage"] for r in m["stages"]}
+        spans = {s["name"] for s in m["spans"] if s["kind"] == "stage"}
+        assert legacy == spans
+
+    def test_occupancy_metrics_are_first_class(self, traced_pipeline_metrics):
+        """The former SCC_WILCOX_PROBE payload rides span metrics now."""
+        m = traced_pipeline_metrics
+        ws = next(s for s in m["spans"] if s["name"] == "wilcox_test")
+        mm = ws["metrics"]
+        assert mm["genes"]["type"] == "counter"
+        assert mm["genes"]["value"] == 100
+        assert mm["bucket_pad_ratio"]["type"] == "histogram"
+        assert mm["bucket_pad_ratio"]["n"] >= 1
+        buckets = [s for s in m["spans"] if s["name"] == "wilcox_bucket"]
+        assert buckets, "ladder buckets must emit child spans"
+        assert all(
+            b["metrics"]["window"]["type"] == "gauge" for b in buckets
+        )
+        # bucket spans nest under the wilcox_test stage span
+        assert all(b["parent_id"] == ws["span_id"] for b in buckets)
+
+    def test_chrome_trace_structurally_valid(self, traced_pipeline_metrics):
+        m = traced_pipeline_metrics
+        ct = chrome_trace(m["spans"])
+        events = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+        assert events
+        # timestamps monotone in emission order
+        ts = [e["ts"] for e in events]
+        assert ts == sorted(ts)
+        # every pipeline stage present
+        names = {e["name"] for e in events}
+        for stage in ("cluster_filter", "aggregates", "wilcox_test",
+                      "union", "embed", "tree", "cuts", "nodg"):
+            assert stage in names, f"stage {stage} missing from trace"
+        # events nest: each child interval is contained in its parent's
+        by_id = {s["span_id"]: s for s in m["spans"]}
+        for s in m["spans"]:
+            p = s.get("parent_id")
+            if p is None:
+                continue
+            parent = by_id[p]
+            c0, c1 = s["t0_s"], s["t0_s"] + s["wall_submitted_s"]
+            pw = (parent["wall_synced_s"]
+                  if parent["wall_synced_s"] is not None
+                  else parent["wall_submitted_s"])
+            p0, p1 = parent["t0_s"], parent["t0_s"] + pw
+            assert p0 - 1e-6 <= c0 and c1 <= p1 + 1e-6, (
+                f"span {s['name']} escapes parent {parent['name']}"
+            )
+
+    def test_trace_dir_export(self, tmp_path, monkeypatch):
+        """SCC_TRACE_DIR=<dir> drops run_record.json + trace.json."""
+        from scconsensus_tpu import recluster_de_consensus_fast
+        from scconsensus_tpu.utils.synthetic import (
+            noisy_labeling,
+            synthetic_scrna,
+        )
+
+        monkeypatch.setenv("SCC_TRACE_DIR", str(tmp_path / "tr"))
+        data, truth, _ = synthetic_scrna(
+            n_genes=60, n_cells=150, n_clusters=2,
+            n_markers_per_cluster=8, seed=3,
+        )
+        recluster_de_consensus_fast(
+            data, noisy_labeling(truth, 0.05, seed=1), mesh=None
+        )
+        rec = json.loads((tmp_path / "tr" / "run_record.json").read_text())
+        validate_run_record(rec)
+        trace = json.loads((tmp_path / "tr" / "trace.json").read_text())
+        assert any(e["ph"] == "X" for e in trace["traceEvents"])

@@ -1,0 +1,63 @@
+"""tools/summarize_evidence.py ingest contract: legacy artifacts render,
+schema-v1 records render with span counts, unknown schema versions are a
+hard error (ISSUE 2 CI satellite)."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+from scconsensus_tpu.obs.export import SCHEMA_VERSION, build_run_record
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+TOOL = REPO / "tools" / "summarize_evidence.py"
+
+
+def _run(root):
+    return subprocess.run(
+        [sys.executable, str(TOOL), str(root)],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_repo_root_artifacts_all_ingest():
+    """Every committed evidence artifact (legacy + new schema) summarizes
+    without error — the cross-round diff workflow must keep working."""
+    proc = _run(REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout.strip(), "expected at least one evidence row"
+
+
+def test_schema_v1_record_renders_with_span_count(tmp_path):
+    rec = build_run_record(
+        "t", 1.0,
+        spans=[{
+            "name": "a", "span_id": 0, "parent_id": None, "depth": 0,
+            "kind": "stage", "t0_s": 0.0, "wall_submitted_s": 0.1,
+            "wall_synced_s": 0.1, "synced": True,
+        }],
+        extra={"platform": "cpu"},
+    )
+    (tmp_path / "SCALE_r99_test.json").write_text(json.dumps(rec))
+    proc = _run(tmp_path)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert f"schema={SCHEMA_VERSION}" in proc.stdout
+    assert "spans=1" in proc.stdout
+
+
+def test_unknown_schema_version_is_hard_error(tmp_path):
+    rec = build_run_record("t", 1.0)
+    rec["schema_version"] = SCHEMA_VERSION + 7
+    (tmp_path / "SCALE_r99_future.json").write_text(json.dumps(rec))
+    proc = _run(tmp_path)
+    assert proc.returncode != 0
+    assert "unsupported" in (proc.stderr + proc.stdout)
+
+
+def test_unknown_schema_name_is_hard_error(tmp_path):
+    (tmp_path / "BENCH_CHECKPOINT_x.json").write_text(
+        json.dumps({"schema": "not-ours", "value": 1})
+    )
+    proc = _run(tmp_path)
+    assert proc.returncode != 0
+    assert "unknown schema" in (proc.stderr + proc.stdout)

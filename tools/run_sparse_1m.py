@@ -81,17 +81,17 @@ def noisy(labels: np.ndarray, flip: float, k: int, seed: int, prefix: str):
 
 
 def main() -> None:
+    from scconsensus_tpu.config import env_flag
+
     import jax
 
     # The env var alone is NOT enough here: the site's axon sitecustomize
     # registers the TPU plugin and wins, hanging backend init on a dead
     # tunnel. Pin CPU via jax.config BEFORE the first backend touch
     # (SCC_1M_PLATFORM overrides for a real accelerator run).
-    jax.config.update(
-        "jax_platforms", os.environ.get("SCC_1M_PLATFORM", "cpu")
-    )
-    n_cells = int(os.environ.get("SCC_1M_CELLS", 1_000_000))
-    n_genes = int(os.environ.get("SCC_1M_GENES", 3000))
+    jax.config.update("jax_platforms", env_flag("SCC_1M_PLATFORM"))
+    n_cells = int(env_flag("SCC_1M_CELLS"))
+    n_genes = int(env_flag("SCC_1M_GENES"))
     n_clusters = 16
 
     from scconsensus_tpu import plot_contingency_table, recluster_de_consensus_fast
@@ -135,7 +135,9 @@ def main() -> None:
         (s["occupancy"] for s in stage_recs
          if s.get("stage") == "wilcox_test" and "occupancy" in s), None
     )
-    probed = bool(os.environ.get("SCC_WILCOX_PROBE"))
+    from scconsensus_tpu.config import env_flag
+
+    probed = bool(env_flag("SCC_WILCOX_PROBE"))
     peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
     dense_gb = n_cells * n_genes * 4 / 1e9
     sil = [
@@ -143,16 +145,19 @@ def main() -> None:
          if k in d}
         for d in res.deep_split_info
     ]
-    record = {
-        "metric": f"{n_cells//1000}k-cell sparse-in FULL pipeline "
-                  "(consensus+DE+union+embed+pooled recluster"
-                  "+pooled silhouette+nodg) wall-clock"
-                  + (" PROBED (per-bucket syncs serialize dispatch)"
-                     if probed else ""),
-        "value": round(refine_s + consensus_s, 3),
-        "unit": "seconds",
-        "vs_baseline": None,  # no reference number exists (BASELINE.md)
-        "extra": {
+    from scconsensus_tpu.obs.export import build_run_record, write_json_atomic
+
+    record = build_run_record(
+        metric=f"{n_cells//1000}k-cell sparse-in FULL pipeline "
+               "(consensus+DE+union+embed+pooled recluster"
+               "+pooled silhouette+nodg) wall-clock"
+               + (" PROBED (per-bucket syncs serialize dispatch)"
+                  if probed else ""),
+        value=round(refine_s + consensus_s, 3),
+        unit="seconds",
+        vs_baseline=None,  # no reference number exists (BASELINE.md)
+        spans=res.metrics.get("spans", []),
+        extra={
             "platform": jax.devices()[0].platform,
             "n_cells": n_cells, "n_genes": n_genes,
             "nnz_frac": round(nnz_frac, 4),
@@ -167,7 +172,7 @@ def main() -> None:
             "silhouette": sil,
             "total_wall_s": round(time.perf_counter() - t_all, 1),
         },
-    }
+    )
     base = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
     out = os.path.join(
         base, f"SCALE_r06_cpu_{n_cells//1000}k_fullpipe_sparse.json"
@@ -188,8 +193,12 @@ def main() -> None:
         record["extra"]["occupancy_meta"] = {
             k: v for k, v in occupancy.items() if k != "buckets"
         }
-    with open(out, "w") as f:
-        json.dump(record, f, indent=1)
+    write_json_atomic(out, record)
+    # Perfetto-openable sibling: the same spans as Chrome trace events
+    from scconsensus_tpu.obs.export import write_chrome_trace
+
+    write_chrome_trace(out.replace(".json", "_trace.json"),
+                       record["spans"])
     print(json.dumps(record), flush=True)
 
 

@@ -4,7 +4,13 @@
 Covers driver artifacts (BENCH_r*.json: {n, cmd, rc, tail, parsed}),
 watcher TPU evidence (BENCH_TPU_*.json), bench checkpoints
 (BENCH_CHECKPOINT_*.json), and the committed SCALE_/MESH_ evidence files.
-Usage: python tools/summarize_evidence.py
+
+Ingest contract: artifacts carrying the ``scc-run-record`` schema are
+version-checked (obs.export.check_schema_version); an unknown schema name
+or version is a hard error (exit != 0), never a silently garbled row.
+Legacy pre-schema artifacts are accepted as-is.
+
+Usage: python tools/summarize_evidence.py [root]
 """
 
 from __future__ import annotations
@@ -12,8 +18,13 @@ from __future__ import annotations
 import glob
 import json
 import os
+import sys
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROOT = sys.argv[1] if len(sys.argv) > 1 else _REPO
+sys.path.insert(0, _REPO)
+
+from scconsensus_tpu.obs.export import check_schema_version  # noqa: E402
 
 
 def _fmt(rec: dict) -> str:
@@ -22,8 +33,12 @@ def _fmt(rec: dict) -> str:
         f"value={rec.get('value')}",
         f"unit={rec.get('unit')}",
         f"vs_baseline={rec.get('vs_baseline')}",
-        f"platform={ex.get('platform')}",
+        f"platform={ex.get('platform') or rec.get('run', {}).get('platform')}",
     ]
+    if "schema" in rec:
+        bits.append(f"schema={rec.get('schema_version')}")
+        if rec.get("spans"):
+            bits.append(f"spans={len(rec['spans'])}")
     if ex.get("degraded"):
         bits.append("DEGRADED")
     if ex.get("partial"):
@@ -35,11 +50,20 @@ def _fmt(rec: dict) -> str:
 
 def _load(path: str):
     """A mid-write (truncated) artifact must degrade to one 'unreadable'
-    row, never crash the whole table."""
+    row, never crash the whole table — but an artifact declaring an
+    UNKNOWN run-record schema version is a hard error (SystemExit): this
+    tool must not render future-schema records as if it understood them.
+    """
     try:
-        return json.load(open(path)), None
+        d = json.load(open(path))
     except (json.JSONDecodeError, OSError) as e:
         return None, f"unreadable: {e!r}"
+    try:
+        if isinstance(d, dict):
+            check_schema_version(d, source=os.path.basename(path))
+    except ValueError as e:
+        raise SystemExit(f"schema validation failed: {e}")
+    return d, None
 
 
 def main() -> None:
