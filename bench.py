@@ -124,15 +124,30 @@ def _trim_line(parsed: dict) -> str:
         parsed["spans"] = []
         parsed.setdefault("extra", {})["truncated"] = True
         line = json.dumps(parsed)
-    drop_order = ("wilcox_occupancy", "prior_failures", "pallas_vs_xla",
+    drop_order = ("wilcox_occupancy", "stage_throughput",
+                  "numeric_fingerprint", "prior_failures", "pallas_vs_xla",
                   "mfu", "edger_error", "wilcox_error", "wilcox_stages",
-                  "edger_stages", "best_partial", "failures")
+                  "edger_stages", "best_partial")
     for key in drop_order:
         if len(line) <= 1500:
             break
         if parsed.get("extra", {}).pop(key, None) is not None:
             parsed["extra"]["truncated"] = True
             line = json.dumps(parsed)
+    # failures are the LAST thing to sacrifice (an all-attempts-failed
+    # record without them is unactionable): first shrink each failure's
+    # stderr tail — three 300-char tails alone can breach the budget
+    fails = parsed.get("extra", {}).get("failures")
+    if len(line) > 1500 and fails:
+        for f in fails:
+            if isinstance(f, dict) and len(f.get("stderr_tail", "")) > 100:
+                f["stderr_tail"] = f["stderr_tail"][-100:]
+        parsed["extra"]["truncated"] = True
+        line = json.dumps(parsed)
+    if len(line) > 1500 and parsed.get("extra", {}).pop(
+            "failures", None) is not None:
+        parsed["extra"]["truncated"] = True
+        line = json.dumps(parsed)
     return line
 
 
@@ -140,20 +155,31 @@ def _trim_line(parsed: dict) -> str:
 # checkpoint file (VERDICT r3 #1: a timeout must still leave a record)
 # --------------------------------------------------------------------------
 
+def _evidence_dir() -> str:
+    """The ledger directory bench writes into: SCC_EVIDENCE_DIR when set
+    (the test suite points it at a tmp dir), else <repo>/evidence."""
+    from scconsensus_tpu.obs.ledger import default_evidence_dir
+
+    return default_evidence_dir(os.path.dirname(os.path.abspath(__file__)))
+
+
 def _ckpt_path() -> str:
     """Per-config checkpoint path, so quick-config test runs can never
-    clobber flagship TPU evidence."""
+    clobber flagship TPU evidence. Checkpoints live under evidence/ now
+    (the root-level BENCH_CHECKPOINT_* files were relocated there); they
+    are working files, indexed into MANIFEST.json only via the final
+    ledger ingest."""
     override = env_flag("SCC_BENCH_CKPT")
     if override:
         return override
     name = env_flag("SCC_BENCH_CONFIG")
-    here = os.path.dirname(os.path.abspath(__file__))
-    return os.path.join(here, f"BENCH_CHECKPOINT_{name}.json")
+    return os.path.join(_evidence_dir(), f"BENCH_CHECKPOINT_{name}.json")
 
 
 def _write_ckpt(record: dict) -> None:
     try:
         path = _ckpt_path()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = f"{path}.tmp{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(record, f, indent=1)
@@ -173,6 +199,38 @@ def _read_ckpt(min_mtime: float | None = None) -> dict | None:
             return json.load(f)
     except (OSError, json.JSONDecodeError):
         return None
+
+
+def _finalize(record: dict) -> dict:
+    """Final-record stamp: per-stage achieved-vs-cost-model throughput
+    (obs.cost.stage_cost_summary over the span tree). Present only when
+    SCC_OBS_COST attribution ran — an empty summary is omitted, never
+    zeros."""
+    try:
+        from scconsensus_tpu.obs.cost import stage_cost_summary
+
+        summ = stage_cost_summary(record.get("spans") or [])
+        if summ:
+            record.setdefault("extra", {})["stage_throughput"] = summ
+    except Exception as e:
+        log(f"[bench] stage-throughput summary failed: {e!r}")
+    return record
+
+
+def _ingest_evidence(record: dict) -> None:
+    """Best-effort ledger ingest of the final record into evidence/
+    (SCC_BENCH_LEDGER=0 disables). The perf gate reads its baselines from
+    this history. Must never kill the bench — the record already printed."""
+    try:
+        if not env_flag("SCC_BENCH_LEDGER"):
+            return
+        from scconsensus_tpu.obs.ledger import Ledger
+
+        record = json.loads(json.dumps(record, default=str))
+        entry = Ledger(_evidence_dir()).ingest(record, source="bench")
+        log(f"[bench] evidence: ingested {entry['file']}")
+    except Exception as e:
+        log(f"[bench] evidence ingest failed: {e!r}")
 
 
 def _emit_partial(record: dict) -> None:
@@ -622,12 +680,35 @@ DEGRADED = {
 }
 
 
+def _stamp_fingerprint(extra: dict, result) -> None:
+    """Numeric-drift sentinel payload on the run record: DE log-p
+    quantiles + NB tagwise-dispersion quantiles (edgeR runs only). The
+    perf gate compares these against evidence/NUMERIC_PINS.json and
+    requires a drift-ledger acknowledgement for any shift."""
+    try:
+        from scconsensus_tpu.obs.regress import drift_fingerprint
+
+        aux = result.de.aux or {}
+        extra["numeric_fingerprint"] = drift_fingerprint(
+            log_p=result.de.log_p,
+            dispersions=aux.get("tagwise_dispersion"),
+        )
+    except Exception as e:
+        log(f"[bench] fingerprint failed: {e!r}")
+
+
 def worker() -> None:
     # test hook: simulate a hung backend init (worker dies having written
     # nothing, so recovery must come from a prior checkpoint)
     hang = float(env_flag("SCC_BENCH_HANG"))
     if hang:
         time.sleep(hang)
+
+    # cost attribution on by default for bench workers: the run record's
+    # stages carry XLA cost_analysis flops/bytes, so the ledger can report
+    # achieved vs. cost-model throughput (one memoized AOT compile per
+    # kernel shape; steady-state walls are unaffected)
+    os.environ.setdefault("SCC_OBS_COST", "1")
 
     import jax
 
@@ -699,9 +780,11 @@ def worker() -> None:
             b1m_state["phase"] = "steady"
         log(f"[bench] steady: {elapsed:.2f}s {info}")
         extra.update(info)
-        final = _b1m_record(elapsed)
+        final = _finalize(_b1m_record(elapsed))
         _write_ckpt(final)
         print(json.dumps(final))
+        if env_flag("SCC_BENCH_NO_FORK"):
+            _ingest_evidence(final)
         return
 
     if name == "flagship":  # env overrides for ad-hoc scaling runs
@@ -798,6 +881,7 @@ def worker() -> None:
             log(f"[bench] edgeR steady-state: {elapsed:.2f}s")
             extra["edger_stages"] = _stage_dict(result)
             extra["union_size"] = int(result.de_gene_union_idx.size)
+            _stamp_fingerprint(extra, result)
             # the headline workload's span tree rides the run record
             state["spans"] = result.metrics.get("spans") or state["spans"]
             return elapsed
@@ -841,9 +925,11 @@ def worker() -> None:
             if pv is not None:
                 extra["pallas_vs_xla"] = pv
 
-        final = _record()
+        final = _finalize(_record())
         _write_ckpt(final)  # final checkpoint is the complete record
         print(_trim_line(final))
+        if env_flag("SCC_BENCH_NO_FORK"):
+            _ingest_evidence(final)
         return
 
     n_cells = cfg["n_cells"]
@@ -885,6 +971,7 @@ def worker() -> None:
         log(f"[bench] steady-state run: {elapsed:.2f}s; union="
             f"{result.de_gene_union_idx.size} genes; "
             f"deep_split_info={result.deep_split_info}")
+        _stamp_fingerprint(extra, result)
         extra["stages"] = {
             s["stage"]: round(s["wall_s"], 3)
             for s in result.metrics.get("stages", [])
@@ -906,9 +993,11 @@ def worker() -> None:
         ]
         if any("silhouette" in d for d in sil):
             extra["silhouette"] = sil
-    final = _refine_record(elapsed)
+    final = _finalize(_refine_record(elapsed))
     _write_ckpt(final)
     print(json.dumps(final))
+    if env_flag("SCC_BENCH_NO_FORK"):
+        _ingest_evidence(final)
 
 
 # --------------------------------------------------------------------------
@@ -1331,6 +1420,7 @@ def main() -> None:
                     parsed["spans"] = disk["spans"]
             _write_ckpt(parsed)
             print(_trim_line(parsed))
+            _ingest_evidence(parsed)
             return
         failures.append(failure)
         log(f"[bench] attempt '{label}' failed: {failure['outcome']}")

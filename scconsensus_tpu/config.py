@@ -57,6 +57,14 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "Wrap refine() in obs.device.TransferWatch: count explicit "
                 "host<->device transfer bytes and flag oversized host "
                 "fetches on the run record."),
+        EnvFlag("SCC_OBS_COST", bool, False,
+                "Attach XLA cost_analysis (FLOPs/bytes) to jitted kernel "
+                "spans at trace time (obs.cost); one memoized AOT compile "
+                "per kernel shape. bench.py workers enable it."),
+        EnvFlag("SCC_EVIDENCE_DIR", str, None,
+                "Evidence-ledger directory override (default <cwd>/evidence"
+                "; bench.py anchors it next to itself). The test suite "
+                "points it at a tmp dir."),
         # --- DE engine ---
         EnvFlag("SCC_WILCOX_PROBE", bool, False,
                 "Synced per-bucket occupancy DIAGNOSIS of the Wilcoxon "
@@ -106,6 +114,9 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "to the CPU-degraded attempt."),
         EnvFlag("SCC_BENCH_CKPT", str, None,
                 "Override the bench checkpoint file path."),
+        EnvFlag("SCC_BENCH_LEDGER", bool, True,
+                "Ingest the final bench record into the evidence ledger "
+                "(set 0 to disable)."),
         EnvFlag("SCC_JAX_CACHE_DIR", str, None,
                 "Override the persistent XLA compile-cache dir."),
         # --- tools/ ---

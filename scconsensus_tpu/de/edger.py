@@ -73,6 +73,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from scconsensus_tpu.obs.cost import attach_cost
 from scconsensus_tpu.ops.negbin import (
     common_dispersion_grid,
     delta_grid,
@@ -459,11 +460,13 @@ def run_edger_pairs(
             if b1 - b0 < sgc:  # pad the tail block: one compiled shape
                 sc = jnp.pad(sc, ((0, sgc - (b1 - b0)), (0, 0)))
                 rc = jnp.pad(rc, ((0, sgc - (b1 - b0)), (0, 0)))
-            t, z = _sub_table_sorted_chunk(
-                sc, j_lib_sub, j_cid_sub, rc,
-                jnp.float32(common_lib), jnp.float32(phi),
-                j_r_nodes, w, K,
-            )
+            kargs = (sc, j_lib_sub, j_cid_sub, rc,
+                     jnp.float32(common_lib), jnp.float32(phi),
+                     j_r_nodes, w, K)
+            # NB node-table build is the driver's hot kernel: price it on
+            # the ambient (edger_nb) stage span when SCC_OBS_COST is on
+            attach_cost(None, _sub_table_sorted_chunk, *kargs)
+            t, z = _sub_table_sorted_chunk(*kargs)
             tabs.append(t[: b1 - b0])
             zss.append(z[: b1 - b0])
         # un-permute back to input gene order (device gathers, axis 0)
@@ -498,11 +501,11 @@ def run_edger_pairs(
     common_parts = []
     for p0, p1, pi, pj in _pair_chunks():
         keep = (j_Zy[:, pi] + j_Zy[:, pj]) > _ROWSUM_FILTER
-        cl = _cl_grid_pairs(
-            table0[:, pi, :], table0[:, pj, :], w_grid,
-            j_zs0[:, pi], j_zs0[:, pj], j_ns[pi], j_ns[pj],
-            keep, j_r_grid,
-        )
+        kargs = (table0[:, pi, :], table0[:, pj, :], w_grid,
+                 j_zs0[:, pi], j_zs0[:, pj], j_ns[pi], j_ns[pj],
+                 keep, j_r_grid)
+        attach_cost(None, _cl_grid_pairs, *kargs)
+        cl = _cl_grid_pairs(*kargs)
         common_parts.append(common_dispersion_grid(cl, j_deltas)[: p1 - p0])
     # chunks dispatch async; ONE (P,) fetch instead of a sync per chunk
     common = np.asarray(jnp.concatenate(common_parts))

@@ -444,6 +444,7 @@ def _run_wilcox_device(
 
     from scconsensus_tpu.config import env_flag
     from scconsensus_tpu.obs import trace as obs_trace
+    from scconsensus_tpu.obs.cost import attach_cost
     from scconsensus_tpu.io.sparsemat import csr_window_rows, is_sparse
     from scconsensus_tpu.ops.ranksum_allpairs import (
         _ALLPAIRS_ELEM_BUDGET,
@@ -602,12 +603,16 @@ def _run_wilcox_device(
                         rows, kcid, jn, jpi, jpj, K, mesh=mesh, window=weff,
                     )
                 elif use_runspace:
+                    attach_cost(bspan, allpairs_ranksum_runspace_chunk,
+                                rows, kcid, jn, jpi, jpj, K, window=weff)
                     lp_b, u_b, ts_b, nr_b = allpairs_ranksum_runspace_chunk(
                         rows, kcid, jn, jpi, jpj, K, window=weff,
                     )
                     out = (lp_b, u_b, ts_b)
                     overflow.append((len(parts), ids, weff, nr_b))
                 else:
+                    attach_cost(bspan, allpairs_ranksum_chunk,
+                                rows, kcid, jn, jpi, jpj, K, window=weff)
                     out = allpairs_ranksum_chunk(
                         rows, kcid, jn, jpi, jpj, K, window=weff,
                     )
@@ -709,12 +714,16 @@ def _run_wilcox_device(
                         chunk, jcid, jn, jpi, jpj, K, mesh=mesh
                     )))
                 elif use_runspace:
+                    attach_cost(csp, allpairs_ranksum_runspace_chunk,
+                                chunk, jcid, jn, jpi, jpj, K)
                     lp_b, u_b, ts_b, nr_b = allpairs_ranksum_runspace_chunk(
                         chunk, jcid, jn, jpi, jpj, K
                     )
                     overflow.append((len(outs), g0, g1, nr_b))
                     outs.append((g0, g1, (lp_b, u_b, ts_b)))
                 else:
+                    attach_cost(csp, allpairs_ranksum_chunk,
+                                chunk, jcid, jn, jpi, jpj, K)
                     outs.append((g0, g1, allpairs_ranksum_chunk(
                         chunk, jcid, jn, jpi, jpj, K
                     )))

@@ -11,7 +11,16 @@ Four layers, consumed together through one versioned run-record schema:
     listeners (jax.monitoring), and a transfer-bytes guard flagging
     unexpected host round-trips;
   * ``obs.export``  — the ``scc-run-record`` schema plus a Chrome
-    trace-event exporter (any run opens in Perfetto).
+    trace-event exporter (any run opens in Perfetto);
+  * ``obs.cost``    — XLA ``cost_analysis`` FLOPs/bytes attached to
+    jitted kernel spans at trace time (SCC_OBS_COST), so records carry
+    achieved-vs-cost-model throughput per stage;
+  * ``obs.ledger``  — the manifest-indexed evidence store under
+    ``evidence/`` (plus the one-shot legacy-artifact upgrader);
+  * ``obs.regress`` — noise-aware per-stage baselines (median-of-3,
+    BASELINE.md policy), regression verdicts with span-tree offender
+    diffs, and the numeric-drift sentinels + drift-acknowledgement
+    ledger (``tools/perf_gate.py`` is the CLI).
 
 ``utils.logging.StageTimer`` remains as a thin back-compat shim over
 ``Tracer``; ``bench.py`` and the ``tools/`` emitters all build their
@@ -19,6 +28,7 @@ artifacts through ``obs.export.build_run_record``.
 """
 
 from scconsensus_tpu.obs.trace import Span, Tracer, current_tracer, span
+from scconsensus_tpu.obs.cost import attach_cost, stage_cost_summary
 from scconsensus_tpu.obs.metrics import MetricSet
 from scconsensus_tpu.obs.export import (
     SCHEMA_NAME,
@@ -36,6 +46,8 @@ __all__ = [
     "current_tracer",
     "span",
     "MetricSet",
+    "attach_cost",
+    "stage_cost_summary",
     "SCHEMA_NAME",
     "SCHEMA_VERSION",
     "build_run_record",

@@ -1,0 +1,181 @@
+"""XLA cost attribution: FLOPs/bytes from ``lower().compile()`` on spans.
+
+The MFU probes in ``bench.py`` price two hand-picked kernels at synthetic
+shapes; nothing prices the kernels a *real* run actually dispatched, so the
+ROADMAP's "as fast as the hardware allows" has no denominator on the
+evidence record. This module attaches XLA's own cost model to spans at
+trace time: :func:`attach_cost` asks a jitted callable for
+``lower(*args).compile().cost_analysis()`` at the call's exact shapes and
+accumulates flops / bytes-accessed / transcendentals onto the ambient (or
+given) span, so every run record can report achieved vs. cost-model
+throughput per stage and a regression can be expressed as an efficiency
+loss rather than bare seconds.
+
+Cost is an *estimate* (XLA's static model; fusion means bytes especially
+are approximate) and collection is best-effort: any failure records
+nothing. The AOT lower+compile behind the estimate is paid once per
+(callable, abstract signature) — results are memoized process-wide, and
+the backend compile itself hits the persistent XLA compile cache — but it
+is still real work, so everything is gated behind ``SCC_OBS_COST`` (off by
+default; ``bench.py`` turns it on for its workers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from scconsensus_tpu.config import env_flag
+
+__all__ = [
+    "cost_enabled",
+    "cost_analysis_of",
+    "attach_cost",
+    "stage_cost_summary",
+]
+
+# (callable key, abstract signature) -> {"flops": ..., ...} | None
+_COST_CACHE: Dict[Any, Optional[Dict[str, float]]] = {}
+
+# cost_analysis key -> run-record field (version-tolerant: the bytes key
+# has been both "bytes accessed" and "bytes_accessed" across jaxlibs)
+_FIELDS = (
+    ("flops", "flops"),
+    ("bytes accessed", "bytes_accessed"),
+    ("bytes_accessed", "bytes_accessed"),
+    ("transcendentals", "transcendentals"),
+)
+
+
+def cost_enabled() -> bool:
+    return bool(env_flag("SCC_OBS_COST"))
+
+
+def _abstract(x: Any) -> Any:
+    """Hashable signature element: arrays by shape/dtype, scalars by value."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("arr", tuple(int(s) for s in shape), str(dtype))
+    if isinstance(x, (int, float, bool, str, type(None))):
+        return ("val", x)
+    return ("repr", repr(x))
+
+
+def cost_analysis_of(jitted, *args, **kwargs) -> Optional[Dict[str, float]]:
+    """XLA cost estimate for ``jitted(*args, **kwargs)``; None when the
+    backend/jit build exposes no cost analysis. Memoized per abstract
+    signature, so only the first call at a shape pays the AOT compile."""
+    try:
+        key = (
+            getattr(jitted, "__wrapped__", None) or id(jitted),
+            tuple(_abstract(a) for a in args),
+            tuple(sorted((k, _abstract(v)) for k, v in kwargs.items())),
+        )
+        hash(key)
+    except TypeError:
+        key = None
+    if key is not None and key in _COST_CACHE:
+        return _COST_CACHE[key]
+    out: Optional[Dict[str, float]] = None
+    try:
+        ca = jitted.lower(*args, **kwargs).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            out = {}
+            for src, dst in _FIELDS:
+                v = ca.get(src)
+                if v is not None and dst not in out:
+                    out[dst] = float(v)
+            out = out or None
+    except Exception:
+        out = None
+    if key is not None:
+        _COST_CACHE[key] = out
+    return out
+
+
+def attach_cost(span, jitted, *args, **kwargs) -> Optional[Dict[str, float]]:
+    """Accumulate the kernel's cost estimate onto ``span.attrs["xla_cost"]``
+    (ambient span when ``span`` is None). No-op unless SCC_OBS_COST is on —
+    instrumentation sites call this unconditionally, like obs.trace.span."""
+    if not cost_enabled():
+        return None
+    if span is None:
+        from scconsensus_tpu.obs.trace import current_span
+
+        span = current_span()
+        if span is None:
+            return None
+    ca = cost_analysis_of(jitted, *args, **kwargs)
+    if not ca:
+        return None
+    cur = span.attrs.setdefault(
+        "xla_cost", {"flops": 0.0, "bytes_accessed": 0.0,
+                     "transcendentals": 0.0, "kernels": 0},
+    )
+    for k, v in ca.items():
+        cur[k] = cur.get(k, 0.0) + v
+    cur["kernels"] += 1
+    return ca
+
+
+def _span_cost(s: Dict[str, Any]) -> Optional[Dict[str, float]]:
+    attrs = s.get("attrs") or {}
+    c = attrs.get("xla_cost")
+    return c if isinstance(c, dict) else None
+
+
+def stage_cost_summary(spans: List[Dict[str, Any]]) -> Dict[str, Dict]:
+    """Per-stage achieved-vs-cost-model throughput from a span-record tree.
+
+    For every stage-kind span, sums ``xla_cost`` over the span itself and
+    all descendants, divides by the stage's headline wall (synced when
+    recorded) and aggregates repeated stages by name. Returns
+    ``{stage: {flops, bytes_accessed, transcendentals, kernels, wall_s,
+    achieved_gflops, achieved_gbps}}`` — stages with no costed kernels are
+    omitted, so an empty dict means "no attribution ran", never zeros.
+    """
+    by_id = {s.get("span_id"): s for s in spans if isinstance(s, dict)}
+    children: Dict[Any, List[Dict[str, Any]]] = {}
+    for s in by_id.values():
+        children.setdefault(s.get("parent_id"), []).append(s)
+
+    def _subtree_cost(s) -> Dict[str, float]:
+        tot = {"flops": 0.0, "bytes_accessed": 0.0,
+               "transcendentals": 0.0, "kernels": 0}
+        stack = [s]
+        while stack:
+            cur = stack.pop()
+            c = _span_cost(cur)
+            if c:
+                for k in tot:
+                    tot[k] += c.get(k, 0)
+            stack.extend(children.get(cur.get("span_id"), []))
+        return tot
+
+    out: Dict[str, Dict] = {}
+    for s in by_id.values():
+        if s.get("kind") != "stage":
+            continue
+        cost = _subtree_cost(s)
+        if not cost["kernels"]:
+            continue
+        wall = s.get("wall_synced_s")
+        if wall is None:
+            wall = s.get("wall_submitted_s") or 0.0
+        agg = out.setdefault(
+            s["name"],
+            {"flops": 0.0, "bytes_accessed": 0.0, "transcendentals": 0.0,
+             "kernels": 0, "wall_s": 0.0},
+        )
+        for k in ("flops", "bytes_accessed", "transcendentals", "kernels"):
+            agg[k] += cost[k]
+        agg["wall_s"] += float(wall)
+    for name, agg in out.items():
+        w = agg["wall_s"]
+        agg["wall_s"] = round(w, 4)
+        if w > 0:
+            agg["achieved_gflops"] = round(agg["flops"] / w / 1e9, 3)
+            agg["achieved_gbps"] = round(agg["bytes_accessed"] / w / 1e9, 3)
+    return out

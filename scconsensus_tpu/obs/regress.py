@@ -1,0 +1,494 @@
+"""Noise-aware regression verdicts + numeric-drift sentinels over the ledger.
+
+Two consumers of ``obs.ledger`` history, both machine-verdict producers
+(``tools/perf_gate.py`` is the CLI that hard-fails on them):
+
+Performance gate. Per-stage baselines follow the BASELINE.md round-6
+anchor policy — the **median of the last ≤3 runs** of the same
+(dataset, backend, config_fp) key — with a noise band derived from the
+anchor spread (floored at 10 % of the baseline and 50 ms, because
+single-core hosts showed unexplained process-state variance on the
+record). A synced stage wall beyond baseline + band is a regression; the
+verdict diffs the candidate's span tree against the baseline run's to
+name the offending child span, and when XLA cost attribution ran
+(obs.cost) the verdict also expresses the loss as achieved-throughput
+efficiency, not just seconds.
+
+Drift sentinel. Cross-round numeric shifts (the ``q2q_nbinom`` x=0
+change) used to be attributed by prose notes in CHANGES.md. Here a run's
+numeric fingerprint — DE p-value quantiles, NB dispersion quantiles,
+final-label ARI vs pinned fixtures — is compared against committed pins;
+any shift beyond tolerance must be explicitly acknowledged by a
+machine-readable entry in the drift ledger
+(``evidence/DRIFT_LEDGER.jsonl``) pinning the *new* value, or the gate
+fails. Acknowledging means: append the entry AND update the pin — the
+ledger is the audit trail, the pin is the new contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+__all__ = [
+    "ANCHOR_RUNS",
+    "StageVerdict",
+    "GateVerdict",
+    "stage_baselines",
+    "diff_span_trees",
+    "gate_record",
+    "DRIFT_LEDGER_NAME",
+    "REFERENCE_DATASET",
+    "pins_for_dataset",
+    "drift_fingerprint",
+    "load_drift_acks",
+    "append_drift_ack",
+    "check_drift",
+    "adjusted_rand_index",
+]
+
+ANCHOR_RUNS = 3          # median-of-3 (BASELINE.md measurement policy)
+REL_NOISE_FLOOR = 0.10   # band is never tighter than 10 % of baseline
+ABS_NOISE_FLOOR_S = 0.05  # ...or 50 ms (timer + drain jitter at tiny walls)
+
+
+# --------------------------------------------------------------------------
+# per-stage baselines
+# --------------------------------------------------------------------------
+
+def stage_baselines(history: Sequence[Dict[str, Any]]
+                    ) -> Dict[str, Dict[str, float]]:
+    """Noise-aware per-stage baselines from manifest entries (oldest
+    first). Uses each entry's ``stage_walls``; the anchor set per stage is
+    the last ``ANCHOR_RUNS`` entries that measured that stage. Returns
+    ``{stage: {baseline_s, band_s, n, spread_s}}``."""
+    walls: Dict[str, List[float]] = {}
+    for e in history:
+        for stage, w in (e.get("stage_walls") or {}).items():
+            if isinstance(w, (int, float)) and w >= 0:
+                walls.setdefault(stage, []).append(float(w))
+    out: Dict[str, Dict[str, float]] = {}
+    for stage, ws in walls.items():
+        anchor = sorted(ws[-ANCHOR_RUNS:])
+        n = len(anchor)
+        baseline = anchor[n // 2] if n % 2 else (
+            0.5 * (anchor[n // 2 - 1] + anchor[n // 2])
+        )
+        spread = anchor[-1] - anchor[0]
+        band = max(spread, REL_NOISE_FLOOR * baseline, ABS_NOISE_FLOOR_S)
+        out[stage] = {
+            "baseline_s": round(baseline, 6),
+            "band_s": round(band, 6),
+            "spread_s": round(spread, 6),
+            "n": n,
+        }
+    return out
+
+
+# --------------------------------------------------------------------------
+# span-tree diff (name the offender)
+# --------------------------------------------------------------------------
+
+def _child_walls(spans: Iterable[Dict[str, Any]], stage: str
+                 ) -> Dict[str, float]:
+    """Aggregate descendant walls by span name under every stage-kind span
+    named ``stage``. Child spans of the same name (ladder buckets, chunk
+    loops) sum — the diff compares *where the time went*, not individual
+    iterations."""
+    spans = [s for s in spans if isinstance(s, dict)]
+    children: Dict[Any, List[Dict[str, Any]]] = {}
+    for s in spans:
+        children.setdefault(s.get("parent_id"), []).append(s)
+    out: Dict[str, float] = {}
+    roots = [s for s in spans
+             if s.get("kind") == "stage" and s.get("name") == stage]
+    stack = [c for r in roots for c in children.get(r.get("span_id"), [])]
+    while stack:
+        s = stack.pop()
+        wall = s.get("wall_synced_s")
+        if wall is None:
+            wall = s.get("wall_submitted_s") or 0.0
+        out[s["name"]] = out.get(s["name"], 0.0) + float(wall)
+        stack.extend(children.get(s.get("span_id"), []))
+    return out
+
+
+def diff_span_trees(cand_spans: Sequence[Dict[str, Any]],
+                    base_spans: Sequence[Dict[str, Any]],
+                    stage: str) -> Optional[Dict[str, Any]]:
+    """Name the child span that grew the most under a regressed stage.
+    None when neither tree has children there (the stage itself is the
+    finest attribution available)."""
+    cand = _child_walls(cand_spans, stage)
+    base = _child_walls(base_spans, stage)
+    if not cand and not base:
+        return None
+    deltas = {
+        name: cand.get(name, 0.0) - base.get(name, 0.0)
+        for name in set(cand) | set(base)
+    }
+    name = max(deltas, key=lambda k: deltas[k])
+    return {
+        "span": name,
+        "wall_s": round(cand.get(name, 0.0), 4),
+        "baseline_s": round(base.get(name, 0.0), 4),
+        "delta_s": round(deltas[name], 4),
+    }
+
+
+# --------------------------------------------------------------------------
+# the gate
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class StageVerdict:
+    stage: str
+    wall_s: float
+    baseline_s: float
+    band_s: float
+    regressed: bool
+    excess_s: float = 0.0
+    offender: Optional[Dict[str, Any]] = None
+    efficiency: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None}
+
+
+@dataclasses.dataclass
+class GateVerdict:
+    ok: bool
+    key: Dict[str, str]
+    n_history: int
+    stages: List[StageVerdict]
+    note: Optional[str] = None
+
+    @property
+    def regressions(self) -> List[StageVerdict]:
+        return [s for s in self.stages if s.regressed]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "ok": self.ok,
+            "key": self.key,
+            "n_history": self.n_history,
+            "note": self.note,
+            "regressions": [s.to_dict() for s in self.regressions],
+            "stages": [s.to_dict() for s in self.stages],
+        }
+
+
+def _efficiency(cand_cost: Optional[Dict[str, Any]],
+                base_cost: Optional[Dict[str, Any]],
+                stage: str) -> Optional[Dict[str, Any]]:
+    """Regression as efficiency loss: achieved flops/s now vs baseline.
+    Needs cost attribution on both sides of the same stage."""
+    c = (cand_cost or {}).get(stage)
+    b = (base_cost or {}).get(stage)
+    if not c or not b:
+        return None
+    ca, ba = c.get("achieved_gflops"), b.get("achieved_gflops")
+    if not ca or not ba:
+        return None
+    return {
+        "achieved_gflops": ca,
+        "baseline_gflops": ba,
+        "efficiency_loss": round(1.0 - ca / ba, 4),
+    }
+
+
+def gate_record(candidate: Dict[str, Any],
+                history: Sequence[Dict[str, Any]],
+                baseline_spans: Optional[Sequence[Dict[str, Any]]] = None,
+                baseline_cost: Optional[Dict[str, Any]] = None,
+                ) -> GateVerdict:
+    """Verdict for one candidate run record against its key's history
+    (manifest entries, oldest first, candidate excluded). With no history
+    the gate passes with a note — a first run cannot regress, it *seeds*
+    the baseline."""
+    from scconsensus_tpu.obs.cost import stage_cost_summary
+    from scconsensus_tpu.obs.ledger import run_key, stage_walls
+
+    key = run_key(candidate)
+    if not history:
+        return GateVerdict(ok=True, key=key, n_history=0, stages=[],
+                           note="no baseline history for this key; "
+                                "candidate seeds the baseline")
+    baselines = stage_baselines(history)
+    cand_walls = stage_walls(candidate)
+    cand_cost = stage_cost_summary(candidate.get("spans") or [])
+    stages: List[StageVerdict] = []
+    for stage, wall in sorted(cand_walls.items()):
+        base = baselines.get(stage)
+        if base is None:
+            continue  # new stage: nothing to regress against
+        limit = base["baseline_s"] + base["band_s"]
+        sv = StageVerdict(
+            stage=stage, wall_s=round(wall, 6),
+            baseline_s=base["baseline_s"], band_s=base["band_s"],
+            regressed=wall > limit,
+        )
+        if sv.regressed:
+            sv.excess_s = round(wall - limit, 6)
+            if baseline_spans is not None:
+                sv.offender = diff_span_trees(
+                    candidate.get("spans") or [], baseline_spans, stage
+                )
+            sv.efficiency = _efficiency(cand_cost, baseline_cost, stage)
+        stages.append(sv)
+    ok = not any(s.regressed for s in stages)
+    return GateVerdict(ok=ok, key=key, n_history=len(history),
+                       stages=stages)
+
+
+# --------------------------------------------------------------------------
+# numeric-drift sentinels
+# --------------------------------------------------------------------------
+
+DRIFT_LEDGER_NAME = "DRIFT_LEDGER.jsonl"
+_QUANTILES = (0.01, 0.10, 0.25, 0.50, 0.75, 0.90, 0.99)
+
+
+def _quantiles(values) -> List[float]:
+    import numpy as np
+
+    v = np.asarray(values, dtype=np.float64).ravel()
+    v = v[np.isfinite(v)]
+    if v.size == 0:
+        return []
+    return [round(float(q), 10) for q in np.quantile(v, _QUANTILES)]
+
+
+def adjusted_rand_index(a, b) -> float:
+    """Plain-numpy ARI (Hubert & Arabie) — keeps the sentinel free of an
+    sklearn runtime dependency outside the test suite."""
+    import numpy as np
+
+    a = np.asarray(a).ravel()
+    b = np.asarray(b).ravel()
+    if a.size != b.size:
+        raise ValueError("label arrays differ in length")
+    _, ai = np.unique(a, return_inverse=True)
+    _, bi = np.unique(b, return_inverse=True)
+    n = a.size
+    c = np.zeros((ai.max() + 1, bi.max() + 1), np.int64)
+    np.add.at(c, (ai, bi), 1)
+
+    def comb2(x):
+        return (x * (x - 1)) // 2
+
+    sum_ij = comb2(c).sum()
+    sum_a = comb2(c.sum(axis=1)).sum()
+    sum_b = comb2(c.sum(axis=0)).sum()
+    expected = sum_a * sum_b / max(comb2(n), 1)
+    max_index = 0.5 * (sum_a + sum_b)
+    if max_index == expected:
+        return 1.0
+    return float((sum_ij - expected) / (max_index - expected))
+
+
+def drift_fingerprint(log_p=None, dispersions=None, labels=None,
+                      ref_labels=None) -> Dict[str, Any]:
+    """Per-run numeric fingerprint: the three cross-round quantities whose
+    silent shifts have historically cost diagnosis time. Every field is
+    optional — pass what the run computed."""
+    fp: Dict[str, Any] = {}
+    if log_p is not None:
+        fp["de_logp_q"] = _quantiles(log_p)
+    if dispersions is not None:
+        fp["nb_dispersion_q"] = _quantiles(dispersions)
+    if labels is not None and ref_labels is not None:
+        fp["label_ari"] = round(adjusted_rand_index(labels, ref_labels), 10)
+    return fp
+
+
+def load_drift_acks(path: str) -> List[Dict[str, Any]]:
+    """Acknowledged-drift entries (one JSON object per line; unreadable
+    lines are skipped so a half-appended ack cannot poison the file)."""
+    acks: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(d, dict) and d.get("field"):
+                    acks.append(d)
+    except OSError:
+        pass
+    return acks
+
+
+def append_drift_ack(path: str, field: str, pinned, current,
+                     reason: str) -> Dict[str, Any]:
+    """Append one machine-readable acknowledgement. The entry pins the NEW
+    value: a later run matching it is acknowledged, a further shift is a
+    fresh drift."""
+    entry = {
+        "field": field,
+        "pinned": pinned,
+        "new": current,
+        "reason": reason,
+        "ts": round(time.time(), 3),
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    return entry
+
+
+def _close(a, b, rtol: float, atol: float) -> bool:
+    if isinstance(a, (list, tuple)) or isinstance(b, (list, tuple)):
+        a = a if isinstance(a, (list, tuple)) else [a]
+        b = b if isinstance(b, (list, tuple)) else [b]
+        return len(a) == len(b) and all(
+            _close(x, y, rtol, atol) for x, y in zip(a, b)
+        )
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        if math.isnan(a) or math.isnan(b):
+            return math.isnan(a) and math.isnan(b)
+        return abs(a - b) <= atol + rtol * abs(b)
+    return a == b
+
+
+def check_drift(current: Dict[str, Any], pinned: Dict[str, Any],
+                acks: Sequence[Dict[str, Any]] = (),
+                rtol: float = 1e-3, atol: float = 1e-9
+                ) -> List[Dict[str, Any]]:
+    """Compare a fingerprint against its pins. Returns one machine-readable
+    drift record per shifted field; ``acknowledged`` is True when a drift
+    ledger entry pins the new value (within the same tolerance). Fields
+    present only on one side are drifts too — a sentinel that silently
+    stopped being computed is exactly the failure mode this exists for.
+    Underscore-prefixed pin fields are metadata (the pinned labels array,
+    the workload note), not sentinels."""
+    out: List[Dict[str, Any]] = []
+    for field in sorted(set(current) | set(pinned)):
+        if field.startswith("_"):
+            continue
+        cur, pin = current.get(field), pinned.get(field)
+        if field in current and field in pinned and _close(
+                cur, pin, rtol, atol):
+            continue
+        acked = any(
+            a.get("field") == field and _close(a.get("new"), cur, rtol, atol)
+            for a in acks
+        )
+        out.append({
+            "field": field,
+            "pinned": pin,
+            "current": cur,
+            "acknowledged": acked,
+        })
+    return out
+
+
+# --------------------------------------------------------------------------
+# the pinned reference workload
+# --------------------------------------------------------------------------
+
+def reference_fingerprint(ref_labels=None) -> Dict[str, Any]:
+    """Fingerprint of the pinned reference workload: a fixed tiny synthetic
+    edgeR slow-path run (seeded, single-device CPU shapes) touching every
+    sentinel surface — NB pseudo-counts/dispersions, DE p-values, and the
+    final dynamic-cut labels. This is the run ``NUMERIC_PINS.json`` pins;
+    the tier-1 sentinel test recomputes it and fails on unacknowledged
+    drift. Pass the pinned labels to score ``label_ari`` against them
+    (without, ARI scores against the run's own labels, i.e. 1.0 —
+    the value a pin generation records)."""
+    from scconsensus_tpu.models.pipeline import recluster_de_consensus
+    from scconsensus_tpu.utils.synthetic import (
+        noisy_labeling,
+        synthetic_scrna,
+    )
+
+    data, truth, _ = synthetic_scrna(
+        n_genes=80, n_cells=200, n_clusters=3, n_markers_per_cluster=8,
+        seed=11,
+    )
+    labels = noisy_labeling(truth, 0.05, seed=2)
+    result = recluster_de_consensus(
+        data, labels, method="edgeR", q_val_thrs=0.05, fc_thrs=1.5,
+        deep_split_values=(2,), mesh=None,
+    )
+    final = result.dynamic_labels["deepsplit: 2"]
+    aux = result.de.aux or {}
+    fp = drift_fingerprint(
+        log_p=result.de.log_p,
+        dispersions=aux.get("tagwise_dispersion"),
+        labels=final,
+        ref_labels=final if ref_labels is None else ref_labels,
+    )
+    fp["_final_labels"] = [int(v) for v in final]
+    return fp
+
+
+REFERENCE_DATASET = "reference"
+
+
+def pins_for_dataset(pins_doc: Any, dataset: str
+                     ) -> Optional[Dict[str, Any]]:
+    """NUMERIC_PINS.json is keyed by dataset (``{"<dataset>": {pins}}``),
+    because a fingerprint is only comparable against pins of the SAME
+    workload — scoring a cite8k run against the tiny reference-workload
+    pins would read every real bench record as drift. Returns the pin set
+    for ``dataset``, or None (= no drift check) when none is pinned."""
+    if not isinstance(pins_doc, dict):
+        return None
+    pins = pins_doc.get(dataset)
+    return pins if isinstance(pins, dict) else None
+
+
+def write_pins(path: str) -> Dict[str, Any]:
+    """(Re)generate ``NUMERIC_PINS.json`` from the reference workload
+    (stored under the ``"reference"`` dataset key; pins for other datasets
+    in an existing file are preserved). Updating the pins is half of
+    acknowledging a drift — the other half is the drift-ledger entry
+    (:func:`append_drift_ack`)."""
+    from scconsensus_tpu.obs.export import write_json_atomic
+
+    fp = reference_fingerprint()
+    fp["_workload"] = ("edgeR slow path, synthetic 80x200x3 seed=11, "
+                       "noisy labels seed=2, deep_split=2 — "
+                       "obs.regress.reference_fingerprint")
+    doc: Dict[str, Any] = {}
+    try:
+        with open(path) as f:
+            existing = json.load(f)
+        if isinstance(existing, dict):
+            doc = {k: v for k, v in existing.items()
+                   if isinstance(v, dict)}
+    except (OSError, json.JSONDecodeError):
+        pass
+    doc[REFERENCE_DATASET] = fp
+    write_json_atomic(path, doc)
+    return fp
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="numeric-drift pin tool")
+    ap.add_argument("--write-pins", metavar="PATH",
+                    help="regenerate NUMERIC_PINS.json at PATH")
+    args = ap.parse_args(argv)
+    if args.write_pins:
+        fp = write_pins(args.write_pins)
+        shown = {k: v for k, v in fp.items() if not k.startswith("_")}
+        print(json.dumps(shown, indent=1))
+        return 0
+    ap.error("nothing to do (--write-pins PATH)")
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -22,6 +22,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from scconsensus_tpu.obs import trace as obs_trace
+from scconsensus_tpu.obs.cost import attach_cost
 from scconsensus_tpu.ops.gates import ClusterAggregates
 from scconsensus_tpu.ops.wilcoxon import wilcoxon_pairs_tile
 from scconsensus_tpu.parallel.mesh import (
@@ -83,7 +84,7 @@ def sharded_aggregates(
     mesh = mesh or make_mesh(axis_name=axis_name)
     with obs_trace.span(
         "sharded_aggregates", n_shards=int(mesh.devices.size),
-    ):
+    ) as sp:
         # pad_and_shard keeps a device-resident jax.Array on device (pad +
         # redistribute in HBM); host numpy pads on host and uploads sharded
         # — on a multi-process mesh each process uploads only its
@@ -105,13 +106,15 @@ def sharded_aggregates(
                     [cid_h, np.full(n_pad, -1, np.int32)]
                 )
             cp = put_sharded(cid_h, mesh, P(axis_name))
-            out = _jitted_aggregates_cid(
-                mesh, axis_name, int(n_clusters)
-            )(dp, cp)
+            jitted = _jitted_aggregates_cid(mesh, axis_name, int(n_clusters))
+            attach_cost(sp, jitted, dp, cp)
+            out = jitted(dp, cp)
         else:
             require_dense(onehot)
             op, _ = pad_and_shard(onehot, mesh, P(axis_name), 0)
-            out = _jitted_aggregates(mesh, axis_name)(dp, op)
+            jitted = _jitted_aggregates(mesh, axis_name)
+            attach_cost(sp, jitted, dp, op)
+            out = jitted(dp, op)
         drain_if_cpu_mesh(mesh, *out)
         return ClusterAggregates(*out)
 
@@ -208,10 +211,10 @@ def sharded_allpairs_ranksum(
                     cid_h, ((0, n_pad), (0, 0)), constant_values=-1
                 )
             cid = put_sharded(cid_h, mesh, P(axis_name, None))
-        lp, u, ts = _jitted_allpairs(mesh, axis_name, n_clusters, window,
-                                     cid_2d)(
-            chunk, cid, n_of, pair_i, pair_j
-        )
+        jitted = _jitted_allpairs(mesh, axis_name, n_clusters, window,
+                                  cid_2d)
+        attach_cost(None, jitted, chunk, cid, n_of, pair_i, pair_j)
+        lp, u, ts = jitted(chunk, cid, n_of, pair_i, pair_j)
         # virtual-CPU meshes deadlock with >1 collective program in flight
         drain_if_cpu_mesh(mesh, lp, u, ts)
         return lp[:gc], u[:gc], ts[:gc]
@@ -262,14 +265,14 @@ def sharded_wilcox_logp(
     with obs_trace.span(
         "sharded_wilcox_logp", n_shards=int(mesh.devices.size),
         n_genes=int(G),
-    ):
+    ) as sp:
         # device-resident input pads/redistributes in HBM; host input
         # uploads
         dp, _ = pad_and_shard(data, mesh, P(axis_name, None), 0)
         # replicated small inputs stay host numpy: uncommitted values
         # replicate onto any mesh, where a jnp.asarray would commit to
         # local device 0 and be rejected by a cross-process jit
-        log_p = _jitted_wilcox(mesh, axis_name)(
+        args = (
             dp,
             np.asarray(idx, np.int32),
             np.asarray(m1),
@@ -277,6 +280,9 @@ def sharded_wilcox_logp(
             np.asarray(n1, np.int32),
             np.asarray(n2, np.int32),
         )
+        jitted = _jitted_wilcox(mesh, axis_name)
+        attach_cost(sp, jitted, *args)
+        log_p = jitted(*args)
         return np.asarray(log_p)[:, :G]
 
 

@@ -25,7 +25,7 @@ from scconsensus_tpu.obs.export import (
     atomic_write as _atomic_bytes_writer,
 )
 
-__all__ = ["ArtifactStore", "input_fingerprint"]
+__all__ = ["ArtifactStore", "input_fingerprint", "config_fingerprint"]
 
 # Stage saves atomically via obs.export.atomic_write (the shared
 # mkstemp+fsync+os.replace primitive): a half-written ``de.npz`` would
@@ -68,6 +68,19 @@ def input_fingerprint(data, labels) -> Dict[str, Any]:
         "data_sample_sha": h.hexdigest()[:16],
         "labels_sha": lh,
     }
+
+
+def config_fingerprint(obj: Any, n_hex: int = 12) -> str:
+    """Short, order-independent content hash of a JSON-able value.
+
+    The one fingerprint both stores use: the evidence ledger keys runs by
+    (dataset, backend, config_fp) with it, and it is the canonical way to
+    derive a directory-safe token from a config mapping. Key order never
+    changes the hash; non-JSON leaves degrade via ``str`` (same rule as the
+    artifact sidecars), so a numpy scalar fingerprints like its value.
+    """
+    blob = json.dumps(obj, sort_keys=True, default=str, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:n_hex]
 
 
 class ArtifactStore:

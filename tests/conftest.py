@@ -15,6 +15,16 @@ if not os.environ.get("SCC_TEST_TPU"):
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+# Hermetic evidence ledger: quick bench runs inside the suite (and their
+# subprocesses, which inherit the env) must never ingest test records into
+# the repo's committed evidence/ history.
+if "SCC_EVIDENCE_DIR" not in os.environ:
+    import tempfile as _tempfile
+
+    os.environ["SCC_EVIDENCE_DIR"] = _tempfile.mkdtemp(
+        prefix="scc-test-evidence-"
+    )
+
 # 8-virtual-device flags + collective-rendezvous timeout raises (shared,
 # jax-free bootstrap — see its docstring for the oversubscription
 # rationale). Loaded by file path: importing the package would pull jax in

@@ -1,0 +1,60 @@
+"""Numeric-drift sentinel against the committed pins (ISSUE 3 tentpole):
+the reference workload's fingerprint — DE p-value quantiles, NB
+dispersions, final-label ARI vs the pinned labels — must match
+evidence/NUMERIC_PINS.json, or the shift must be acknowledged in
+evidence/DRIFT_LEDGER.jsonl. A failure here means a change moved NB/DE
+numerics cross-round: either fix it, or acknowledge it with
+regress.append_drift_ack AND regenerate the pins
+(python -m scconsensus_tpu.obs.regress --write-pins evidence/NUMERIC_PINS.json)."""
+
+import json
+import pathlib
+
+import pytest
+
+from scconsensus_tpu.obs import regress
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+PINS = REPO / "evidence" / "NUMERIC_PINS.json"
+DRIFT_LEDGER = REPO / "evidence" / regress.DRIFT_LEDGER_NAME
+
+
+@pytest.fixture(scope="module")
+def pins():
+    assert PINS.exists(), "committed NUMERIC_PINS.json missing"
+    doc = json.loads(PINS.read_text())
+    ref = regress.pins_for_dataset(doc, regress.REFERENCE_DATASET)
+    assert ref, "reference-workload pins missing from NUMERIC_PINS.json"
+    return ref
+
+
+class TestReferenceWorkload:
+    def test_fingerprint_matches_pins_or_is_acknowledged(self, pins):
+        fp = regress.reference_fingerprint(
+            ref_labels=pins.get("_final_labels")
+        )
+        acks = regress.load_drift_acks(str(DRIFT_LEDGER))
+        drifts = regress.check_drift(fp, pins, acks)
+        unacked = [d for d in drifts if not d["acknowledged"]]
+        assert not unacked, (
+            "UNACKNOWLEDGED numeric drift vs pinned fixtures — if the "
+            "change is deliberate, append_drift_ack + regenerate pins: "
+            f"{json.dumps(unacked, indent=1)}"
+        )
+
+    def test_fingerprint_covers_all_three_sentinels(self, pins):
+        # p-value quantiles, NB dispersions, label ARI — all pinned
+        for field in ("de_logp_q", "nb_dispersion_q", "label_ari"):
+            assert field in pins, f"pin {field} missing"
+        assert len(pins["de_logp_q"]) == 7
+        assert pins["label_ari"] == 1.0  # pinned against its own labels
+
+
+class TestDriftLedgerSeed:
+    def test_q2q_history_imported_from_changes_md(self):
+        """The r5 q2q_nbinom x=0 change — previously a CHANGES.md prose
+        note — must exist as a machine-readable ledger entry."""
+        acks = regress.load_drift_acks(str(DRIFT_LEDGER))
+        (entry,) = [a for a in acks if a["field"] == "q2q_nbinom_x0"]
+        assert "r5" in entry["reason"]
+        assert entry["ts"] > 0

@@ -1,0 +1,201 @@
+"""Evidence ledger (obs.ledger): ingest/manifest indexing, run keying,
+lossless legacy upgrade + relocation (ISSUE 3 tentpole acceptance: the
+round-trip must lose no information)."""
+
+import json
+import os
+import pathlib
+
+import pytest
+
+from scconsensus_tpu.obs.export import build_run_record, validate_run_record
+from scconsensus_tpu.obs.ledger import (
+    Ledger,
+    downgrade_legacy,
+    run_key,
+    stage_walls,
+    upgrade_legacy,
+    upgrade_tree,
+)
+from scconsensus_tpu.obs.trace import Tracer
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+# one representative of every known pre-schema artifact shape
+LEGACY_SHAPES = {
+    "BENCH_r01.json": {
+        "n": 1, "cmd": "python bench.py", "rc": 0, "tail": "...",
+        "parsed": {"metric": "26k edgeR", "value": 41.2, "unit": "seconds",
+                   "vs_baseline": 0.728,
+                   "extra": {"platform": "tpu", "config": "flagship"}},
+    },
+    "BENCH_r03.json": {"n": 3, "cmd": "python bench.py", "rc": 124,
+                       "tail": "", "parsed": None},
+    "SCALE_r04_cpu.json": {
+        "configs": {
+            "cite8k": {"metric": "8k", "value": 8.9, "unit": "seconds",
+                       "extra": {"platform": "cpu", "degraded": True}},
+            "tm100k": {"metric": "100k", "value": 100.0, "unit": "seconds",
+                       "extra": {"platform": "cpu"}},
+        },
+    },
+    "MESH_OVERHEAD_r04.json": {
+        "sizes": {"4096": {"mesh8": 1.2, "serial": 0.9, "ratio": 1.33}},
+    },
+    "MULTICHIP_r05.json": {"n_devices": 8, "rc": 0, "ok": True,
+                           "skipped": False, "tail": "log tail"},
+    "PROFILE_r05_cpu_flagship26k.json": {
+        "note": "phase profile", "wall_s": 467.1,
+        "nb_phases_s": {"table0": 100.0},
+    },
+}
+
+
+def _record(value=1.0, config="quick", platform="cpu", created=1000.0):
+    tr = Tracer(sync="off")
+    with tr.span("aggregates"):
+        pass
+    rec = build_run_record(
+        "test metric", value, tracer=tr,
+        extra={"platform": platform, "config": config},
+    )
+    rec["run"]["created_unix"] = created
+    return rec
+
+
+class TestUpgradeRoundTrip:
+    @pytest.mark.parametrize("name", sorted(LEGACY_SHAPES))
+    def test_lossless_roundtrip_per_shape(self, name):
+        original = json.loads(json.dumps(LEGACY_SHAPES[name]))
+        up = upgrade_legacy(original, name, created_unix=123.0)
+        validate_run_record(up)  # every upgrade is a full schema-v1 record
+        assert up["extra"]["legacy_source"] == name
+        assert downgrade_legacy(up) == original  # byte-equal payload
+        # headline extracted when the shape carries one
+        if name == "BENCH_r01.json":
+            assert up["value"] == 41.2
+            assert up["run"]["platform"] == "tpu"
+            assert run_key(up)["backend"] == "tpu"
+
+    def test_schema_record_passes_through_unchanged(self):
+        rec = _record()
+        assert upgrade_legacy(rec, "X.json") is rec
+
+    def test_downgrade_without_payload_raises(self):
+        with pytest.raises(ValueError, match="no legacy payload"):
+            downgrade_legacy(_record())
+
+
+class TestLedger:
+    def test_ingest_indexes_and_persists(self, tmp_path):
+        led = Ledger(str(tmp_path))
+        entry = led.ingest(_record(created=111.0))
+        assert (tmp_path / entry["file"]).exists()
+        assert entry["stage_walls"].keys() == {"aggregates"}
+        # a fresh Ledger over the same dir sees the entry (manifest is disk)
+        led2 = Ledger(str(tmp_path))
+        assert [e["file"] for e in led2.entries()] == [entry["file"]]
+        validate_run_record(led2.load(entry["file"]))
+
+    def test_rejects_legacy_ingest(self, tmp_path):
+        with pytest.raises(ValueError):
+            Ledger(str(tmp_path)).ingest({"metric": "m", "value": 1})
+
+    def test_history_is_key_scoped_and_ordered(self, tmp_path):
+        led = Ledger(str(tmp_path))
+        for created in (300.0, 100.0, 200.0):
+            led.ingest(_record(created=created))
+        other = led.ingest(_record(config="flagship", created=150.0))
+        key = run_key(_record())
+        hist = led.history(key)
+        assert [e["created_unix"] for e in hist] == [100.0, 200.0, 300.0]
+        assert other["file"] not in {e["file"] for e in hist}
+        # exclusion hook (the gate excludes the candidate itself)
+        assert len(led.history(key, exclude_files=[hist[-1]["file"]])) == 2
+
+    def test_key_separates_degraded_runs(self):
+        full = _record()
+        degraded = _record()
+        degraded["extra"]["degraded"] = True
+        assert run_key(full) != run_key(degraded)
+        assert run_key(full) == run_key(_record(value=9.9))  # outcome-blind
+
+    def test_stage_walls_prefers_synced_and_aggregates(self):
+        rec = _record()
+        rec["spans"] = [
+            {"name": "a", "span_id": 0, "parent_id": None, "depth": 0,
+             "kind": "stage", "t0_s": 0.0, "wall_submitted_s": 0.5,
+             "wall_synced_s": 1.0, "synced": True},
+            {"name": "a", "span_id": 1, "parent_id": None, "depth": 0,
+             "kind": "stage", "t0_s": 1.0, "wall_submitted_s": 2.0,
+             "wall_synced_s": None, "synced": False},
+            {"name": "d", "span_id": 2, "parent_id": 0, "depth": 1,
+             "kind": "detail", "t0_s": 0.0, "wall_submitted_s": 0.1,
+             "wall_synced_s": None, "synced": False},
+        ]
+        assert stage_walls(rec) == {"a": 3.0}  # synced + submitted, no detail
+
+    def test_unknown_manifest_version_is_hard_error(self, tmp_path):
+        (tmp_path / "MANIFEST.json").write_text(json.dumps(
+            {"schema": "scc-evidence-manifest", "version": 99, "entries": []}
+        ))
+        with pytest.raises(ValueError, match="unsupported manifest"):
+            Ledger(str(tmp_path))
+
+
+class TestUpgradeTree:
+    def test_relocates_and_is_idempotent(self, tmp_path):
+        for name, payload in LEGACY_SHAPES.items():
+            (tmp_path / name).write_text(json.dumps(payload))
+        native = _record()
+        (tmp_path / "SCALE_native.json").write_text(json.dumps(native))
+        done, skipped = upgrade_tree(str(tmp_path))
+        assert len(done) == len(LEGACY_SHAPES) + 1 and not skipped
+        # root files are gone; evidence/ holds them under original names
+        for name in LEGACY_SHAPES:
+            assert not (tmp_path / name).exists()
+            up = json.load(open(tmp_path / "evidence" / name))
+            assert downgrade_legacy(up) == LEGACY_SHAPES[name]
+        led = Ledger(str(tmp_path / "evidence"))
+        by_file = {e["file"]: e for e in led.entries()}
+        assert by_file["SCALE_native.json"]["source"] == "native"
+        assert by_file["BENCH_r01.json"]["source"] == "legacy-upgrade"
+        # second run: nothing left to relocate
+        done2, _ = upgrade_tree(str(tmp_path))
+        assert done2 == []
+
+    def test_live_working_files_never_relocated(self, tmp_path):
+        """BENCH_CHECKPOINT_* (bench overwrites every run, gitignored) and
+        BENCH_TPU_* (the capture watcher's `captured()` reads the root
+        path mid-campaign) are live targets: relocating or indexing one
+        would break a fresh clone or re-burn a TPU capture window."""
+        for name in ("BENCH_CHECKPOINT_quick.json",
+                     "BENCH_TPU_flagship.json"):
+            (tmp_path / name).write_text(json.dumps(_record()))
+        done, skipped = upgrade_tree(str(tmp_path))
+        assert done == [] and skipped == []
+        assert (tmp_path / "BENCH_CHECKPOINT_quick.json").exists()
+        assert (tmp_path / "BENCH_TPU_flagship.json").exists()
+
+    def test_unreadable_file_skipped_not_fatal(self, tmp_path):
+        (tmp_path / "BENCH_r09.json").write_text("{truncated")
+        (tmp_path / "SCALE_ok.json").write_text(
+            json.dumps({"metric": "m", "value": 1.0})
+        )
+        done, skipped = upgrade_tree(str(tmp_path))
+        assert skipped == ["BENCH_r09.json"] and done == ["SCALE_ok.json"]
+        assert (tmp_path / "BENCH_r09.json").exists()  # left for a human
+
+
+class TestCommittedEvidence:
+    """The repo's own relocated history must stay readable."""
+
+    def test_manifest_entries_resolve_and_downgrade(self):
+        led = Ledger(str(REPO / "evidence"))
+        entries = led.entries()
+        assert len(entries) >= 30, "relocated history went missing"
+        for e in entries:
+            rec = led.load(e["file"])
+            validate_run_record(rec)
+            if e["source"] == "legacy-upgrade":
+                assert isinstance(downgrade_legacy(rec), dict)

@@ -331,3 +331,60 @@ class TestTraceSmoke:
         validate_run_record(rec)
         trace = json.loads((tmp_path / "tr" / "trace.json").read_text())
         assert any(e["ph"] == "X" for e in trace["traceEvents"])
+
+
+# --------------------------------------------------------------------------
+# edge cases: empty spans, zero-sample histograms, CPU-only records
+# (ISSUE 3 satellite)
+# --------------------------------------------------------------------------
+
+class TestMetricsEdgeCases:
+    def test_zero_sample_histogram_exports_cleanly(self):
+        h = Histogram(bounds=[1, 10])
+        d = h.to_dict()
+        assert d == {"type": "histogram", "n": 0, "sum": 0.0,
+                     "min": None, "max": None, "buckets": {}}
+        json.dumps(d)  # JSON-safe without observations
+
+    def test_overflow_only_histogram(self):
+        h = Histogram(bounds=[1.0])
+        h.observe(5.0)
+        assert h.to_dict()["buckets"] == {"+inf": 1}
+
+    def test_unset_gauge_serializes_null(self):
+        assert json.loads(json.dumps(Gauge().to_dict()))["value"] is None
+
+    def test_touched_but_empty_metricset_omitted_from_record(self):
+        tr = Tracer(sync="off")
+        with tr.span("s") as sp:
+            assert sp.metrics.empty()  # touched, nothing registered
+        assert "metrics" not in tr.span_records()[0]
+
+    def test_chrome_trace_of_empty_span_list(self):
+        ct = chrome_trace([])
+        assert [e["ph"] for e in ct["traceEvents"]] == ["M"]
+        json.dumps(ct)
+
+    def test_run_record_without_device_sampler(self):
+        """CPU-only backends have no memory_stats: device.memory is null,
+        the record still validates, serializes, and traces."""
+        tr = Tracer(sync="off")
+        with tr.span("s"):
+            pass
+        rec = build_run_record("cpu-only", 1.0, tracer=tr)
+        assert rec["device"]["memory"] is None
+        validate_run_record(json.loads(json.dumps(rec)))
+        json.dumps(chrome_trace(rec["spans"]))
+
+    def test_tracer_with_no_spans_builds_valid_record(self):
+        tr = Tracer(sync="off")
+        rec = build_run_record("empty run", -1.0, tracer=tr)
+        validate_run_record(rec)
+        assert rec["spans"] == []
+        assert tr.total_s() == 0.0
+
+    def test_histogram_negative_and_nan_free_stats(self):
+        h = Histogram(bounds=[0.0, 1.0])
+        h.observe(-5.0)
+        d = h.to_dict()
+        assert d["min"] == -5.0 and d["buckets"] == {"0.0": 1}

@@ -1,0 +1,121 @@
+"""XLA cost attribution (obs.cost): gating, memoization, span accumulation,
+per-stage summary, and the engine-level wiring (ladder buckets carry
+flops when SCC_OBS_COST is on)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scconsensus_tpu.obs import cost as obs_cost
+from scconsensus_tpu.obs.trace import Tracer
+
+
+@jax.jit
+def _mm(x, y):
+    return x @ y
+
+
+@pytest.fixture
+def cost_on(monkeypatch):
+    monkeypatch.setenv("SCC_OBS_COST", "1")
+
+
+class TestAttachCost:
+    def test_off_by_default_is_noop(self, monkeypatch):
+        monkeypatch.delenv("SCC_OBS_COST", raising=False)
+        tr = Tracer(sync="off")
+        with tr.span("s") as sp:
+            assert obs_cost.attach_cost(sp, _mm, jnp.ones((8, 8)),
+                                        jnp.ones((8, 8))) is None
+        assert "xla_cost" not in tr.span_records()[0].get("attrs", {})
+
+    def test_attaches_and_accumulates(self, cost_on):
+        x = jnp.ones((16, 16))
+        tr = Tracer(sync="off")
+        with tr.span("s") as sp:
+            first = obs_cost.attach_cost(sp, _mm, x, x)
+            obs_cost.attach_cost(sp, _mm, x, x)
+        assert first and first["flops"] > 0
+        c = tr.span_records()[0]["attrs"]["xla_cost"]
+        assert c["kernels"] == 2
+        assert c["flops"] == pytest.approx(2 * first["flops"])
+
+    def test_memoized_per_shape(self, cost_on):
+        x = jnp.ones((32, 32))
+        obs_cost.attach_cost(None, _mm, x, x)  # no span: still warms cache
+        key_hits = obs_cost.cost_analysis_of(_mm, x, x)
+        assert key_hits is not None
+        # a different shape is a different cache entry, not a collision
+        y = jnp.ones((64, 64))
+        assert obs_cost.cost_analysis_of(_mm, y, y)["flops"] > \
+            key_hits["flops"]
+
+    def test_ambient_span_attach(self, cost_on):
+        x = jnp.ones((8, 8))
+        tr = Tracer(sync="off")
+        with tr.span("stage_k"):
+            obs_cost.attach_cost(None, _mm, x, x)
+        assert tr.span_records()[0]["attrs"]["xla_cost"]["kernels"] == 1
+
+    def test_uncosted_callable_degrades_to_none(self, cost_on):
+        assert obs_cost.attach_cost(None, object(), 1) is None
+
+
+class TestStageCostSummary:
+    def _span(self, i, name, parent, kind, wall, flops=None):
+        s = {"name": name, "span_id": i, "parent_id": parent,
+             "depth": 0 if parent is None else 1, "kind": kind,
+             "t0_s": 0.0, "wall_submitted_s": wall,
+             "wall_synced_s": wall if kind == "stage" else None,
+             "synced": kind == "stage"}
+        if flops is not None:
+            s["attrs"] = {"xla_cost": {
+                "flops": flops, "bytes_accessed": flops / 2,
+                "transcendentals": 0.0, "kernels": 1}}
+        return s
+
+    def test_descendant_costs_roll_up_to_stage(self):
+        spans = [
+            self._span(0, "wilcox", None, "stage", 2.0),
+            self._span(1, "bucket", 0, "detail", 1.0, flops=6e9),
+            self._span(2, "bucket", 0, "detail", 0.5, flops=2e9),
+            self._span(3, "tree", None, "stage", 1.0),  # uncosted stage
+        ]
+        out = obs_cost.stage_cost_summary(spans)
+        assert set(out) == {"wilcox"}  # uncosted stages omitted, not zeroed
+        w = out["wilcox"]
+        assert w["flops"] == 8e9 and w["kernels"] == 2
+        assert w["achieved_gflops"] == pytest.approx(4.0)
+
+    def test_empty_spans(self):
+        assert obs_cost.stage_cost_summary([]) == {}
+
+
+class TestEngineWiring:
+    def test_ladder_buckets_carry_flops(self, cost_on, rng):
+        """A dense wilcox run with SCC_OBS_COST=1 must price its rank-sum
+        kernels onto the bucket/chunk spans, and the stage summary must
+        report achieved throughput for the wilcox_test stage."""
+        from scconsensus_tpu import recluster_de_consensus_fast
+        from scconsensus_tpu.utils.synthetic import (
+            noisy_labeling,
+            synthetic_scrna,
+        )
+
+        data, truth, _ = synthetic_scrna(
+            n_genes=60, n_cells=150, n_clusters=2,
+            n_markers_per_cluster=8, seed=3,
+        )
+        res = recluster_de_consensus_fast(
+            data, noisy_labeling(truth, 0.05, seed=1), mesh=None
+        )
+        spans = res.metrics["spans"]
+        costed = [s for s in spans
+                  if s["name"] in ("wilcox_bucket", "wilcox_chunk")
+                  and (s.get("attrs") or {}).get("xla_cost")]
+        assert costed, "no ladder span carried xla_cost"
+        assert all(s["attrs"]["xla_cost"]["flops"] > 0 for s in costed)
+        summ = obs_cost.stage_cost_summary(spans)
+        assert "wilcox_test" in summ
+        assert summ["wilcox_test"]["achieved_gflops"] > 0

@@ -1,0 +1,171 @@
+"""perf_gate CLI + regression verdict logic (ISSUE 3 CI satellite): the
+committed fixture ledger must drive both verdicts — clean exits 0,
+regressed exits nonzero naming the offending child span — and --smoke
+asserts the whole contract in one tier-1 call."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from scconsensus_tpu.obs import regress
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+TOOL = REPO / "tools" / "perf_gate.py"
+FIXTURES = REPO / "tests" / "fixtures" / "perf_gate"
+
+
+def _run(*args):
+    return subprocess.run(
+        [sys.executable, str(TOOL), *args],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+class TestCLI:
+    def test_smoke_passes(self):
+        proc = _run("--smoke")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "SMOKE PASS" in proc.stdout
+
+    def test_clean_candidate_exits_zero(self):
+        proc = _run(str(FIXTURES / "candidate_clean.json"),
+                    "--evidence", str(FIXTURES / "evidence"))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "PASS" in proc.stdout
+
+    def test_regressed_candidate_exits_nonzero_naming_offender(self):
+        proc = _run(str(FIXTURES / "candidate_regressed.json"),
+                    "--evidence", str(FIXTURES / "evidence"), "--json")
+        assert proc.returncode == 1
+        out = json.loads(proc.stdout)
+        assert out["ok"] is False
+        (reg,) = [r for r in out["regressions"]
+                  if r["stage"] == "wilcox_test"]
+        assert reg["offender"]["span"] == "wilcox_bucket"
+        assert reg["efficiency"]["efficiency_loss"] > 0
+        # the drifted fingerprint is flagged, unacknowledged
+        assert any(not d["acknowledged"] for d in out["drift"])
+
+    def test_legacy_candidate_is_usage_error(self, tmp_path):
+        p = tmp_path / "legacy.json"
+        p.write_text(json.dumps({"metric": "m", "value": 1}))
+        proc = _run(str(p), "--evidence", str(FIXTURES / "evidence"))
+        assert proc.returncode == 2
+        assert "upgrade" in proc.stderr
+
+
+class TestBaselines:
+    def test_median_of_three_with_noise_band(self):
+        hist = [{"stage_walls": {"s": w}} for w in (1.0, 1.3, 0.9)]
+        b = regress.stage_baselines(hist)["s"]
+        assert b["baseline_s"] == 1.0  # median, not mean
+        assert b["band_s"] == pytest.approx(0.4)  # spread dominates floors
+        assert b["n"] == 3
+
+    def test_only_last_three_runs_anchor(self):
+        hist = [{"stage_walls": {"s": w}} for w in (9.0, 9.0, 1.0, 1.0, 1.0)]
+        assert regress.stage_baselines(hist)["s"]["baseline_s"] == 1.0
+
+    def test_floors_apply_to_tight_anchors(self):
+        hist = [{"stage_walls": {"s": 2.0}} for _ in range(3)]
+        assert regress.stage_baselines(hist)["s"]["band_s"] == 0.2
+        hist = [{"stage_walls": {"s": 0.01}} for _ in range(3)]
+        assert regress.stage_baselines(hist)["s"]["band_s"] == 0.05
+
+    def test_no_history_passes_with_note(self):
+        rec = {"extra": {}, "run": {}, "spans": [], "unit": "s"}
+        v = regress.gate_record(rec, [])
+        assert v.ok and "seeds the baseline" in v.note
+
+
+class TestDrift:
+    def test_shift_flagged_until_acknowledged(self, tmp_path):
+        pinned = {"label_ari": 1.0, "de_logp_q": [-3.0, -1.0]}
+        current = {"label_ari": 0.8, "de_logp_q": [-3.0, -1.0]}
+        (drift,) = regress.check_drift(current, pinned)
+        assert drift["field"] == "label_ari" and not drift["acknowledged"]
+        ledger = tmp_path / "DRIFT_LEDGER.jsonl"
+        regress.append_drift_ack(str(ledger), "label_ari", 1.0, 0.8,
+                                 reason="deliberate recut change")
+        acks = regress.load_drift_acks(str(ledger))
+        (drift2,) = regress.check_drift(current, pinned, acks)
+        assert drift2["acknowledged"]
+        # a FURTHER shift is fresh drift — the ack pins 0.8, not "anything"
+        (drift3,) = regress.check_drift({"label_ari": 0.5, "de_logp_q":
+                                         [-3.0, -1.0]}, pinned, acks)
+        assert not drift3["acknowledged"]
+
+    def test_missing_field_is_drift(self):
+        drifts = regress.check_drift({}, {"label_ari": 1.0})
+        assert drifts and drifts[0]["current"] is None
+
+    def test_metadata_fields_ignored(self):
+        assert regress.check_drift(
+            {"label_ari": 1.0}, {"label_ari": 1.0, "_workload": "x",
+                                 "_final_labels": [1, 2]}
+        ) == []
+
+    def test_tolerance_is_relative(self):
+        assert regress.check_drift({"q": [100.0]}, {"q": [100.05]}) == []
+        assert regress.check_drift({"q": [100.0]}, {"q": [101.0]})
+
+    def test_pins_are_dataset_keyed(self):
+        """A cite8k fingerprint must never be scored against the tiny
+        reference-workload pins — no pin entry for a dataset means no
+        drift check, not a spurious failure."""
+        doc = {"reference": {"label_ari": 1.0}, "not-a-dict": 3}
+        assert regress.pins_for_dataset(doc, "reference") == \
+            {"label_ari": 1.0}
+        assert regress.pins_for_dataset(doc, "cite8k") is None
+        assert regress.pins_for_dataset(doc, "not-a-dict") is None
+        assert regress.pins_for_dataset(None, "reference") is None
+
+    def test_corrupt_ack_lines_skipped(self, tmp_path):
+        p = tmp_path / "l.jsonl"
+        p.write_text('{"field": "a", "new": 1}\n{trunc\n\n')
+        assert regress.load_drift_acks(str(p)) == [{"field": "a", "new": 1}]
+
+
+class TestARI:
+    def test_matches_sklearn(self, rng):
+        from sklearn.metrics import adjusted_rand_score
+
+        a = rng.integers(0, 4, 200)
+        b = rng.integers(0, 3, 200)
+        assert regress.adjusted_rand_index(a, b) == pytest.approx(
+            adjusted_rand_score(a, b)
+        )
+        assert regress.adjusted_rand_index(a, a) == 1.0
+
+    def test_label_names_do_not_matter(self):
+        assert regress.adjusted_rand_index(
+            ["x", "x", "y"], [5, 5, 9]
+        ) == 1.0
+
+
+class TestSpanDiff:
+    def test_no_children_returns_none(self):
+        spans = [{"name": "s", "span_id": 0, "parent_id": None,
+                  "kind": "stage", "wall_submitted_s": 1.0}]
+        assert regress.diff_span_trees(spans, spans, "s") is None
+
+    def test_offender_is_largest_delta_aggregated_by_name(self):
+        def tree(b1, b2):
+            return [
+                {"name": "s", "span_id": 0, "parent_id": None,
+                 "kind": "stage", "wall_submitted_s": b1 + b2},
+                {"name": "bucket", "span_id": 1, "parent_id": 0,
+                 "kind": "detail", "wall_submitted_s": b1},
+                {"name": "bucket", "span_id": 2, "parent_id": 0,
+                 "kind": "detail", "wall_submitted_s": b2},
+                {"name": "other", "span_id": 3, "parent_id": 0,
+                 "kind": "detail", "wall_submitted_s": 0.1},
+            ]
+
+        off = regress.diff_span_trees(tree(2.0, 2.0), tree(1.0, 1.0), "s")
+        assert off["span"] == "bucket"
+        assert off["delta_s"] == pytest.approx(2.0)

@@ -61,3 +61,90 @@ def test_unknown_schema_name_is_hard_error(tmp_path):
     proc = _run(tmp_path)
     assert proc.returncode != 0
     assert "unknown schema" in (proc.stderr + proc.stdout)
+
+
+# --------------------------------------------------------------------------
+# evidence/-vs-root transition (ISSUE 3 satellite)
+# --------------------------------------------------------------------------
+
+def _mkrec():
+    return build_run_record(
+        "t", 2.0,
+        spans=[{
+            "name": "a", "span_id": 0, "parent_id": None, "depth": 0,
+            "kind": "stage", "t0_s": 0.0, "wall_submitted_s": 0.1,
+            "wall_synced_s": 0.1, "synced": True,
+        }],
+        extra={"platform": "cpu"},
+    )
+
+
+def test_root_level_ingest_warns_deprecation(tmp_path):
+    (tmp_path / "SCALE_r99_root.json").write_text(json.dumps(_mkrec()))
+    proc = _run(tmp_path)
+    assert proc.returncode == 0
+    assert "SCALE_r99_root.json" in proc.stdout
+    assert "DeprecationWarning" in proc.stderr
+    assert "perf_gate.py --upgrade" in proc.stderr
+
+
+def test_evidence_dir_ingest_does_not_warn(tmp_path):
+    ev = tmp_path / "evidence"
+    ev.mkdir()
+    (ev / "SCALE_r99_moved.json").write_text(json.dumps(_mkrec()))
+    proc = _run(tmp_path)
+    assert proc.returncode == 0
+    assert "evidence/SCALE_r99_moved.json" in proc.stdout
+    assert "DeprecationWarning" not in proc.stderr
+
+
+def test_both_locations_render_in_one_table(tmp_path):
+    (tmp_path / "SCALE_r98_root.json").write_text(json.dumps(_mkrec()))
+    ev = tmp_path / "evidence"
+    ev.mkdir()
+    (ev / "SCALE_r99_moved.json").write_text(json.dumps(_mkrec()))
+    proc = _run(tmp_path)
+    assert proc.returncode == 0
+    assert "SCALE_r98_root.json" in proc.stdout
+    assert "evidence/SCALE_r99_moved.json" in proc.stdout
+
+
+def test_relocated_legacy_renders_through_original_shape(tmp_path):
+    """An upgraded driver artifact under evidence/ must render its legacy
+    payload (rc= / parsed=) exactly as it did at the root."""
+    from scconsensus_tpu.obs.ledger import Ledger, upgrade_legacy
+
+    legacy = {"n": 2, "cmd": "bench", "rc": 124, "tail": "",
+              "parsed": {"metric": "m", "value": 3.5, "unit": "seconds",
+                         "extra": {"platform": "tpu"}}}
+    ev = tmp_path / "evidence"
+    Ledger(str(ev)).ingest(
+        upgrade_legacy(legacy, "BENCH_r42.json", created_unix=1.0),
+        name="BENCH_r42.json", source="legacy-upgrade",
+    )
+    proc = _run(tmp_path)
+    assert proc.returncode == 0
+    row = next(l for l in proc.stdout.splitlines()
+               if l.startswith("evidence/BENCH_r42.json"))
+    assert "rc=124" in row and "value=3.5" in row and "platform=tpu" in row
+
+
+def test_manifest_row_summarizes_entries(tmp_path):
+    from scconsensus_tpu.obs.ledger import Ledger
+
+    Ledger(str(tmp_path / "evidence")).ingest(_mkrec())
+    proc = _run(tmp_path)
+    assert proc.returncode == 0
+    assert "evidence/MANIFEST.json" in proc.stdout
+    assert "entries=1" in proc.stdout
+
+
+def test_future_schema_in_evidence_dir_is_hard_error(tmp_path):
+    ev = tmp_path / "evidence"
+    ev.mkdir()
+    rec = _mkrec()
+    rec["schema_version"] = SCHEMA_VERSION + 3
+    (ev / "RUN_future.json").write_text(json.dumps(rec))
+    proc = _run(tmp_path)
+    assert proc.returncode != 0
+    assert "unsupported" in (proc.stderr + proc.stdout)

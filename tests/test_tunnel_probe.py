@@ -1,0 +1,84 @@
+"""tools/tunnel_probe.py hard-timeout contract (ISSUE 3 satellite): a
+wedged probe is KILLED at the per-probe deadline (VERDICT r5: the judge's
+probe hung 45 s until killed by hand), retries back off exponentially, and
+every attempt leaves one structured TUNNEL_LOG.jsonl record."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+TOOL = str(pathlib.Path(__file__).resolve().parents[1] / "tools"
+           / "tunnel_probe.py")
+
+
+def _run(*args, timeout=300):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, TOOL, *args], env=env,
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def _log_records(path):
+    return [json.loads(line) for line in
+            path.read_text().strip().splitlines()]
+
+
+def test_alive_probe_logs_one_attempt(tmp_path):
+    log = tmp_path / "TUNNEL_LOG.jsonl"
+    proc = _run("4", "--log", str(log), "--timeout", "120")
+    assert proc.returncode == 0, proc.stderr[-800:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["alive"] is True and out["platform"] == "cpu"
+    assert out["up_MBps"] > 0 and out["matmul_s"] >= 0
+    (rec,) = _log_records(log)
+    assert rec["outcome"] == "alive" and rec["attempt"] == 1
+    assert rec["probe"]["alive"] is True
+    assert rec["timeout_s"] == 120 and rec["backoff_s"] == 0.0
+    assert rec["ts"].startswith("20")  # ISO timestamp
+
+
+def test_hung_probe_killed_at_hard_timeout_with_backoff(tmp_path):
+    log = tmp_path / "TUNNEL_LOG.jsonl"
+    t0 = time.perf_counter()
+    proc = _run("4", "--log", str(log), "--timeout", "2", "--attempts", "2",
+                "--test-hang-s", "600")
+    wall = time.perf_counter() - t0
+    assert proc.returncode == 1
+    assert wall < 60, f"hard timeout did not bite ({wall:.0f}s)"
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["alive"] is False and "timeout" in out["error"]
+    recs = _log_records(log)
+    assert [r["attempt"] for r in recs] == [1, 2]
+    assert all(r["outcome"] == "timeout" for r in recs)
+    # exponential backoff: logged on every non-final failed attempt
+    assert recs[0]["backoff_s"] == 2.0
+    assert recs[1]["backoff_s"] == 0.0  # last attempt never sleeps
+    assert "backing off" in proc.stderr
+
+
+def test_log_disabled_still_prints_payload(tmp_path):
+    proc = _run("4", "--log", "", "--timeout", "120")
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout.strip().splitlines()[-1])["alive"] is True
+
+
+def test_summarize_reads_attempt_records(tmp_path):
+    """The per-attempt records stay consumable by summarize_evidence's
+    TUNNEL_LOG row (it reads rec['probe']['alive'])."""
+    log = tmp_path / "TUNNEL_LOG.jsonl"
+    _run("4", "--log", str(log), "--timeout", "120")
+    _run("4", "--log", str(log), "--timeout", "2", "--attempts", "1",
+         "--test-hang-s", "600")
+    tool = str(pathlib.Path(TOOL).parent / "summarize_evidence.py")
+    proc = subprocess.run(
+        [sys.executable, tool, str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    row = next(l for l in proc.stdout.splitlines()
+               if l.startswith("TUNNEL_LOG.jsonl"))
+    assert "1 alive / 1 down" in row

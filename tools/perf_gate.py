@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Regression gate over the evidence ledger (plus the one-shot upgrader).
+
+Modes:
+
+  perf_gate.py CANDIDATE.json [--evidence DIR] [--json]
+      Gate one run record against its key's baselines (median-of-3 with a
+      noise band, BASELINE.md policy). Exit 0 = within band, 1 = regressed
+      stage wall or unacknowledged numeric drift, 2 = usage/IO error. A
+      regression names the offending child span (span-tree diff vs the
+      baseline run) and, when XLA cost attribution ran on both sides, the
+      efficiency loss.
+
+  perf_gate.py --smoke
+      Self-test against the committed fixture ledger
+      (tests/fixtures/perf_gate): asserts the clean candidate PASSES, the
+      regressed candidate FAILS naming its offender, and the drift
+      sentinel flags an unacknowledged shift / accepts an acknowledged
+      one. Exit 0 iff every expectation held — wired into tier-1.
+
+  perf_gate.py --upgrade [--root DIR] [--keep-root]
+      One-shot legacy lift: relocate root BENCH_*/SCALE_*/PROFILE_*/
+      MESH_*/MULTICHIP_* artifacts into <root>/evidence as schema-v1
+      records indexed by MANIFEST.json (lossless; see obs.ledger).
+
+Drift workflow: a run record may carry ``extra["numeric_fingerprint"]``
+(obs.regress.drift_fingerprint). When the evidence dir holds
+``NUMERIC_PINS.json``, the gate compares and fails on any shift that has
+no matching acknowledgement in ``DRIFT_LEDGER.jsonl`` — acknowledge with
+``obs.regress.append_drift_ack`` (and update the pin), never with prose.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from scconsensus_tpu.obs import regress  # noqa: E402
+from scconsensus_tpu.obs.export import check_schema_version  # noqa: E402
+from scconsensus_tpu.obs.ledger import (  # noqa: E402
+    Ledger,
+    default_evidence_dir,
+    run_key,
+    upgrade_tree,
+)
+
+PINS_NAME = "NUMERIC_PINS.json"
+FIXTURES = os.path.join(_REPO, "tests", "fixtures", "perf_gate")
+
+
+def _load_json(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _baseline_context(ledger: Ledger, history: List[Dict[str, Any]]
+                      ) -> Tuple[Optional[List[Dict]], Optional[Dict]]:
+    """Span tree + stage-cost table of the freshest baseline run that
+    recorded spans — the tree the offender diff runs against."""
+    for entry in reversed(history):
+        try:
+            rec = ledger.load(entry["file"])
+        except (OSError, ValueError, KeyError):
+            continue
+        spans = rec.get("spans")
+        if spans:
+            return spans, entry.get("stage_cost")
+    return None, None
+
+
+def run_gate(candidate_path: str, evidence_dir: str
+             ) -> Tuple[regress.GateVerdict, List[Dict[str, Any]]]:
+    """(perf verdict, drift records) for one candidate file."""
+    candidate = _load_json(candidate_path)
+    if check_schema_version(candidate, source=candidate_path) == "legacy":
+        raise ValueError(
+            f"{candidate_path}: pre-schema record — upgrade it first "
+            "(perf_gate.py --upgrade)"
+        )
+    ledger = Ledger(evidence_dir)
+    history = ledger.history(
+        run_key(candidate),
+        exclude_files=[os.path.basename(candidate_path)],
+    )
+    base_spans, base_cost = _baseline_context(ledger, history)
+    verdict = regress.gate_record(candidate, history,
+                                  baseline_spans=base_spans,
+                                  baseline_cost=base_cost)
+    drifts: List[Dict[str, Any]] = []
+    fp = (candidate.get("extra") or {}).get("numeric_fingerprint")
+    pins_path = os.path.join(evidence_dir, PINS_NAME)
+    if fp and os.path.exists(pins_path):
+        # pins are keyed by dataset: the reference-workload pins must never
+        # be compared against a cite8k/tm100k fingerprint (every real run
+        # would read as bogus drift). No pin entry for this dataset = no
+        # drift check, not a failure.
+        pins = regress.pins_for_dataset(
+            _load_json(pins_path), run_key(candidate)["dataset"]
+        )
+        if pins:
+            acks = regress.load_drift_acks(
+                os.path.join(evidence_dir, regress.DRIFT_LEDGER_NAME)
+            )
+            drifts = regress.check_drift(fp, pins, acks)
+    return verdict, drifts
+
+
+def _report(verdict: regress.GateVerdict, drifts: List[Dict[str, Any]],
+            as_json: bool) -> int:
+    unacked = [d for d in drifts if not d["acknowledged"]]
+    ok = verdict.ok and not unacked
+    out = verdict.to_dict()
+    out["drift"] = drifts
+    out["ok"] = ok
+    if as_json:
+        print(json.dumps(out, indent=1))
+    else:
+        k = verdict.key
+        print(f"key: dataset={k['dataset']} backend={k['backend']} "
+              f"config_fp={k['config_fp']}  history={verdict.n_history}")
+        if verdict.note:
+            print(f"note: {verdict.note}")
+        for sv in verdict.stages:
+            mark = "REGRESSED" if sv.regressed else "ok"
+            line = (f"  stage {sv.stage:<20} {sv.wall_s:>9.3f}s  "
+                    f"baseline {sv.baseline_s:.3f}s ± {sv.band_s:.3f}s  "
+                    f"{mark}")
+            if sv.regressed and sv.offender:
+                line += (f"  <- {sv.offender['span']} "
+                         f"(+{sv.offender['delta_s']:.3f}s)")
+            if sv.regressed and sv.efficiency:
+                line += (f"  efficiency loss "
+                         f"{sv.efficiency['efficiency_loss']:.1%}")
+            print(line)
+        for d in drifts:
+            state = "acknowledged" if d["acknowledged"] else "UNACKNOWLEDGED"
+            print(f"  drift {d['field']}: pinned={d['pinned']} "
+                  f"current={d['current']}  {state}")
+        print("PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+def _smoke(fixtures: str, as_json: bool) -> int:
+    """Fixture self-test: every expectation below must hold."""
+    evidence = os.path.join(fixtures, "evidence")
+    checks: List[Tuple[str, bool]] = []
+
+    verdict, drifts = run_gate(
+        os.path.join(fixtures, "candidate_clean.json"), evidence
+    )
+    checks.append(("clean candidate passes",
+                   verdict.ok and not [d for d in drifts
+                                       if not d["acknowledged"]]))
+
+    verdict_r, drifts_r = run_gate(
+        os.path.join(fixtures, "candidate_regressed.json"), evidence
+    )
+    reg = verdict_r.regressions
+    checks.append(("regressed candidate fails", not verdict_r.ok))
+    checks.append((
+        "offending child span named",
+        any(s.offender and s.offender.get("span") for s in reg),
+    ))
+    checks.append((
+        "regressed fingerprint drift flagged unacknowledged",
+        any(not d["acknowledged"] for d in drifts_r),
+    ))
+
+    for label, ok in checks:
+        print(f"[smoke] {'ok  ' if ok else 'FAIL'} {label}")
+    ok_all = all(ok for _, ok in checks)
+    print("SMOKE PASS" if ok_all else "SMOKE FAIL")
+    return 0 if ok_all else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="evidence-ledger regression gate")
+    ap.add_argument("candidate", nargs="?", help="run-record JSON to gate")
+    ap.add_argument("--evidence", default=None,
+                    help="ledger dir (default: SCC_EVIDENCE_DIR or "
+                         "<repo>/evidence)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable verdict on stdout")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-test against the committed fixture ledger")
+    ap.add_argument("--fixtures", default=FIXTURES, help=argparse.SUPPRESS)
+    ap.add_argument("--upgrade", action="store_true",
+                    help="one-shot legacy artifact relocation")
+    ap.add_argument("--root", default=_REPO,
+                    help="root dir for --upgrade (default: repo)")
+    ap.add_argument("--keep-root", action="store_true",
+                    help="--upgrade: keep the original root files")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return _smoke(args.fixtures, args.as_json)
+    if args.upgrade:
+        # same dir every other ledger consumer resolves: --evidence, else
+        # SCC_EVIDENCE_DIR, else <root>/evidence
+        dest = args.evidence or default_evidence_dir(args.root)
+        done, skipped = upgrade_tree(args.root, dest=dest,
+                                     keep_root=args.keep_root)
+        print(f"{len(done)} artifact(s) relocated into {dest}, "
+              f"{len(skipped)} skipped")
+        return 0
+    if not args.candidate:
+        ap.error("candidate record required (or --smoke / --upgrade)")
+    evidence = args.evidence or default_evidence_dir(_REPO)
+    try:
+        verdict, drifts = run_gate(args.candidate, evidence)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"perf_gate: {e}", file=sys.stderr)
+        return 2
+    return _report(verdict, drifts, args.as_json)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

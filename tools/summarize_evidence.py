@@ -1,9 +1,17 @@
 #!/usr/bin/env python
-"""Print one table from every benchmark/evidence artifact in the repo root.
+"""Print one table from every benchmark/evidence artifact in the repo.
+
+Artifacts live under ``evidence/`` (the ledger layout: schema-v1 records
+indexed by MANIFEST.json; legacy files relocated there by
+``tools/perf_gate.py --upgrade`` carry their original payload under
+``extra["legacy"]`` and render through their original shape). Root-level
+artifacts are still accepted during the transition — each root ingest
+emits a deprecation warning on stderr pointing at the upgrader.
 
 Covers driver artifacts (BENCH_r*.json: {n, cmd, rc, tail, parsed}),
 watcher TPU evidence (BENCH_TPU_*.json), bench checkpoints
-(BENCH_CHECKPOINT_*.json), and the committed SCALE_/MESH_ evidence files.
+(BENCH_CHECKPOINT_*.json), committed SCALE_/MESH_/MULTICHIP_/PROFILE_
+files, ledger-ingested RUN_*.json records and the manifest itself.
 
 Ingest contract: artifacts carrying the ``scc-run-record`` schema are
 version-checked (obs.export.check_schema_version); an unknown schema name
@@ -19,12 +27,15 @@ import glob
 import json
 import os
 import sys
+from typing import Dict, List, Optional, Tuple
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ROOT = sys.argv[1] if len(sys.argv) > 1 else _REPO
 sys.path.insert(0, _REPO)
 
 from scconsensus_tpu.obs.export import check_schema_version  # noqa: E402
+
+Row = Tuple[str, str]
 
 
 def _fmt(rec: dict) -> str:
@@ -45,6 +56,8 @@ def _fmt(rec: dict) -> str:
         bits.append("PARTIAL")
     if ex.get("wilcox_s") is not None:
         bits.append(f"wilcox_s={ex['wilcox_s']}")
+    if ex.get("stage_throughput"):
+        bits.append(f"costed_stages={len(ex['stage_throughput'])}")
     return "  ".join(str(b) for b in bits)
 
 
@@ -66,81 +79,183 @@ def _load(path: str):
     return d, None
 
 
-def main() -> None:
-    rows = []
-    for path in sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json"))):
-        d, err = _load(path)
-        if err:
-            rows.append((os.path.basename(path), err))
-            continue
-        parsed = d.get("parsed")
-        rows.append((os.path.basename(path),
-                     f"rc={d.get('rc')}  parsed="
-                     + ("null" if parsed is None else _fmt(parsed))))
-    for pat in ("BENCH_TPU_*.json", "BENCH_CHECKPOINT_*.json"):
-        for path in sorted(glob.glob(os.path.join(ROOT, pat))):
-            d, err = _load(path)
-            rows.append((os.path.basename(path), err or _fmt(d)))
-    for path in sorted(glob.glob(os.path.join(ROOT, "SCALE_*.json"))):
-        d, err = _load(path)
-        if err:
-            rows.append((os.path.basename(path), err))
-            continue
-        # three shapes: a single bench record ({"metric", "value", ...}),
-        # {"configs": {name: record}}, or a top-level map of records
-        if "metric" in d and "value" in d:
-            rows.append((os.path.basename(path), _fmt(d)))
-            continue
-        entries = d.get("configs") or {
-            k: v for k, v in d.items()
-            if isinstance(v, dict) and ("metric" in v or "value" in v)
-        }
-        if entries:
-            for cfg, rec in entries.items():
-                rows.append((f"{os.path.basename(path)}:{cfg}", _fmt(rec)))
-        else:
-            rows.append((os.path.basename(path), _fmt(d)))
-    for path in sorted(glob.glob(os.path.join(ROOT, "MESH_*.json"))):
-        d, err = _load(path)
-        if err:
-            rows.append((os.path.basename(path), err))
-            continue
-        for size, rec in d.get("sizes", {}).items():
-            rows.append((
-                f"{os.path.basename(path)}:{size}",
-                f"mesh={rec.get('mesh8')}s serial={rec.get('serial')}s "
-                f"ratio={rec.get('ratio', rec.get('mesh_over_serial'))}",
-            ))
-    tlog = os.path.join(ROOT, "TUNNEL_LOG.jsonl")
-    if os.path.exists(tlog):
-        try:
-            import statistics
+# --------------------------------------------------------------------------
+# per-shape renderers, dispatched on the artifact's (original) name
+# --------------------------------------------------------------------------
 
-            alive = down = 0
-            bw = []
-            with open(tlog) as f:
-                for line in f:
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        continue
-                    p = rec.get("probe") if isinstance(rec, dict) else None
-                    if not isinstance(p, dict):
-                        continue
-                    if p.get("alive"):
-                        alive += 1
-                        if p.get("up_MBps"):
-                            bw.append(float(p["up_MBps"]))
-                    else:
-                        down += 1
-            desc = f"probes: {alive} alive / {down} down"
-            if bw:
-                desc += (f"; up-bandwidth MB/s min={min(bw):.1f} "
-                         f"median={statistics.median(bw):.1f} "
-                         f"max={max(bw):.1f}")
-        except (OSError, ValueError, TypeError) as e:
-            desc = f"unreadable: {e!r}"
-        rows.append(("TUNNEL_LOG.jsonl", desc))
+def _rows_bench_driver(label: str, d: dict) -> List[Row]:
+    parsed = d.get("parsed")
+    return [(label, f"rc={d.get('rc')}  parsed="
+             + ("null" if parsed is None else _fmt(parsed)))]
+
+
+def _rows_scale(label: str, d: dict) -> List[Row]:
+    # three shapes: a single bench record ({"metric", "value", ...}),
+    # {"configs": {name: record}}, or a top-level map of records
+    if "metric" in d and "value" in d:
+        return [(label, _fmt(d))]
+    entries = d.get("configs") or {
+        k: v for k, v in d.items()
+        if isinstance(v, dict) and ("metric" in v or "value" in v)
+    }
+    if entries:
+        return [(f"{label}:{cfg}", _fmt(rec)) for cfg, rec in entries.items()]
+    return [(label, _fmt(d))]
+
+
+def _rows_mesh(label: str, d: dict) -> List[Row]:
+    rows = []
+    for size, rec in d.get("sizes", {}).items():
+        rows.append((
+            f"{label}:{size}",
+            f"mesh={rec.get('mesh8')}s serial={rec.get('serial')}s "
+            f"ratio={rec.get('ratio', rec.get('mesh_over_serial'))}",
+        ))
+    if not rows:
+        rows.append((label, _fmt(d) if "value" in d else
+                     f"keys={sorted(d)[:6]}"))
+    return rows
+
+
+def _rows_generic(label: str, d: dict) -> List[Row]:
+    if "value" in d or "metric" in d:
+        return [(label, _fmt(d))]
+    return [(label, f"keys={sorted(d)[:8]}")]
+
+
+def _rows_for(name: str, d: dict) -> List[Row]:
+    """Dispatch on the artifact's original filename. A relocated legacy
+    artifact (schema envelope with extra.legacy) unwraps first, so the
+    table reads the same before and after the relocation."""
+    label = name
+    ex = d.get("extra") if isinstance(d, dict) else None
+    if isinstance(ex, dict) and isinstance(ex.get("legacy"), dict):
+        d = ex["legacy"]
+        name = ex.get("legacy_source") or name
+    if name.startswith("BENCH_r") and "parsed" in d:
+        return _rows_bench_driver(label, d)
+    if name.startswith("SCALE_"):
+        return _rows_scale(label, d)
+    if name.startswith(("MESH_", "MULTICHIP_")) and "sizes" in d:
+        return _rows_mesh(label, d)
+    return _rows_generic(label, d)
+
+
+_PATTERNS = (
+    "BENCH_r*.json",
+    "BENCH_TPU_*.json",
+    "BENCH_CHECKPOINT_*.json",
+    "SCALE_*.json",
+    "MESH_*.json",
+    "MULTICHIP_*.json",
+    "PROFILE_*.json",
+    "RUN_*.json",
+)
+
+
+def _scan_dir(root: str, prefix: str = "") -> Tuple[List[Row], int]:
+    """Render every evidence artifact under ``root``. The returned count
+    is the number of RELOCATABLE files — live working files
+    (BENCH_CHECKPOINT_*/BENCH_TPU_*, which the upgrader deliberately
+    skips) still render but must not trigger the deprecation nag, since
+    `--upgrade` can never clear them."""
+    from scconsensus_tpu.obs.ledger import is_transient_artifact
+
+    rows: List[Row] = []
+    n = 0
+    seen = set()
+    for pat in _PATTERNS:
+        for path in sorted(glob.glob(os.path.join(root, pat))):
+            if path in seen:
+                continue
+            seen.add(path)
+            if not is_transient_artifact(path):
+                n += 1
+            name = os.path.basename(path)
+            d, err = _load(path)
+            if err:
+                rows.append((prefix + name, err))
+                continue
+            if not isinstance(d, dict):
+                rows.append((prefix + name, f"unexpected type "
+                             f"{type(d).__name__}"))
+                continue
+            rows.extend(
+                (prefix + label, desc) for label, desc in _rows_for(name, d)
+            )
+    return rows, n
+
+
+def _tunnel_row(root: str) -> Optional[Row]:
+    tlog = os.path.join(root, "TUNNEL_LOG.jsonl")
+    if not os.path.exists(tlog):
+        return None
+    try:
+        import statistics
+
+        alive = down = 0
+        bw = []
+        with open(tlog) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                p = rec.get("probe") if isinstance(rec, dict) else None
+                if not isinstance(p, dict):
+                    continue
+                if p.get("alive"):
+                    alive += 1
+                    if p.get("up_MBps"):
+                        bw.append(float(p["up_MBps"]))
+                else:
+                    down += 1
+        desc = f"probes: {alive} alive / {down} down"
+        if bw:
+            desc += (f"; up-bandwidth MB/s min={min(bw):.1f} "
+                     f"median={statistics.median(bw):.1f} "
+                     f"max={max(bw):.1f}")
+    except (OSError, ValueError, TypeError) as e:
+        desc = f"unreadable: {e!r}"
+    return ("TUNNEL_LOG.jsonl", desc)
+
+
+def _manifest_row(ev_dir: str) -> Optional[Row]:
+    path = os.path.join(ev_dir, "MANIFEST.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        m = json.load(open(path))
+        entries = m.get("entries", [])
+        keys = {json.dumps(e.get("key"), sort_keys=True) for e in entries}
+        desc = (f"entries={len(entries)} keys={len(keys)} "
+                f"version={m.get('version')}")
+    except (OSError, ValueError) as e:
+        desc = f"unreadable: {e!r}"
+    return ("evidence/MANIFEST.json", desc)
+
+
+def main() -> None:
+    rows: List[Row] = []
+    root_rows, n_root = _scan_dir(ROOT)
+    rows.extend(root_rows)
+    if n_root:
+        print(
+            f"DeprecationWarning: {n_root} root-level evidence file(s) "
+            f"under {ROOT} — relocate into evidence/ with "
+            "`python tools/perf_gate.py --upgrade`",
+            file=sys.stderr,
+        )
+    ev_dir = os.path.join(ROOT, "evidence")
+    if os.path.isdir(ev_dir):
+        mrow = _manifest_row(ev_dir)
+        if mrow:
+            rows.append(mrow)
+        ev_rows, _ = _scan_dir(ev_dir, prefix="evidence/")
+        rows.extend(ev_rows)
+    trow = _tunnel_row(ROOT)
+    if trow:
+        rows.append(trow)
     width = max(len(r[0]) for r in rows) if rows else 0
     for name, desc in rows:
         print(f"{name:<{width}}  {desc}")

@@ -33,9 +33,14 @@ while true; do
     echo "$(date +%H:%M:%S) DEADLINE reached, exiting" >> $LOG; exit 0
   fi
   if all_done; then echo "$(date +%H:%M:%S) ALL CAPTURED" >> $LOG; exit 0; fi
+  # tunnel_probe appends one structured record PER ATTEMPT to
+  # TUNNEL_LOG.jsonl itself (hard per-probe timeout + logged backoff) —
+  # the watcher must NOT append its own wrapper record too, or every
+  # probe double-counts in summarize_evidence's alive/down tally. The
+  # outer timeout is the last-resort kill for a wedged probe PARENT;
+  # attempts it already completed are logged.
   probe=$(timeout 240 python tools/tunnel_probe.py 16 2>/dev/null | tail -1)
-  # one validation pass: emits "<plat>\t<canonical json>" only for real JSON,
-  # so a killed-mid-write probe can never corrupt TUNNEL_LOG.jsonl
+  # one validation pass: emits "<plat>\t<canonical json>" only for real JSON
   parsed=$(echo "$probe" | python -c "import json,sys
 try:
     d = json.loads(sys.stdin.read())
@@ -44,18 +49,10 @@ except Exception:
     pass" 2>/dev/null)
   plat=${parsed%%$'\t'*}
   pjson=${parsed#*$'\t'}
-  # a failed probe produces no JSON: record that too, so an all-day outage
-  # leaves committed evidence, not just silence. Distinguish a hang (no
-  # output at all — killed by the timeout) from fast-fail garbage output.
   if [ -z "$pjson" ]; then
-    if [ -z "$probe" ]; then
-      pjson='{"alive": false, "error": "probe hang/timeout (no output; killed by probe timeout)"}'
-    else
-      pjson='{"alive": false, "error": "probe returned non-JSON output (fast failure; see /tmp/tpu_capture.log)"}'
-    fi
+    pjson='{"alive": false, "error": "probe parent produced no JSON (killed by outer timeout; per-attempt records are in TUNNEL_LOG.jsonl)"}'
   fi
   echo "$(date +%H:%M:%S) probe plat=$plat $pjson" >> $LOG
-  echo "{\"ts\": \"$(date -Is)\", \"probe\": $pjson}" >> "$REPO_ROOT/TUNNEL_LOG.jsonl"
   if [ -n "$plat" ] && [ "$plat" != "cpu" ]; then
     for cfg in $CFGS; do
       captured "$cfg" && continue

