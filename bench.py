@@ -176,6 +176,29 @@ def _ckpt_path() -> str:
     return os.path.join(_evidence_dir(), f"BENCH_CHECKPOINT_{name}.json")
 
 
+def _live_base() -> str:
+    """Flight-recorder path base: the checkpoint path minus extension, so
+    `<ckpt>_heartbeat.jsonl` / `<ckpt>_partial.json` sit next to the
+    checkpoint and the orchestrator can derive the stream path without a
+    side channel."""
+    return os.path.splitext(_ckpt_path())[0]
+
+
+# the worker's flight recorder (obs.live); None until worker() starts it
+_LIVE = None
+
+
+def _flush_live(cause: str) -> None:
+    """Best-effort partial-record flush of the worker's recorder — called
+    from the SIGTERM path, so it must never raise."""
+    try:
+        from scconsensus_tpu.obs.live import flush_active
+
+        flush_active(cause)
+    except Exception:
+        pass
+
+
 def _write_ckpt(record: dict) -> None:
     try:
         path = _ckpt_path()
@@ -633,6 +656,9 @@ def _install_term_handler(record_fn) -> None:
 
     def _on_term(signum, frame):  # pragma: no cover - signal path
         try:
+            # the flight recorder's partial record first: it carries the
+            # open-span stack of the moment the TERM landed
+            _flush_live("signal")
             rec = record_fn()
             rec.setdefault("extra", {})["partial"] = True
             rec["extra"]["terminated"] = True
@@ -698,12 +724,42 @@ def _stamp_fingerprint(extra: dict, result) -> None:
 
 
 def worker() -> None:
-    # test hook: simulate a hung backend init (worker dies having written
-    # nothing, so recovery must come from a prior checkpoint)
+    """Measurement entry, wrapped in the live flight recorder (obs.live):
+    heartbeats + the incrementally flushed partial record cover the whole
+    worker life INCLUDING backend init (the historical hang site), and the
+    orchestrator watchdog reads the stream as its primary liveness
+    signal."""
+    global _LIVE
+    # test hook: simulate a hung backend init (worker dies having produced
+    # nothing — not even heartbeats — so the orchestrator must catch it
+    # through the no-heartbeat fallback signals)
     hang = float(env_flag("SCC_BENCH_HANG"))
     if hang:
         time.sleep(hang)
+    # heartbeats default ON for bench workers (like SCC_OBS_COST below);
+    # the in-process stall watchdog dumps stacks at half the orchestrator
+    # window, so the stream holds the wedged stack before the reap
+    os.environ.setdefault("SCC_OBS_HEARTBEAT", "5")
+    os.environ.setdefault("SCC_OBS_STALL_S", str(
+        max(60.0, float(env_flag("SCC_BENCH_STALL_S")) / 2)
+    ))
+    from scconsensus_tpu.obs.live import LiveRecorder
 
+    _LIVE = LiveRecorder(
+        _live_base(), metric="bench flight record",
+        extra={"config": env_flag("SCC_BENCH_CONFIG")},
+    ).start()
+    ok = False
+    try:
+        _worker_body()
+        ok = True
+    finally:
+        # a clean pass overwrites the standing crash-stamped partial; an
+        # exception leaves cause="crash" with the open-span stack
+        _LIVE.stop("clean" if ok else "crash")
+
+
+def _worker_body() -> None:
     # cost attribution on by default for bench workers: the run record's
     # stages carry XLA cost_analysis flops/bytes, so the ledger can report
     # achieved vs. cost-model throughput (one memoized AOT compile per
@@ -734,6 +790,8 @@ def worker() -> None:
         f" degraded={degraded}")
     extra = {"platform": platform, "config": name, "degraded": degraded,
              "backend_init_s": round(init_s, 1)}
+    if _LIVE is not None:  # refine the stream's run key now the backend
+        _LIVE.annotate(platform=platform, degraded=degraded)  # answered
 
     if kind == "brain1m":
         bn = 100_000 if degraded else 1_000_000  # CPU fallback stays bounded
@@ -761,6 +819,8 @@ def worker() -> None:
 
         b1m_state = {"secs": None, "phase": "cold", "spans": None}
         _install_term_handler(lambda: _b1m_record(b1m_state["secs"]))
+        if _LIVE is not None:
+            _LIVE.record_fn = lambda: _b1m_record(b1m_state["secs"])
         once = run_brain1m(n_cells=bn)
         cold_s, cold_info, cold_spans = once()
         log(f"[bench] cold run: {cold_s:.2f}s {cold_info}")
@@ -858,6 +918,8 @@ def worker() -> None:
             }
 
         _install_term_handler(_record)
+        if _LIVE is not None:  # partial flushes carry the cumulative record
+            _LIVE.record_fn = _record
         _ckpt()  # records platform + backend init before any heavy work
 
         # headline: the literal north-star workload — slow-path edgeR
@@ -950,6 +1012,8 @@ def worker() -> None:
 
     refine_state = {"secs": None, "phase": "cold", "spans": None}
     _install_term_handler(lambda: _refine_record(refine_state["secs"]))
+    if _LIVE is not None:
+        _LIVE.record_fn = lambda: _refine_record(refine_state["secs"])
     once = run_refine_config(**cfg, **refine_kw)
     cold_s, cold_res = once()
     log(f"[bench] cold run (includes XLA compiles): {cold_s:.2f}s")
@@ -1006,6 +1070,45 @@ def worker() -> None:
 
 # handle of the currently-running worker, for the SIGTERM emergency path
 _CURRENT_WORKER: "subprocess.Popen | None" = None
+
+
+def _heartbeat_progress(hb_path: str,
+                        min_unix: float) -> "tuple[float, float] | None":
+    """(progress_unix, line_ts) from the flight-recorder stream's tail, or
+    None when no stream fresh for THIS attempt exists. This is the
+    watchdog's PRIMARY liveness signal: unlike cache-dir mtimes it cannot
+    be faked by an unrelated JAX process, and unlike raw file mtime it
+    distinguishes "sampler thread alive" from "run thread making
+    progress" — a worker wedged inside a dead device RPC keeps
+    heartbeating (the C++ wait releases the GIL) with a frozen
+    ``progress_unix``, which is exactly a stall. ``line_ts`` lets the
+    caller notice the STREAM itself going quiet (sampler dead, disk
+    full), which re-engages the fallback signals."""
+    try:
+        from scconsensus_tpu.obs.live import read_heartbeat_tail
+
+        tail = read_heartbeat_tail(hb_path)
+    except Exception:
+        return None
+    if not tail:
+        return None
+    ts = float(tail.get("ts") or 0.0)
+    if ts < min_unix:
+        return None  # stale stream from a previous attempt/run
+    kind = tail.get("t")
+    if kind == "hb":
+        return float(tail.get("progress_unix") or ts), ts
+    if kind == "stall":
+        # the stall event's own ts is NOT progress; back out the moment
+        # progress actually stopped
+        return ts - float(tail.get("since_progress_s") or 0.0), ts
+    # header / annotate / end: the line itself is fresh worker activity
+    return ts, ts
+
+
+# How quiet the heartbeat stream may go before the orchestrator stops
+# trusting it as the sole liveness signal and re-engages the fallbacks.
+_HB_QUIET_S = 60.0
 
 
 def _last_json_line(text: str) -> dict | None:
@@ -1150,6 +1253,9 @@ def _run_attempt(label: str, env_over: dict, timeout_s: int):
             outcome = None
             err_size = [0]
             err_grew = [0.0]
+            from scconsensus_tpu.obs.live import heartbeat_path
+
+            hb_path = heartbeat_path(_live_base())
             while proc.poll() is None:
                 if time.perf_counter() >= deadline:
                     outcome = "timeout"
@@ -1159,31 +1265,45 @@ def _run_attempt(label: str, env_over: dict, timeout_s: int):
                     activity = max(activity, os.path.getmtime(_ckpt_path()))
                 except OSError:
                     pass
-                # a compiling worker emits no stdout/checkpoints for minutes:
-                # count fresh persistent-cache entries and stderr growth
-                # (stage logs) as liveness too. The cache dir is private to
-                # this attempt (hardlink-warmed above), so only THIS
-                # worker's compiles count; entries older than the attempt
-                # (the warm-start links keep their source mtimes) are not
-                # life either.
-                try:
-                    activity = max(activity, max(
-                        (m for m in (
-                            e.stat().st_mtime
-                            for e in os.scandir(attempt_cache)
-                        ) if m >= t0_wall),
-                        default=0.0,
-                    ))
-                except OSError:
-                    pass
-                try:
-                    sz = os.fstat(errf.fileno()).st_size
-                    if sz != err_size[0]:
-                        err_size[0] = sz
-                        err_grew[0] = time.time()
-                    activity = max(activity, err_grew[0])
-                except OSError:
-                    pass
+                # PRIMARY liveness signal: the worker's flight-recorder
+                # heartbeat stream (progress_unix = span transitions +
+                # compile events, sampled in-process by obs.live). While
+                # the stream is actively written, the indirect fallbacks
+                # below are demoted; if it goes quiet (> _HB_QUIET_S —
+                # sampler dead, stream unwritable) or never appeared
+                # (SCC_OBS_HEARTBEAT=0, hung interpreter startup), they
+                # re-engage so a silent stream cannot get a live worker
+                # reaped.
+                hb = _heartbeat_progress(hb_path, t0_wall)
+                if hb is not None:
+                    activity = max(activity, hb[0])
+                hb_fresh = (hb is not None
+                            and time.time() - hb[1] < _HB_QUIET_S)
+                if not hb_fresh:
+                    # FALLBACK: fresh persistent-cache entries + stderr
+                    # growth. The cache dir is private to this attempt
+                    # (hardlink-warmed above), so only THIS worker's
+                    # compiles count; entries older than the attempt (the
+                    # warm-start links keep their source mtimes) are not
+                    # life either.
+                    try:
+                        activity = max(activity, max(
+                            (m for m in (
+                                e.stat().st_mtime
+                                for e in os.scandir(attempt_cache)
+                            ) if m >= t0_wall),
+                            default=0.0,
+                        ))
+                    except OSError:
+                        pass
+                    try:
+                        sz = os.fstat(errf.fileno()).st_size
+                        if sz != err_size[0]:
+                            err_size[0] = sz
+                            err_grew[0] = time.time()
+                        activity = max(activity, err_grew[0])
+                    except OSError:
+                        pass
                 if time.time() - activity > stall_s:
                     outcome = "stall"
                     break
@@ -1216,6 +1336,25 @@ def _run_attempt(label: str, env_over: dict, timeout_s: int):
                 partial = _best_partial(stdout, t0_wall)
                 failure = {"attempt": label, "outcome": outcome,
                            "timeout_s": timeout_s, "stderr_tail": _err_tail()}
+                hb_tail = None
+                try:
+                    from scconsensus_tpu.obs.live import read_heartbeat_tail
+
+                    hb_tail = read_heartbeat_tail(hb_path)
+                except Exception:
+                    pass
+                if hb_tail and float(hb_tail.get("ts") or 0) >= t0_wall:
+                    # post-mortem: where the worker was when it was reaped
+                    opens = hb_tail.get("open_spans") or []
+                    failure["heartbeat"] = {
+                        "last_t": hb_tail.get("t"),
+                        "age_s": round(
+                            time.time() - float(hb_tail.get("ts") or 0), 1
+                        ),
+                        "since_progress_s": hb_tail.get("since_progress_s"),
+                        "last_span": opens[-1]["name"] if opens else None,
+                        "stalls": hb_tail.get("stalls"),
+                    }
                 if _record_value(partial) > 0:
                     partial.setdefault("extra", {})["attempt"] = label
                     partial["extra"]["partial"] = True

@@ -61,6 +61,21 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "Attach XLA cost_analysis (FLOPs/bytes) to jitted kernel "
                 "spans at trace time (obs.cost); one memoized AOT compile "
                 "per kernel shape. bench.py workers enable it."),
+        EnvFlag("SCC_OBS_HEARTBEAT", float, 0.0,
+                "Live flight recorder (obs.live): heartbeat tick interval "
+                "in seconds (0 = off). Each tick appends one JSONL line "
+                "(open-span stack, RSS/HBM, compile stats) to the run's "
+                "*_heartbeat.jsonl stream. bench.py workers default it on."),
+        EnvFlag("SCC_OBS_STALL_S", float, 0.0,
+                "In-process stall watchdog window (seconds; 0 = off): with "
+                "no span transition / compile progress for this long, the "
+                "recorder dumps all-thread stacks into the heartbeat "
+                "stream, bumps the stall counter, and (with "
+                "SCC_OBS_STALL_TRACE set) opens a profiler capture."),
+        EnvFlag("SCC_OBS_STALL_TRACE", str, None,
+                "Directory for on-demand jax.profiler capture windows "
+                "(stall escalation and SIGUSR1 both write here; unset = "
+                "no capture, stack dumps only)."),
         EnvFlag("SCC_EVIDENCE_DIR", str, None,
                 "Evidence-ledger directory override (default <cwd>/evidence"
                 "; bench.py anchors it next to itself). The test suite "

@@ -112,32 +112,48 @@ class EdgerPairResult:
 
 
 class _PhaseProfiler:
-    """SCC_EDGER_PROFILE=1 (env-flag registry, config.py): per-phase
-    wall-clocks for the NB driver, with a device sync at each boundary (so
-    async dispatch can't smear phases). Phase walls additionally land as
-    gauges on the ambient tracer span (the edger_nb stage), so a profiled
-    bench run carries them in its run record, not just on stderr.
-    Zero overhead when disabled — no syncs, no timing."""
+    """NB-driver phase marks as ambient ``obs.trace`` child spans.
+
+    This used to be the repo's third private profiler (stderr prints +
+    ad-hoc gauges behind SCC_EDGER_PROFILE). Each ``mark(label)`` now
+    closes the phase that began at the previous mark and records it as a
+    completed ``detail`` child span (``edger_<label>``) of the ambient
+    span — so NB phase walls ride run records, heartbeat open-span
+    context, and Chrome traces like every other span, on EVERY traced run.
+
+    Sync semantics follow the tracer policy for detail spans: phase
+    boundaries device-drain only when SCC_EDGER_PROFILE=1 (the classic
+    synced stderr profile) or under SCC_TRACE_SYNC=all; the default traced
+    run records dispatch-interval walls with ``synced=False`` and pays no
+    drains. With no ambient tracer and the flag off, ``mark`` is free."""
 
     def __init__(self) -> None:
         from scconsensus_tpu.config import env_flag
+        from scconsensus_tpu.obs.trace import current_tracer
 
-        self.enabled = bool(env_flag("SCC_EDGER_PROFILE"))
+        self.print_enabled = bool(env_flag("SCC_EDGER_PROFILE"))
+        self._tracer = current_tracer()
+        self._sync = self.print_enabled or (
+            self._tracer is not None and self._tracer.sync == "all"
+        )
+        self.enabled = self.print_enabled or self._tracer is not None
         self._t = time.perf_counter() if self.enabled else 0.0
 
     def mark(self, label: str) -> None:
         if not self.enabled:
             return
-        from scconsensus_tpu.obs.trace import device_drain
+        if self._sync:
+            from scconsensus_tpu.obs.trace import device_drain
 
-        device_drain()  # phase boundary: retire the queued phase work
+            device_drain()  # phase boundary: retire the queued phase work
         now = time.perf_counter()
-        print(f"[edger-profile] {label}: {now - self._t:.3f}s", flush=True)
-        from scconsensus_tpu.obs.trace import current_span
-
-        sp = current_span()
-        if sp is not None:
-            sp.metrics.gauge(f"phase_{label}_s").set(round(now - self._t, 4))
+        wall = now - self._t
+        if self._tracer is not None:
+            self._tracer.add_completed_span(
+                f"edger_{label}", wall, kind="detail", synced=self._sync
+            )
+        if self.print_enabled:
+            print(f"[edger-profile] {label}: {wall:.3f}s", flush=True)
         self._t = now
 
 

@@ -20,15 +20,27 @@ Four layers, consumed together through one versioned run-record schema:
   * ``obs.regress`` — noise-aware per-stage baselines (median-of-3,
     BASELINE.md policy), regression verdicts with span-tree offender
     diffs, and the numeric-drift sentinels + drift-acknowledgement
-    ledger (``tools/perf_gate.py`` is the CLI).
+    ledger (``tools/perf_gate.py`` is the CLI);
+  * ``obs.live``   — the flight recorder: heartbeat JSONL stream,
+    in-process stall watchdog with faulthandler stack dumps (and
+    on-demand profiler captures), crash-safe incremental partial run
+    records stamped with a termination cause (``tools/tail_run.py``
+    renders the stream live).
 
 ``utils.logging.StageTimer`` remains as a thin back-compat shim over
 ``Tracer``; ``bench.py`` and the ``tools/`` emitters all build their
 artifacts through ``obs.export.build_run_record``.
 """
 
-from scconsensus_tpu.obs.trace import Span, Tracer, current_tracer, span
+from scconsensus_tpu.obs.trace import (
+    Span,
+    Tracer,
+    current_tracer,
+    last_tracer,
+    span,
+)
 from scconsensus_tpu.obs.cost import attach_cost, stage_cost_summary
+from scconsensus_tpu.obs.live import LiveRecorder, active_recorder, flush_active
 from scconsensus_tpu.obs.metrics import MetricSet
 from scconsensus_tpu.obs.export import (
     SCHEMA_NAME,
@@ -44,7 +56,11 @@ __all__ = [
     "Span",
     "Tracer",
     "current_tracer",
+    "last_tracer",
     "span",
+    "LiveRecorder",
+    "active_recorder",
+    "flush_active",
     "MetricSet",
     "attach_cost",
     "stage_cost_summary",

@@ -37,6 +37,17 @@ __all__ = [
 # memory
 # --------------------------------------------------------------------------
 
+def _backend_initialized() -> bool:
+    """Whether some jax backend has ALREADY initialized (without
+    triggering one). Best-effort over a private registry; unknown jax
+    internals degrade to True (the pre-guard behavior)."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        return True
+
 def memory_snapshot(device=None) -> Optional[Dict[str, int]]:
     """Live/peak device memory of one device (default: first local device).
     None when no backend is up or the backend has no memory_stats (CPU)."""
@@ -48,6 +59,12 @@ def memory_snapshot(device=None) -> Optional[Dict[str, int]]:
             # not trigger backend/plugin init just to sample memory
             return None
         jax = sys.modules["jax"]
+        if device is None and not _backend_initialized():
+            # jax imported but no backend up yet: local_devices() would
+            # INITIALIZE one — from the flight recorder's sampler thread
+            # that means a surprise (possibly hanging, on a dead tunnel)
+            # backend init the run never asked for
+            return None
 
         d = device if device is not None else jax.local_devices()[0]
         ms = d.memory_stats()

@@ -21,6 +21,14 @@ schema ``scc-run-record`` version 1 — top-level keys:
                      host_peak_rss_bytes, compile: {events, total_s, ...}?,
                      transfers: TransferWatch.report()?}
   extra             free-form emitter extras (legacy ``extra`` dict)
+  termination       OPTIONAL (still schema version 1 — additive): stamped
+                    by the live flight recorder (obs.live) on incrementally
+                    flushed partial records. {cause: clean|signal|stall|
+                    crash, last_span: str|null, open_spans: [...],
+                    stall_count, heartbeat_path?, flushed_unix}. Absent on
+                    records written by a clean single-shot emitter; any
+                    cause other than "clean" marks the record PARTIAL —
+                    ledger-ingestible but never a regression baseline.
 
 The Chrome trace export (:func:`chrome_trace`) converts the span tree to
 ``traceEvents`` complete ("X") events — open the file in Perfetto
@@ -38,6 +46,7 @@ from typing import Any, Dict, List, Optional
 __all__ = [
     "SCHEMA_NAME",
     "SCHEMA_VERSION",
+    "TERMINATION_CAUSES",
     "build_run_record",
     "validate_run_record",
     "check_schema_version",
@@ -48,6 +57,13 @@ __all__ = [
 
 SCHEMA_NAME = "scc-run-record"
 SCHEMA_VERSION = 1
+
+# The only admissible termination.cause values: "clean" (the run finished
+# and said so), "signal" (SIGTERM-style external stop), "stall" (the
+# in-process watchdog fired and the process was later reaped), "crash"
+# (the periodic flush's standing stamp — if this file is the last evidence,
+# the process died with no handler running).
+TERMINATION_CAUSES = ("clean", "signal", "stall", "crash")
 
 
 def _device_section(tracer=None,
@@ -173,6 +189,20 @@ def validate_run_record(rec: Dict[str, Any]) -> None:
             raise ValueError(f"{where}: dangling parent_id {parent}")
     if not isinstance(rec["device"], dict):
         raise ValueError("device section must be an object")
+    term = rec.get("termination")
+    if term is not None:
+        if not isinstance(term, dict):
+            raise ValueError("termination must be an object")
+        if term.get("cause") not in TERMINATION_CAUSES:
+            raise ValueError(
+                f"termination.cause must be one of {TERMINATION_CAUSES}, "
+                f"got {term.get('cause')!r}"
+            )
+        ls = term.get("last_span")
+        if ls is not None and not isinstance(ls, str):
+            raise ValueError("termination.last_span must be a string or null")
+        if not isinstance(term.get("open_spans", []), list):
+            raise ValueError("termination.open_spans must be a list")
 
 
 # --------------------------------------------------------------------------

@@ -51,6 +51,9 @@ __all__ = [
     "downgrade_legacy",
     "upgrade_tree",
     "is_transient_artifact",
+    "termination_cause",
+    "is_partial_record",
+    "is_partial_entry",
     "MANIFEST_NAME",
     "LEGACY_PATTERNS",
 ]
@@ -78,11 +81,47 @@ LEGACY_PATTERNS = (
     "MULTICHIP_*.json",
 )
 TRANSIENT_PREFIXES = ("BENCH_CHECKPOINT_", "BENCH_TPU_")
+# Flight-recorder sidecars (obs.live): the recorder REWRITES these while a
+# run is live, and run_sparse_1m anchors them at SCALE_*/PROFILE_* names
+# that match LEGACY_PATTERNS — relocating one would index a mid-run
+# crash-stamped partial and unlink it out from under the recorder.
+TRANSIENT_SUFFIXES = ("_heartbeat.jsonl", "_partial.json")
 
 
 def is_transient_artifact(name: str) -> bool:
     """Live working files the upgrader must never relocate or index."""
-    return os.path.basename(name).startswith(TRANSIENT_PREFIXES)
+    base = os.path.basename(name)
+    return (base.startswith(TRANSIENT_PREFIXES)
+            or base.endswith(TRANSIENT_SUFFIXES))
+
+
+# --------------------------------------------------------------------------
+# partial (flight-recorder) records
+# --------------------------------------------------------------------------
+
+def termination_cause(rec: Dict[str, Any]) -> Optional[str]:
+    """The record's termination cause (obs.live incremental flush), or
+    None for records with no termination section (every clean single-shot
+    emitter)."""
+    term = rec.get("termination")
+    return term.get("cause") if isinstance(term, dict) else None
+
+
+def is_partial_record(rec: Dict[str, Any]) -> bool:
+    """True for flight-recorder partials: a termination stamp with any
+    cause other than "clean". Partial records are ledger-ingestible (they
+    are often the ONLY evidence a dead run left) but must never seed or
+    anchor a regression baseline — the walls of the interrupted stage are
+    truncated, not measured."""
+    cause = termination_cause(rec)
+    return cause is not None and cause != "clean"
+
+
+def is_partial_entry(entry: Dict[str, Any]) -> bool:
+    """Manifest-entry twin of :func:`is_partial_record` (the entry carries
+    the cause under ``termination``)."""
+    cause = entry.get("termination")
+    return cause is not None and cause != "clean"
 
 # extra-dict fields that identify the workload (not its outcome): two runs
 # agreeing on all of these are comparable, so they share a baseline key.
@@ -312,6 +351,12 @@ class Ledger:
             "source": source,
             "stage_walls": stage_walls(rec),
         }
+        cause = termination_cause(rec)
+        if cause is not None:
+            # the index says up front whether this run ended cleanly —
+            # baseline computation (regress.stage_baselines) reads only
+            # the manifest and must skip partials without loading files
+            entry["termination"] = cause
         try:
             from scconsensus_tpu.obs.cost import stage_cost_summary
 

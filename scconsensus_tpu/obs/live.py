@@ -1,0 +1,611 @@
+"""Live flight recorder: heartbeats, stall stack-dumps, partial run records.
+
+Five consecutive rounds of TPU tunnel hangs shared one failure mode: a run
+that stalled or was killed left NO evidence, because the run record was
+serialized only at clean exit and the orchestrator inferred worker liveness
+from stdout lines and cache-dir mtimes. This module closes that gap with
+the standard flight-recorder / always-on-profiling pattern (Dapper-style
+ambient tracing; the Perfetto/XProf continuous-capture model):
+
+  * **Heartbeat stream** — a daemon sampler thread owned by the active
+    :class:`~scconsensus_tpu.obs.trace.Tracer` appends one JSONL line per
+    tick (``SCC_OBS_HEARTBEAT`` seconds; default off) to a sibling
+    ``<base>_heartbeat.jsonl``: the open-span stack with elapsed walls,
+    counter/gauge snapshots of the open spans, host RSS +
+    ``memory_snapshot()`` HBM, compile stats, and the recorder's own
+    ``progress_unix``. Appends are line-granular (crash-safe: a SIGKILL
+    can truncate at most the line being written).
+
+  * **Stall watchdog** — with ``SCC_OBS_STALL_S`` set, a tick that sees no
+    span transition AND no compile progress for the whole window dumps
+    all-thread stacks via ``faulthandler`` into the stream as a ``stall``
+    event, increments the stall counter, and — when ``SCC_OBS_STALL_TRACE``
+    names a directory — escalates to an on-demand
+    ``jax.profiler.start_trace``/``stop_trace`` capture window. SIGUSR1
+    requests the same capture on a live run at any time.
+
+  * **Incremental run-record flushing** — the recorder periodically (and
+    on SIGTERM / atexit) writes a schema-valid partial record to
+    ``<base>_partial.json`` stamped ``termination: {cause, last_span,
+    open_spans, ...}``. The periodic stamp is ``cause="crash"`` on
+    purpose: the on-disk file always describes what it would mean if it
+    turned out to be the last evidence (a process that dies with no
+    handler running leaves exactly that stamp). SIGTERM rewrites it as
+    ``"signal"``, a fired watchdog as ``"stall"``, and a clean
+    :meth:`LiveRecorder.stop` as ``"clean"``. ``obs.ledger`` ingests
+    partial records (the entry carries the cause) but
+    ``obs.regress.stage_baselines`` excludes them from baselines.
+
+The sampler thread keeps ticking while the run thread is blocked inside a
+dead device RPC (the C++ wait releases the GIL) — which is the point: the
+stream then shows a live process with a frozen ``progress_unix`` and the
+exact span it froze in, distinguishing "slow but alive" from "dead" for
+``bench.py``'s orchestrator watchdog and ``tools/tail_run.py``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import faulthandler
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from scconsensus_tpu.config import env_flag
+from scconsensus_tpu.obs import trace as obs_trace
+from scconsensus_tpu.obs.export import (
+    TERMINATION_CAUSES,
+    build_run_record,
+    write_json_atomic,
+)
+
+__all__ = [
+    "LiveRecorder",
+    "active_recorder",
+    "flush_active",
+    "heartbeat_path",
+    "partial_record_path",
+    "read_heartbeat_tail",
+    "dump_all_stacks",
+]
+
+_LOCK = threading.Lock()
+_ACTIVE: "Optional[LiveRecorder]" = None
+
+# Default seconds of profiler capture per stall/SIGUSR1 escalation.
+CAPTURE_WINDOW_S = 15.0
+# Partial-record flush cadence (seconds) when heartbeats are faster.
+FLUSH_EVERY_S = 30.0
+
+
+def heartbeat_path(base: str) -> str:
+    """``<base>_heartbeat.jsonl`` (base = artifact path minus ``.json``)."""
+    return f"{base}_heartbeat.jsonl"
+
+
+def partial_record_path(base: str) -> str:
+    return f"{base}_partial.json"
+
+
+def active_recorder() -> "Optional[LiveRecorder]":
+    return _ACTIVE
+
+
+def flush_active(cause: str) -> Optional[str]:
+    """Flush the process's active recorder (if any) with ``cause``; returns
+    the partial-record path or None. Safe to call from signal handlers —
+    never raises."""
+    rec = _ACTIVE
+    if rec is None:
+        return None
+    try:
+        return rec.flush_partial(cause)
+    except Exception:
+        return None
+
+
+def dump_all_stacks() -> str:
+    """All-thread stack dump as text (faulthandler needs a real fd, so the
+    dump round-trips through a temp file)."""
+    try:
+        with tempfile.TemporaryFile(mode="w+") as tf:
+            faulthandler.dump_traceback(file=tf, all_threads=True)
+            tf.seek(0)
+            return tf.read()
+    except Exception as e:  # pragma: no cover - faulthandler is stdlib
+        return f"<stack dump failed: {e!r}>"
+
+
+def read_heartbeat_tail(path: str, max_bytes: int = 256 << 10
+                        ) -> Optional[Dict[str, Any]]:
+    """Newest parseable heartbeat/stall line of a stream, or None. Reads
+    only the file tail — post-mortem consumers poll this on long streams.
+    The window must comfortably hold one STALL line (an embedded
+    all-thread faulthandler dump easily exceeds 8 KiB under XLA thread
+    pools), or tail readers go blind exactly when a stall just fired."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - max_bytes))
+            chunk = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return None
+    for line in reversed(chunk.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+class LiveRecorder:
+    """Background heartbeat sampler + stall watchdog + partial flusher.
+
+    ``path_base`` anchors the two output files (``<base>_heartbeat.jsonl``,
+    ``<base>_partial.json``). ``record_fn`` (optional) builds the partial
+    run record — emitters that already have a cumulative record builder
+    (bench.py's ``_record``) plug it in here; without one the recorder
+    builds a record from the last-created tracer's live span tree.
+    ``heartbeat_s``/``stall_s`` default from the env-flag registry
+    (``SCC_OBS_HEARTBEAT`` / ``SCC_OBS_STALL_S``); fractional values are
+    the test-scale hook. A recorder with ``heartbeat_s <= 0`` is disabled:
+    ``start()`` is a no-op, so callers wire it unconditionally.
+    """
+
+    def __init__(self, path_base: str, metric: str = "live flight record",
+                 extra: Optional[Dict[str, Any]] = None,
+                 heartbeat_s: Optional[float] = None,
+                 stall_s: Optional[float] = None,
+                 capture_dir: Optional[str] = None,
+                 capture_s: float = CAPTURE_WINDOW_S,
+                 flush_every_s: float = FLUSH_EVERY_S,
+                 record_fn: Optional[Callable[[], Dict[str, Any]]] = None):
+        self.path_base = path_base
+        self.hb_path = heartbeat_path(path_base)
+        self.partial_path = partial_record_path(path_base)
+        self.metric = metric
+        self.extra = dict(extra or {})
+        self.heartbeat_s = float(
+            env_flag("SCC_OBS_HEARTBEAT") if heartbeat_s is None
+            else heartbeat_s
+        )
+        self.stall_s = float(
+            env_flag("SCC_OBS_STALL_S") if stall_s is None else stall_s
+        )
+        self.capture_dir = (capture_dir if capture_dir is not None
+                            else env_flag("SCC_OBS_STALL_TRACE"))
+        self.capture_s = float(capture_s)
+        self.flush_every_s = float(flush_every_s)
+        self.record_fn = record_fn
+
+        self.ticks = 0
+        self.stall_count = 0
+        # Cumulative CPU seconds the sampler thread spent inside ticks
+        # (time.thread_time: per-thread CPU, NOT wall — wall would charge
+        # the sampler for GIL waits caused by the run thread and overstate
+        # overhead by >10x on a busy interpreter). The overhead-guard test
+        # asserts this stays <1% of the workload wall.
+        self.tick_cpu_s = 0.0
+        self._t_start = time.time()
+        self._progress_unix = self._t_start
+        self._last_transition_seen = 0.0
+        self._compile_seen = -1
+        self._compile_mark0 = 0  # events before this recorder existed
+        self._stalled = False          # current stall episode
+        # capture machinery: "idle" | "open" | "dead" (a wedged profiler
+        # start is never retried); owner says WHO opened the window
+        # ("mainthread" toggle vs "thread" stall escalation) so the two
+        # can never double-stop one profiler session
+        self._capture_state = "idle"
+        self._capture_owner: Optional[str] = None
+        self._last_flush = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._f = None
+        # sampler thread, capture thread, annotate()/toggle_capture() on
+        # the run/main thread all emit; unserialized writes could tear
+        # lines and blind read_heartbeat_tail right when it matters
+        self._emit_lock = threading.Lock()
+        self._prev_term = None
+        self._prev_usr1 = None
+        self._atexit_registered = False
+
+    # -- properties --------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self.heartbeat_s > 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, install_signals: bool = True) -> "LiveRecorder":
+        """Open the stream, write the header line, spawn the sampler
+        thread. No-op when disabled (SCC_OBS_HEARTBEAT unset/0)."""
+        global _ACTIVE
+        if not self.enabled or self._thread is not None:
+            return self
+        os.makedirs(os.path.dirname(os.path.abspath(self.hb_path)) or ".",
+                    exist_ok=True)
+        self._f = open(self.hb_path, "a", buffering=1)
+        self._emit({
+            "t": "header", "ts": round(time.time(), 3), "pid": os.getpid(),
+            "metric": self.metric, "extra": self.extra,
+            "heartbeat_s": self.heartbeat_s, "stall_s": self.stall_s,
+            "argv": list(sys.argv),
+            "key": self._run_key(),
+        })
+        with _LOCK:
+            _ACTIVE = self
+        if install_signals:
+            self._install_signals()
+        # first periodic flush lands flush_every_s from NOW (0 here would
+        # make every tick rewrite+fsync the partial record — measured at
+        # ~100 ms/tick on slow filesystems)
+        self._last_flush = time.time()
+        self._thread = threading.Thread(
+            target=self._run, name="scc-heartbeat", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, cause: str = "clean") -> None:
+        """Stop the sampler and write the final partial record stamped with
+        ``cause`` (idempotent; safe when never started)."""
+        global _ACTIVE
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(2.0, 4 * self.heartbeat_s))
+        if self.enabled and self._f is not None:
+            self.flush_partial(cause)
+            self._emit({"t": "end", "ts": round(time.time(), 3),
+                        "cause": cause, "ticks": self.ticks,
+                        "stalls": self.stall_count})
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+        with _LOCK:
+            if _ACTIVE is self:
+                _ACTIVE = None
+
+    # -- signal / exit wiring ---------------------------------------------
+    def _install_signals(self) -> None:
+        """SIGTERM: flush a ``signal``-stamped partial, then chain to the
+        handler that was installed before us (bench.py's own checkpoint
+        handler keeps working). SIGUSR1: request a profiler capture.
+        atexit: flush ``crash`` if nothing flushed a better cause (a
+        process dying of an unhandled exception still leaves its record).
+        Non-main-thread installs are skipped silently."""
+        def _on_term(signum, frame):  # pragma: no cover - signal path
+            try:
+                self.flush_partial("signal")
+            except Exception:
+                pass
+            prev = self._prev_term
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        def _on_usr1(signum, frame):  # pragma: no cover - signal path
+            # Runs on the MAIN thread — the only thread jax.profiler
+            # start/stop is reliable on everywhere (thread-initiated
+            # captures wedge inside the TSL profiler on some builds).
+            # Toggle: first USR1 opens the window, second closes it.
+            try:
+                self.toggle_capture()
+            except Exception:
+                pass
+
+        try:
+            self._prev_term = signal.signal(signal.SIGTERM, _on_term)
+            self._prev_usr1 = signal.signal(signal.SIGUSR1, _on_usr1)
+        except (ValueError, OSError, AttributeError):
+            pass
+        if not self._atexit_registered:
+            self._atexit_registered = True
+
+            def _at_exit():
+                # stop() already ran on the happy path (then _f is None)
+                if self._f is not None:
+                    self.stop("crash")
+
+            atexit.register(_at_exit)
+
+    # -- sampling ----------------------------------------------------------
+    def _run_key(self) -> Optional[Dict[str, str]]:
+        """Run key of this recorder's workload (for tail_run.py's ETA
+        lookup against the evidence ledger); None when extras carry no
+        workload identity."""
+        try:
+            if not self.extra:
+                return None
+            from scconsensus_tpu.obs.ledger import run_key
+
+            return run_key({"extra": self.extra,
+                            "unit": self.extra.get("unit", "seconds")})
+        except Exception:
+            return None
+
+    def _emit(self, obj: Dict[str, Any]) -> None:
+        f = self._f
+        if f is None:
+            return
+        try:
+            line = json.dumps(obj, default=str) + "\n"
+            with self._emit_lock:
+                f.write(line)
+                f.flush()
+        except (OSError, ValueError):
+            pass
+
+    def _observe_progress(self, now: float) -> None:
+        """Update ``progress_unix`` from span transitions and compile
+        events. A long XLA compile transitions no spans, so compile-event
+        arrivals count as progress too."""
+        tr = obs_trace.last_tracer()
+        if tr is not None:
+            t = tr.last_transition_unix
+            if t > self._last_transition_seen:
+                self._last_transition_seen = t
+                self._progress_unix = max(self._progress_unix, t)
+        try:
+            from scconsensus_tpu.obs import device as obs_device
+
+            n = obs_device.compile_mark()
+            if self._compile_seen < 0:
+                # first observation: pre-existing events are not progress,
+                # and per-tick stats aggregate only from here (summing the
+                # whole process-lifetime event list every tick measured at
+                # >5% of a quick stage's wall under a warm test suite)
+                self._compile_mark0 = n
+            elif n != self._compile_seen:
+                self._progress_unix = now
+            self._compile_seen = n
+        except Exception:
+            pass
+
+    def touch(self) -> None:
+        """Manual progress mark for instrumented host-side work that opens
+        no spans (chunked generators, long pure-numpy phases)."""
+        self._progress_unix = time.time()
+
+    def annotate(self, **extra: Any) -> None:
+        """Update the recorder's workload extras after start (e.g. the
+        platform, known only once the backend answered) and append an
+        ``annotate`` line so stream consumers (tail_run.py's ETA key
+        lookup) see the refined run key."""
+        self.extra.update(extra)
+        self._emit({"t": "annotate", "ts": round(time.time(), 3),
+                    "extra": dict(extra), "key": self._run_key()})
+
+    def _open_metrics(self, tr) -> Dict[str, Any]:
+        """Scalar counter/gauge snapshots of the open spans (histograms are
+        summarized by n/sum)."""
+        out: Dict[str, Any] = {}
+        try:
+            with tr._lock:
+                stack = list(tr._stack)
+            for sp in stack:
+                ms = sp._metrics
+                if ms is None or ms.empty():
+                    continue
+                for name, m in ms.to_dict().items():
+                    if m.get("type") in ("counter", "gauge"):
+                        out[f"{sp.name}.{name}"] = m.get("value")
+                    else:
+                        out[f"{sp.name}.{name}"] = {
+                            "n": m.get("n"), "sum": m.get("sum")
+                        }
+        except Exception:
+            pass
+        return out
+
+    def _snapshot(self, now: float) -> Dict[str, Any]:
+        from scconsensus_tpu.obs import device as obs_device
+
+        tr = obs_trace.last_tracer()
+        open_spans: List[Dict[str, Any]] = []
+        spans_done = 0
+        metrics: Dict[str, Any] = {}
+        if tr is not None:
+            try:
+                open_spans = tr.open_stack()
+                spans_done = len(tr.spans)
+                metrics = self._open_metrics(tr)
+            except Exception:
+                pass
+        hb: Dict[str, Any] = {
+            "t": "hb",
+            "ts": round(now, 3),
+            "seq": self.ticks,
+            "up_s": round(now - self._t_start, 3),
+            "progress_unix": round(self._progress_unix, 3),
+            "since_progress_s": round(now - self._progress_unix, 3),
+            "open_spans": open_spans,
+            "spans_done": spans_done,
+            "stalls": self.stall_count,
+            "rss_bytes": obs_device.host_peak_rss_bytes(),
+        }
+        if metrics:
+            hb["metrics"] = metrics
+        mem = obs_device.memory_snapshot()
+        if mem is not None:
+            hb["hbm"] = mem
+        if self._compile_seen > self._compile_mark0:
+            try:
+                cs = obs_device.compile_stats(since=self._compile_mark0)
+                hb["compile"] = {"events": cs["events"],
+                                 "total_s": cs["total_s"]}
+            except Exception:
+                pass
+        return hb
+
+    # -- stall handling ----------------------------------------------------
+    def _check_stall(self, now: float) -> None:
+        if self.stall_s <= 0:
+            return
+        since = now - self._progress_unix
+        if since <= self.stall_s:
+            if self._stalled:
+                self._emit({"t": "recovered", "ts": round(now, 3),
+                            "stalls": self.stall_count})
+            self._stalled = False
+            return
+        if self._stalled:
+            return  # one dump per stall episode
+        self._stalled = True
+        self.stall_count += 1
+        tr = obs_trace.last_tracer()
+        event: Dict[str, Any] = {
+            "t": "stall",
+            "ts": round(now, 3),
+            "since_progress_s": round(since, 3),
+            "stalls": self.stall_count,
+            "open_spans": tr.open_stack() if tr is not None else [],
+            "stack": dump_all_stacks(),
+        }
+        if self.capture_dir:
+            event["capture"] = self._spawn_capture("stall")
+        self._emit(event)
+        self.flush_partial("stall")
+
+    def toggle_capture(self) -> None:
+        """Synchronous main-thread capture toggle (the SIGUSR1 handler):
+        first call opens a ``jax.profiler`` window, second closes it.
+        Main thread because thread-initiated TSL profiler starts wedge on
+        some builds; the USR1 handler always runs on the main thread."""
+        now = time.time()
+        if not self.capture_dir or "jax" not in sys.modules:
+            self._emit({"t": "capture-failed", "ts": round(now, 3),
+                        "error": "no SCC_OBS_STALL_TRACE dir or jax not "
+                                 "loaded"})
+            return
+        import jax.profiler
+
+        if self._capture_state == "open":
+            if self._capture_owner != "mainthread":
+                # a stall-escalation capture thread owns the session and
+                # will stop it itself; stopping here would double-stop
+                # the profiler and poison the machinery as "dead"
+                self._emit({"t": "capture-busy", "ts": round(now, 3),
+                            "owner": self._capture_owner})
+                return
+            jax.profiler.stop_trace()
+            self._capture_state = "idle"
+            self._capture_owner = None
+            self._emit({"t": "capture-done", "ts": round(now, 3),
+                        "dir": self.capture_dir})
+        else:
+            os.makedirs(self.capture_dir, exist_ok=True)
+            jax.profiler.start_trace(self.capture_dir)
+            self._capture_state = "open"
+            self._capture_owner = "mainthread"
+            self._emit({"t": "capture", "ts": round(now, 3),
+                        "trigger": "sigusr1", "dir": self.capture_dir})
+
+    def _spawn_capture(self, trigger: str) -> Optional[str]:
+        """Stall-escalation capture: a self-contained daemon thread runs
+        start_trace → sleep(capture_s) → stop_trace and emits the
+        capture/capture-done events itself, so a wedged profiler start can
+        never hang the sampler loop (the thread just parks and the state
+        stays "open" — no retries, and the missing ``capture`` event in
+        the stream is itself the diagnosis). Never the first jax touch."""
+        if ("jax" not in sys.modules or not self.capture_dir
+                or self._capture_state != "idle"):
+            return None
+        self._capture_state = "open"
+        self._capture_owner = "thread"
+        cap_dir, cap_s = self.capture_dir, self.capture_s
+
+        def _go():
+            try:
+                import jax.profiler
+
+                os.makedirs(cap_dir, exist_ok=True)
+                jax.profiler.start_trace(cap_dir)
+                self._emit({"t": "capture", "ts": round(time.time(), 3),
+                            "trigger": trigger, "dir": cap_dir,
+                            "duration_s": cap_s})
+                time.sleep(cap_s)
+                jax.profiler.stop_trace()
+                self._emit({"t": "capture-done",
+                            "ts": round(time.time(), 3), "dir": cap_dir})
+                self._capture_state = "idle"
+                self._capture_owner = None
+            except Exception as e:
+                self._emit({"t": "capture-failed",
+                            "ts": round(time.time(), 3),
+                            "error": repr(e)[:200]})
+                self._capture_state = "dead"
+
+        threading.Thread(target=_go, daemon=True,
+                         name="scc-capture").start()
+        return cap_dir
+
+    # -- partial record ----------------------------------------------------
+    def build_partial_record(self, cause: str) -> Dict[str, Any]:
+        if cause not in TERMINATION_CAUSES:
+            raise ValueError(f"unknown termination cause {cause!r}")
+        tr = obs_trace.last_tracer()
+        if self.record_fn is not None:
+            rec = self.record_fn()
+        else:
+            rec = build_run_record(
+                metric=self.metric, value=-1.0, unit="seconds",
+                vs_baseline=None, extra=dict(self.extra),
+                spans=tr.live_span_records() if tr is not None else [],
+            )
+        open_spans = tr.open_stack() if tr is not None else []
+        rec["termination"] = {
+            "cause": cause,
+            "last_span": open_spans[-1]["name"] if open_spans else None,
+            "open_spans": open_spans,
+            "stall_count": self.stall_count,
+            "heartbeat_path": os.path.basename(self.hb_path),
+            "flushed_unix": round(time.time(), 3),
+        }
+        if cause != "clean":
+            rec.setdefault("extra", {})["partial"] = True
+        return rec
+
+    def flush_partial(self, cause: str = "crash") -> Optional[str]:
+        """Atomically (re)write ``<base>_partial.json``. The on-disk stamp
+        always answers "what does it mean if this file is the last
+        evidence" — hence the periodic flush's standing ``crash``."""
+        try:
+            rec = self.build_partial_record(cause)
+            rec = json.loads(json.dumps(rec, default=str))
+            write_json_atomic(self.partial_path, rec)
+            self._last_flush = time.time()
+            return self.partial_path
+        except Exception:
+            return None
+
+    # -- the sampler thread ------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            t0 = time.thread_time()
+            try:
+                now = time.time()
+                self._observe_progress(now)
+                self.ticks += 1
+                self._emit(self._snapshot(now))
+                self._check_stall(now)
+                if now - self._last_flush >= self.flush_every_s:
+                    # the standing stamp while running is "crash": see
+                    # flush_partial. A stall episode keeps its own stamp.
+                    self.flush_partial("stall" if self._stalled else "crash")
+            except Exception:  # the sampler must never kill the run
+                pass
+            finally:
+                self.tick_cpu_s += time.thread_time() - t0

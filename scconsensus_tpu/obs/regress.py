@@ -65,9 +65,18 @@ def stage_baselines(history: Sequence[Dict[str, Any]]
     """Noise-aware per-stage baselines from manifest entries (oldest
     first). Uses each entry's ``stage_walls``; the anchor set per stage is
     the last ``ANCHOR_RUNS`` entries that measured that stage. Returns
-    ``{stage: {baseline_s, band_s, n, spread_s}}``."""
+    ``{stage: {baseline_s, band_s, n, spread_s}}``.
+
+    Flight-recorder partials (``termination`` cause != clean) are excluded
+    unconditionally: a SIGTERMed or stalled run's stage walls are
+    truncated at the moment of death, and a baseline anchored on one
+    would read every subsequent healthy run as a regression."""
+    from scconsensus_tpu.obs.ledger import is_partial_entry
+
     walls: Dict[str, List[float]] = {}
     for e in history:
+        if is_partial_entry(e):
+            continue
         for stage, w in (e.get("stage_walls") or {}).items():
             if isinstance(w, (int, float)) and w >= 0:
                 walls.setdefault(stage, []).append(float(w))
@@ -167,6 +176,11 @@ class GateVerdict:
     n_history: int
     stages: List[StageVerdict]
     note: Optional[str] = None
+    # flight-recorder bookkeeping: history entries excluded from the
+    # baselines because they are partial, and the candidate's own
+    # termination cause when it is itself a partial record
+    n_partial_excluded: int = 0
+    candidate_termination: Optional[str] = None
 
     @property
     def regressions(self) -> List[StageVerdict]:
@@ -178,6 +192,8 @@ class GateVerdict:
             "key": self.key,
             "n_history": self.n_history,
             "note": self.note,
+            "n_partial_excluded": self.n_partial_excluded,
+            "candidate_termination": self.candidate_termination,
             "regressions": [s.to_dict() for s in self.regressions],
             "stages": [s.to_dict() for s in self.stages],
         }
@@ -210,16 +226,45 @@ def gate_record(candidate: Dict[str, Any],
     """Verdict for one candidate run record against its key's history
     (manifest entries, oldest first, candidate excluded). With no history
     the gate passes with a note — a first run cannot regress, it *seeds*
-    the baseline."""
+    the baseline. Partial history entries are reported (counted) but never
+    anchor baselines; a partial CANDIDATE is gated informationally — its
+    completed stages still compare, and the verdict says so."""
     from scconsensus_tpu.obs.cost import stage_cost_summary
-    from scconsensus_tpu.obs.ledger import run_key, stage_walls
+    from scconsensus_tpu.obs.ledger import (
+        is_partial_entry,
+        is_partial_record,
+        run_key,
+        stage_walls,
+        termination_cause,
+    )
 
     key = run_key(candidate)
+    n_partial = sum(1 for e in history if is_partial_entry(e))
+    cand_term = (termination_cause(candidate)
+                 if is_partial_record(candidate) else None)
+    note = None
+    if cand_term is not None:
+        note = (f"candidate is a PARTIAL record (termination.cause="
+                f"{cand_term}): reported only — it must never be ingested "
+                "as a baseline anchor")
+    history = [e for e in history if not is_partial_entry(e)]
     if not history:
         return GateVerdict(ok=True, key=key, n_history=0, stages=[],
-                           note="no baseline history for this key; "
-                                "candidate seeds the baseline")
+                           note=note or
+                           "no baseline history for this key; "
+                           "candidate seeds the baseline",
+                           n_partial_excluded=n_partial,
+                           candidate_termination=cand_term)
     baselines = stage_baselines(history)
+    if cand_term is not None:
+        # "completed stages still compare": OPEN span snapshots in a
+        # partial record carry the wall at the moment of death — a wedged
+        # stage would fake a regression, a just-started one a pass. Gate
+        # only the spans that actually closed.
+        candidate = {**candidate, "spans": [
+            s for s in candidate.get("spans") or []
+            if not (isinstance(s, dict) and (s.get("attrs") or {}).get("open"))
+        ]}
     cand_walls = stage_walls(candidate)
     cand_cost = stage_cost_summary(candidate.get("spans") or [])
     stages: List[StageVerdict] = []
@@ -243,7 +288,9 @@ def gate_record(candidate: Dict[str, Any],
         stages.append(sv)
     ok = not any(s.regressed for s in stages)
     return GateVerdict(ok=ok, key=key, n_history=len(history),
-                       stages=stages)
+                       stages=stages, note=note,
+                       n_partial_excluded=n_partial,
+                       candidate_termination=cand_term)
 
 
 # --------------------------------------------------------------------------

@@ -30,6 +30,7 @@ import json
 import logging
 import threading
 import time
+import weakref
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
@@ -41,6 +42,7 @@ __all__ = [
     "span",
     "current_tracer",
     "current_span",
+    "last_tracer",
     "device_drain",
     "summarize_record",
 ]
@@ -48,6 +50,20 @@ __all__ = [
 _ACTIVE: contextvars.ContextVar = contextvars.ContextVar(
     "scc_active_tracer", default=None
 )
+
+# Most recently created tracer, for out-of-context observers (the obs.live
+# heartbeat sampler runs on its own thread and cannot see the contextvar).
+# A weakref: the flight recorder must never keep a finished run's span tree
+# alive.
+_LAST_TRACER: "Optional[weakref.ref]" = None
+
+
+def last_tracer() -> "Optional[Tracer]":
+    """The most recently created (still-alive) tracer in this process, or
+    None. This is the handle the live flight recorder samples — unlike
+    :func:`current_tracer` it works from any thread."""
+    ref = _LAST_TRACER
+    return ref() if ref is not None else None
 
 _LOG_LIST_CAP = 16
 
@@ -243,9 +259,15 @@ class Tracer:
         self.sync = sync if sync in ("stage", "all", "off") else _sync_mode()
         self.annotate = annotate
         self.sample_device = sample_device
+        # wall-clock of the last span enter/exit — the flight recorder's
+        # progress signal (a run with an open span but no transitions is
+        # exactly what "stalled" means)
+        self.last_transition_unix = time.time()
         self._stack: List[Span] = []
         self._ids = itertools.count()
         self._lock = threading.Lock()
+        global _LAST_TRACER
+        _LAST_TRACER = weakref.ref(self)
         self._compile_mark = None
         try:
             from scconsensus_tpu.obs import device as obs_device
@@ -279,6 +301,7 @@ class Tracer:
                 len(self._stack), kind, dict(attrs),
             )
             self._stack.append(sp)
+            self.last_transition_unix = time.time()
         do_sync = self._should_sync(kind, sync)
         ann = None
         if self.annotate:
@@ -318,6 +341,7 @@ class Tracer:
                 if self._stack and self._stack[-1] is sp:
                     self._stack.pop()
                 self.spans.append(sp)
+                self.last_transition_unix = time.time()
             if self.logger is not None and kind == "stage":
                 self.logger.info(
                     "stage %s",
@@ -325,12 +349,88 @@ class Tracer:
                                default=str),
                 )
 
+    def add_completed_span(self, name: str, wall_s: float,
+                           kind: str = "detail", synced: bool = False,
+                           **attrs: Any) -> Span:
+        """Synthesize an already-finished child span of the innermost open
+        span, covering the ``wall_s`` seconds that just elapsed.
+
+        For sequential phase-mark instrumentation (the NB driver's
+        ``mark(label)`` calls) where the phase's NAME is only known at its
+        end: a context-manager span would have to be renamed mid-flight
+        and would leak open on an exception. The synthesized span is
+        back-dated so Chrome traces render it in place; it never touches
+        the open-span stack."""
+        now_pc = time.perf_counter()
+        with self._lock:
+            parent = self._stack[-1] if self._stack else None
+            sp = Span(
+                name, next(self._ids),
+                parent.span_id if parent is not None else None,
+                parent.depth + 1 if parent is not None else 0,
+                kind, dict(attrs),
+            )
+            sp.t0_s = max(now_pc - self.t_origin - wall_s, 0.0)
+            sp._t_enter = sp.t0_s + self.t_origin
+            sp.wall_submitted_s = wall_s
+            if synced:
+                sp.synced = True
+                sp.wall_synced_s = wall_s
+            self.spans.append(sp)
+            self.last_transition_unix = time.time()
+        return sp
+
     # -- views -------------------------------------------------------------
     def stage_records(self) -> List[Dict[str, Any]]:
         return [s.stage_record() for s in self.spans if s.kind == "stage"]
 
     def span_records(self) -> List[Dict[str, Any]]:
         return [s.record() for s in self.spans]
+
+    def open_stack(self) -> List[Dict[str, Any]]:
+        """Snapshot of the currently open spans, outermost first: name,
+        kind, depth, span_id/parent_id, and the wall elapsed since entry.
+        Thread-safe (the flight recorder calls this from its sampler
+        thread while the run thread is mid-span)."""
+        now = time.perf_counter()
+        with self._lock:
+            stack = list(self._stack)
+        return [{
+            "name": s.name,
+            "span_id": s.span_id,
+            "parent_id": s.parent_id,
+            "depth": s.depth,
+            "kind": s.kind,
+            "elapsed_s": round(max(now - s._t_enter, 0.0), 4),
+        } for s in stack]
+
+    def live_span_records(self) -> List[Dict[str, Any]]:
+        """Finished span records PLUS provisional records for still-open
+        spans (wall = elapsed so far, ``synced`` False, ``attrs["open"]``
+        True). A mid-run record built only from finished spans would carry
+        dangling parent_ids (children of a still-open stage complete
+        first) and lose the one thing a flight record exists to keep: what
+        was running when the process died."""
+        now = time.perf_counter()
+        with self._lock:
+            done = list(self.spans)
+            stack = list(self._stack)
+        out = [s.record() for s in done]
+        for s in stack:
+            rec: Dict[str, Any] = {
+                "name": s.name,
+                "span_id": s.span_id,
+                "parent_id": s.parent_id,
+                "depth": s.depth,
+                "kind": s.kind,
+                "t0_s": round(s.t0_s, 6),
+                "wall_submitted_s": round(max(now - s._t_enter, 0.0), 6),
+                "wall_synced_s": None,
+                "synced": False,
+                "attrs": {**s.attrs, "open": True},
+            }
+            out.append(rec)
+        return out
 
     def total_s(self) -> float:
         return sum(s.wall_s for s in self.spans if s.kind == "stage")

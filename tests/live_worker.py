@@ -1,0 +1,32 @@
+"""Subprocess target for the flight-recorder termination tests: start a
+recorder + tracer, enter a nested span stack named like the real DE hot
+path, then sleep — the parent waits for the first heartbeat, delivers
+SIGTERM, and asserts the partial record says ``cause=signal`` with the
+open-span stack intact. Not a test module (no ``test_`` prefix)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from scconsensus_tpu.obs.live import LiveRecorder  # noqa: E402
+from scconsensus_tpu.obs.trace import Tracer  # noqa: E402
+
+
+def main() -> None:
+    base = sys.argv[1]
+    LiveRecorder(
+        base, metric="sigterm mid-wilcox test",
+        extra={"config": "livetest", "platform": "cpu"},
+        heartbeat_s=0.05, stall_s=0.0,
+    ).start()
+    tr = Tracer(sync="off")
+    with tr.span("wilcox_test"):
+        with tr.span("wilcox_chunk", kind="detail"):
+            time.sleep(120)  # parent TERMs us long before this elapses
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,16 @@ def test_worker_sigterm_leaves_parseable_line_and_checkpoint(tmp_path):
     assert last["extra"]["partial"] is True
     disk = json.loads(ckpt.read_text())
     assert disk["metric"]
+    # flight recorder (round 9): the worker heartbeats by default and its
+    # SIGTERM path flushes a schema-valid signal-stamped partial sibling
+    hb = tmp_path / "ckpt_heartbeat.jsonl"
+    assert hb.exists(), "worker emitted no heartbeat stream"
+    assert json.loads(hb.read_text().splitlines()[0])["t"] == "header"
+    from scconsensus_tpu.obs.export import validate_run_record
+
+    partial = json.loads((tmp_path / "ckpt_partial.json").read_text())
+    validate_run_record(partial)
+    assert partial["termination"]["cause"] == "signal"
 
 
 def test_checkpoint_partial_with_value_is_accepted_on_timeout(

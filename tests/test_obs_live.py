@@ -1,0 +1,488 @@
+"""Flight recorder (obs.live, ISSUE 4 tentpole acceptance): a worker
+killed mid-``wilcox`` and a stalled worker both leave a schema-valid
+partial run record + heartbeat stream with a stack dump; the ledger
+ingests partials but baselines exclude them; the perf gate reports (never
+baselines) them; bench's watchdog reads heartbeat recency as its primary
+liveness signal; tail_run renders a committed fixture stream; and the
+sampler thread's overhead stays under 1% of wall."""
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from scconsensus_tpu.obs.export import validate_run_record
+from scconsensus_tpu.obs.ledger import (
+    Ledger,
+    is_partial_entry,
+    is_partial_record,
+    run_key,
+)
+from scconsensus_tpu.obs.live import (
+    LiveRecorder,
+    heartbeat_path,
+    partial_record_path,
+    read_heartbeat_tail,
+)
+from scconsensus_tpu.obs.trace import Tracer
+from scconsensus_tpu.obs import regress
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+HB_FIXTURES = REPO / "tests" / "fixtures" / "heartbeat"
+
+
+def _stream_lines(path):
+    return [json.loads(ln) for ln in
+            pathlib.Path(path).read_text().strip().splitlines()]
+
+
+# --------------------------------------------------------------------------
+# heartbeat stream
+# --------------------------------------------------------------------------
+
+class TestHeartbeatStream:
+    def test_stream_carries_open_spans_rss_and_progress(self, tmp_path):
+        rec = LiveRecorder(str(tmp_path / "run"), metric="t",
+                           extra={"config": "quick", "platform": "cpu"},
+                           heartbeat_s=0.05, stall_s=0.0).start(
+                               install_signals=False)
+        tr = Tracer(sync="off")
+        with tr.span("stage_a"):
+            with tr.span("inner", kind="detail") as sp:
+                sp.metrics.counter("genes").add(7)
+                time.sleep(0.35)
+        rec.stop("clean")
+        lines = _stream_lines(rec.hb_path)
+        assert lines[0]["t"] == "header" and lines[0]["pid"] == os.getpid()
+        assert lines[0]["key"]["dataset"] == "quick"
+        assert lines[-1]["t"] == "end" and lines[-1]["cause"] == "clean"
+        hbs = [ln for ln in lines if ln["t"] == "hb"]
+        assert len(hbs) >= 3
+        mid = next(ln for ln in hbs
+                   if [s["name"] for s in ln["open_spans"]]
+                   == ["stage_a", "inner"])
+        assert mid["rss_bytes"] > 0
+        assert mid["open_spans"][1]["elapsed_s"] >= 0
+        assert mid["since_progress_s"] >= 0
+        assert mid["metrics"]["inner.genes"] == 7.0
+
+    def test_disabled_recorder_writes_nothing(self, tmp_path):
+        rec = LiveRecorder(str(tmp_path / "off"), heartbeat_s=0.0)
+        rec.start(install_signals=False)
+        assert not rec.enabled
+        rec.stop("clean")
+        assert not os.path.exists(rec.hb_path)
+        assert not os.path.exists(rec.partial_path)
+
+    def test_read_heartbeat_tail_skips_torn_final_line(self, tmp_path):
+        p = tmp_path / "s_heartbeat.jsonl"
+        p.write_text('{"t": "hb", "ts": 5.0, "seq": 1}\n{"t": "hb", "ts"')
+        tail = read_heartbeat_tail(str(p))
+        assert tail == {"t": "hb", "ts": 5.0, "seq": 1}
+        assert read_heartbeat_tail(str(tmp_path / "missing.jsonl")) is None
+
+
+# --------------------------------------------------------------------------
+# stall watchdog (acceptance: stalled worker leaves a stack dump)
+# --------------------------------------------------------------------------
+
+class TestStallWatchdog:
+    def test_stall_dumps_stacks_and_counts(self, tmp_path):
+        rec = LiveRecorder(str(tmp_path / "run"), metric="stall test",
+                           heartbeat_s=0.05, stall_s=0.25,
+                           flush_every_s=0.2).start(install_signals=False)
+        tr = Tracer(sync="off")
+        with tr.span("wilcox_test"):
+            time.sleep(1.0)  # no span transition for > stall_s
+            # the partial record flushed DURING the stall says so
+            mid = json.load(open(rec.partial_path))
+        time.sleep(0.25)  # a few ticks AFTER the span exits (recovery)
+        rec.stop("clean")
+        assert rec.stall_count == 1  # one dump per stall episode
+        lines = _stream_lines(rec.hb_path)
+        (stall,) = [ln for ln in lines if ln["t"] == "stall"]
+        # a real faulthandler all-thread dump, with this test on it
+        assert "Thread" in stall["stack"] or "File" in stall["stack"]
+        assert "test_obs_live" in stall["stack"]
+        assert stall["open_spans"][-1]["name"] == "wilcox_test"
+        assert stall["since_progress_s"] >= 0.25
+        # stall counter rides subsequent heartbeats
+        after = [ln for ln in lines if ln["t"] == "hb"
+                 and ln["ts"] > stall["ts"]]
+        assert after and all(ln["stalls"] == 1 for ln in after)
+        # progress resumed when the span exited -> recovery event
+        assert any(ln["t"] == "recovered" for ln in lines)
+        validate_run_record(mid)
+        assert mid["termination"]["cause"] == "stall"
+        assert is_partial_record(mid)
+
+    def test_stall_counter_in_termination_stamp(self, tmp_path):
+        rec = LiveRecorder(str(tmp_path / "r"), heartbeat_s=0.04,
+                           stall_s=0.15).start(install_signals=False)
+        time.sleep(0.6)  # no tracer at all: stalls on zero transitions
+        rec.stop("clean")
+        final = json.load(open(rec.partial_path))
+        assert final["termination"]["stall_count"] >= 1
+        assert final["termination"]["cause"] == "clean"  # stop() won
+
+
+# --------------------------------------------------------------------------
+# SIGTERM mid-stage (acceptance: killed worker leaves a partial record)
+# --------------------------------------------------------------------------
+
+class TestSigtermPartialRecord:
+    def test_sigterm_mid_wilcox_leaves_signal_stamped_partial(self, tmp_path):
+        base = str(tmp_path / "victim")
+        proc = subprocess.Popen(
+            [sys.executable, str(REPO / "tests" / "live_worker.py"), base],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            hb = heartbeat_path(base)
+            deadline = time.time() + 60
+            # wait until the worker is heartbeating INSIDE the span stack
+            while time.time() < deadline:
+                tail = read_heartbeat_tail(hb)
+                if tail and tail.get("t") == "hb" and tail.get("open_spans"):
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail(f"no heartbeat with open spans; stderr: "
+                            f"{proc.stderr.read()[-500:]}")
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        partial = json.load(open(partial_record_path(base)))
+        validate_run_record(partial)
+        term = partial["termination"]
+        assert term["cause"] == "signal"
+        # non-empty open-span stack, innermost last — killed mid-wilcox
+        names = [s["name"] for s in term["open_spans"]]
+        assert names == ["wilcox_test", "wilcox_chunk"]
+        assert term["last_span"] == "wilcox_chunk"
+        # the span tree includes the open spans (no dangling parent_ids,
+        # already proven by validate_run_record) marked open
+        opens = [s for s in partial["spans"]
+                 if (s.get("attrs") or {}).get("open")]
+        assert {s["name"] for s in opens} == {"wilcox_test", "wilcox_chunk"}
+        assert partial["extra"]["partial"] is True
+        assert is_partial_record(partial)
+
+
+# --------------------------------------------------------------------------
+# ingestion: ledger takes partials, baselines and the gate exclude them
+# --------------------------------------------------------------------------
+
+def _clean_record(value, created):
+    tr = Tracer(sync="off")
+    with tr.span("aggregates"):
+        pass
+    from scconsensus_tpu.obs.export import build_run_record
+
+    rec = build_run_record("m", value, tracer=tr,
+                           extra={"platform": "cpu", "config": "quick"})
+    rec["run"]["created_unix"] = created
+    return rec
+
+
+def _partial_record(created, cause="stall"):
+    rec = _clean_record(-1.0, created)
+    rec["spans"][0]["wall_synced_s"] = 99.0  # truncated-garbage wall
+    rec["termination"] = {
+        "cause": cause, "last_span": "aggregates", "open_spans": [],
+        "stall_count": 1, "flushed_unix": created,
+    }
+    rec["extra"]["partial"] = True
+    return rec
+
+
+class TestPartialIngestion:
+    def test_ledger_ingests_partial_and_stamps_entry(self, tmp_path):
+        led = Ledger(str(tmp_path))
+        entry = led.ingest(_partial_record(100.0))
+        assert entry["termination"] == "stall"
+        assert is_partial_entry(entry)
+        validate_run_record(led.load(entry["file"]))
+        clean = led.ingest(_clean_record(1.0, 200.0))
+        assert "termination" not in clean
+        assert not is_partial_entry(clean)
+
+    def test_stage_baselines_exclude_partial_entries(self, tmp_path):
+        led = Ledger(str(tmp_path))
+        for i, v in enumerate((1.0, 1.1, 1.2)):
+            led.ingest(_clean_record(v, 100.0 + i))
+        led.ingest(_partial_record(150.0))
+        hist = led.history(run_key(_clean_record(1.0, 0)))
+        assert len(hist) == 4
+        b = regress.stage_baselines(hist)["aggregates"]
+        # the partial's 99 s wall would dominate the median if admitted
+        assert b["baseline_s"] < 1.0
+        assert b["n"] == 3
+
+    def test_gate_reports_partial_candidate_without_baselining(
+            self, tmp_path):
+        led = Ledger(str(tmp_path))
+        for i, v in enumerate((1.0, 1.1, 1.2)):
+            led.ingest(_clean_record(v, 100.0 + i))
+        led.ingest(_partial_record(150.0))
+        cand = _partial_record(200.0)
+        hist = led.history(run_key(cand))
+        v = regress.gate_record(cand, hist)
+        assert v.candidate_termination == "stall"
+        assert v.n_partial_excluded == 1
+        assert "PARTIAL" in (v.note or "")
+        assert v.to_dict()["candidate_termination"] == "stall"
+
+    def test_gate_ignores_partial_candidates_open_span_walls(self, tmp_path):
+        """A wedged OPEN stage snapshot (wall = elapsed at the moment of
+        death) must not fail the gate — only the candidate's CLOSED
+        stages compare against baselines."""
+        led = Ledger(str(tmp_path))
+        for i, v in enumerate((1.0, 1.1, 1.2)):
+            led.ingest(_clean_record(v, 100.0 + i))
+        cand = _partial_record(200.0)
+        # mark the candidate's only stage span as an open snapshot with a
+        # wedged wall far beyond baseline+band
+        cand["spans"][0]["attrs"] = {"open": True}
+        cand["spans"][0]["wall_synced_s"] = None
+        cand["spans"][0]["synced"] = False
+        cand["spans"][0]["wall_submitted_s"] = 999.0
+        v = regress.gate_record(cand, led.history(run_key(cand)))
+        assert v.ok, [s.to_dict() for s in v.regressions]
+        assert v.stages == []  # nothing closed -> nothing gated
+
+    def test_perf_gate_cli_reports_partial(self, tmp_path):
+        led = Ledger(str(tmp_path / "evidence"))
+        for i, v in enumerate((1.0, 1.1, 1.2)):
+            led.ingest(_clean_record(v, 100.0 + i))
+        cand_path = tmp_path / "cand.json"
+        cand_path.write_text(json.dumps(_partial_record(200.0)))
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "perf_gate.py"),
+             str(cand_path), "--evidence", str(tmp_path / "evidence")],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert "PARTIAL record" in proc.stdout
+        assert "termination.cause=stall" in proc.stdout
+
+    def test_upgrader_never_eats_recorder_sidecars(self, tmp_path):
+        """run_sparse_1m anchors sidecars at SCALE_*/PROFILE_* names that
+        match the legacy upgrade globs; the upgrader must treat them as
+        live working files (the recorder rewrites them mid-run), never
+        relocate/index/unlink them."""
+        from scconsensus_tpu.obs.ledger import (
+            is_transient_artifact,
+            upgrade_tree,
+        )
+
+        assert is_transient_artifact(
+            "SCALE_r06_cpu_1000k_fullpipe_sparse_partial.json")
+        assert is_transient_artifact("PROFILE_r06_wilcox_1m_heartbeat.jsonl")
+        assert not is_transient_artifact("SCALE_r06_cpu_tm100k_full.json")
+        (tmp_path / "SCALE_x_partial.json").write_text(
+            json.dumps(_partial_record(1.0)))
+        done, skipped = upgrade_tree(str(tmp_path))
+        assert done == [] and skipped == []
+        assert (tmp_path / "SCALE_x_partial.json").exists()
+
+    def test_validate_rejects_unknown_cause(self):
+        rec = _partial_record(1.0)
+        rec["termination"]["cause"] = "gremlins"
+        with pytest.raises(ValueError, match="termination.cause"):
+            validate_run_record(rec)
+
+    def test_summarize_evidence_shows_termination(self, tmp_path):
+        led = Ledger(str(tmp_path / "evidence"))
+        led.ingest(_partial_record(100.0), name="RUN_partial.json")
+        proc = subprocess.run(
+            [sys.executable,
+             str(REPO / "tools" / "summarize_evidence.py"), str(tmp_path)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0
+        row = next(l for l in proc.stdout.splitlines()
+                   if "RUN_partial.json" in l)
+        assert "TERMINATED=stall@aggregates" in row
+
+
+# --------------------------------------------------------------------------
+# bench watchdog: heartbeat recency is the primary liveness signal
+# --------------------------------------------------------------------------
+
+class TestBenchHeartbeatPrimary:
+    def _hb(self, tmp_path, lines):
+        p = tmp_path / "x_heartbeat.jsonl"
+        p.write_text("".join(json.dumps(ln) + "\n" for ln in lines))
+        return str(p)
+
+    def test_progress_read_from_hb_tail(self, tmp_path):
+        sys.path.insert(0, str(REPO))
+        import bench
+
+        now = time.time()
+        p = self._hb(tmp_path, [
+            {"t": "header", "ts": now - 100},
+            {"t": "hb", "ts": now - 1, "progress_unix": now - 40,
+             "since_progress_s": 39.0},
+        ])
+        # fresh stream: progress comes from the worker's own sampler, NOT
+        # from file mtime (a wedged worker keeps heartbeating); line_ts
+        # rides along so the caller can see the stream going quiet
+        prog, line_ts = bench._heartbeat_progress(p, now - 200)
+        assert prog == pytest.approx(now - 40)
+        assert line_ts == pytest.approx(now - 1)
+        # a quiet stream (line_ts older than _HB_QUIET_S) means the
+        # orchestrator re-engages the fallback signals
+        assert now - line_ts < bench._HB_QUIET_S
+
+    def test_stale_stream_from_previous_attempt_ignored(self, tmp_path):
+        import bench
+
+        now = time.time()
+        p = self._hb(tmp_path, [
+            {"t": "hb", "ts": now - 500, "progress_unix": now - 500},
+        ])
+        assert bench._heartbeat_progress(p, now - 100) is None
+        assert bench._heartbeat_progress(
+            str(tmp_path / "missing.jsonl"), 0) is None
+
+    def test_stall_event_tail_backs_out_progress_stop(self, tmp_path):
+        import bench
+
+        now = time.time()
+        p = self._hb(tmp_path, [
+            {"t": "stall", "ts": now - 2, "since_progress_s": 60.0},
+        ])
+        prog, _ = bench._heartbeat_progress(p, now - 100)
+        assert prog == pytest.approx(now - 62, abs=1.0)
+
+
+# --------------------------------------------------------------------------
+# tail_run render smoke (CI satellite: committed fixture stream)
+# --------------------------------------------------------------------------
+
+class TestTailRunRender:
+    def test_render_fixture_stream_cli(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "tail_run.py"),
+             str(HB_FIXTURES / "sample_heartbeat.jsonl"),
+             "--evidence", str(HB_FIXTURES / "evidence")],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        out = proc.stdout
+        assert "bench flight record" in out
+        assert "wilcox_test" in out
+        assert "STALL #1" in out
+        assert "baseline" in out          # ledger ETA lookup worked
+        assert "cause=stall" in out       # partial sidecar rendered
+        assert "stack dump in stream" in out
+
+    def test_render_eta_for_open_stage_under_baseline(self):
+        sys.path.insert(0, str(REPO / "tools"))
+        import tail_run
+
+        lines = tail_run.read_stream(
+            str(HB_FIXTURES / "sample_heartbeat.jsonl"))
+        # truncate to tick #24: wilcox_test open at 83.2 s, baseline 196 s
+        upto = lines[:4]
+        baselines = tail_run._baselines_for(
+            tail_run._stream_state(lines)["key"],
+            str(HB_FIXTURES / "evidence"),
+        )
+        # the fixture manifest's partial entry must not poison the median
+        assert baselines["wilcox_test"]["baseline_s"] == pytest.approx(196.0)
+        panel = tail_run.render(upto, baselines)
+        assert "ETA ~" in panel
+        assert "1m52" in panel or "112" in panel  # 196 - 83.2 ≈ 112.8 s
+
+    def test_render_empty_stream_degrades(self, tmp_path):
+        sys.path.insert(0, str(REPO / "tools"))
+        import tail_run
+
+        panel = tail_run.render([{"t": "header", "ts": 1.0, "metric": "x"}])
+        assert "no heartbeat yet" in panel
+
+
+# --------------------------------------------------------------------------
+# profiler capture window (SIGUSR1's main-thread toggle)
+# --------------------------------------------------------------------------
+
+class TestCaptureToggle:
+    def test_mainthread_toggle_opens_and_closes_profile(self, tmp_path):
+        """What the SIGUSR1 handler runs: open on first call, close on
+        second, both on the main thread (thread-initiated TSL profiler
+        starts wedge on some builds — the handler avoids that path)."""
+        rec = LiveRecorder(str(tmp_path / "c"), heartbeat_s=0.1,
+                           stall_s=0.0,
+                           capture_dir=str(tmp_path / "cap")).start(
+                               install_signals=False)
+        tr = Tracer(sync="off")
+        with tr.span("work"):
+            rec.toggle_capture()
+            time.sleep(0.2)
+            rec.toggle_capture()
+        rec.stop("clean")
+        kinds = [ln["t"] for ln in _stream_lines(rec.hb_path)]
+        if "capture-failed" in kinds:
+            pytest.skip("jax profiler unavailable on this backend")
+        assert "capture" in kinds and "capture-done" in kinds
+        import glob
+
+        assert glob.glob(str(tmp_path / "cap" / "**" / "*"),
+                         recursive=True), "no profile artifacts written"
+
+
+# --------------------------------------------------------------------------
+# overhead guard (CI satellite: sampler adds <1% wall)
+# --------------------------------------------------------------------------
+
+class TestHeartbeatOverhead:
+    def test_sampler_busy_fraction_under_one_percent(self, tmp_path):
+        """The sampler's cumulative CPU time (tick building + stream
+        writes, self-measured per tick via thread_time so GIL waits are
+        not charged to it) must stay under 1% of the wall of a quick
+        bench-like stage at a production-ish interval."""
+        # reproduce a warm process: thousands of pre-existing compile
+        # events (the regression this guards against was per-tick
+        # aggregation of the whole process-lifetime event list)
+        from scconsensus_tpu.obs import device as obs_device
+
+        with obs_device._COMPILE_LOCK:
+            n0 = len(obs_device._COMPILE_EVENTS)
+            obs_device._COMPILE_EVENTS.extend(
+                ("pjit_compile", 0.01) for _ in range(5000)
+            )
+        try:
+            # 1 s interval: the sampler fraction scales as tick-cost /
+            # interval, and bench workers run at 5 s — a sub-second test
+            # interval would gate a 5x-harsher-than-production bar on
+            # thread_time scheduling noise
+            rec = LiveRecorder(str(tmp_path / "ovh"), metric="overhead",
+                               heartbeat_s=1.0, stall_s=0.0,
+                               flush_every_s=3600.0).start(
+                                   install_signals=False)
+            tr = Tracer(sync="off")
+            t0 = time.perf_counter()
+            with tr.span("busy_stage"):
+                x = 0.0
+                while time.perf_counter() - t0 < 3.2:  # the workload
+                    x += sum(i * i for i in range(1000))
+            wall = time.perf_counter() - t0
+            rec.stop("clean")
+        finally:
+            with obs_device._COMPILE_LOCK:
+                del obs_device._COMPILE_EVENTS[n0:n0 + 5000]
+        assert rec.ticks >= 3  # the sampler actually ran during the stage
+        frac = rec.tick_cpu_s / wall
+        assert frac < 0.01, (
+            f"sampler burned {frac:.2%} of wall "
+            f"({rec.tick_cpu_s:.3f}s over {wall:.2f}s, {rec.ticks} ticks)"
+        )

@@ -62,8 +62,14 @@ def _load_json(path: str) -> Dict[str, Any]:
 def _baseline_context(ledger: Ledger, history: List[Dict[str, Any]]
                       ) -> Tuple[Optional[List[Dict]], Optional[Dict]]:
     """Span tree + stage-cost table of the freshest baseline run that
-    recorded spans — the tree the offender diff runs against."""
+    recorded spans — the tree the offender diff runs against. Partial
+    (flight-recorder) entries are skipped: their trees hold truncated
+    open-span snapshots, not measurements."""
+    from scconsensus_tpu.obs.ledger import is_partial_entry
+
     for entry in reversed(history):
+        if is_partial_entry(entry):
+            continue
         try:
             rec = ledger.load(entry["file"])
         except (OSError, ValueError, KeyError):
@@ -124,6 +130,13 @@ def _report(verdict: regress.GateVerdict, drifts: List[Dict[str, Any]],
         k = verdict.key
         print(f"key: dataset={k['dataset']} backend={k['backend']} "
               f"config_fp={k['config_fp']}  history={verdict.n_history}")
+        if verdict.n_partial_excluded:
+            print(f"partial records in history: "
+                  f"{verdict.n_partial_excluded} (reported, never "
+                  "baselined)")
+        if verdict.candidate_termination:
+            print("candidate: PARTIAL record "
+                  f"(termination.cause={verdict.candidate_termination})")
         if verdict.note:
             print(f"note: {verdict.note}")
         for sv in verdict.stages:

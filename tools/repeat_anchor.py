@@ -43,6 +43,10 @@ def main() -> None:
         ckpt = os.path.join(ckpt_dir, f"run{i}.json")
         env = dict(os.environ, SCC_BENCH_CONFIG=config,
                    SCC_BENCH_PLATFORM="cpu", SCC_BENCH_CKPT=ckpt)
+        # the worker heartbeats by default (obs.live); name the stream so
+        # a second terminal can watch: python tools/tail_run.py <stream>
+        print(f"[repeat] run {i} flight record: "
+              f"{os.path.splitext(ckpt)[0]}_heartbeat.jsonl", flush=True)
         t0 = time.perf_counter()
         proc = subprocess.run(
             [sys.executable, os.path.join(base, "bench.py")],

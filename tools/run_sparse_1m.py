@@ -97,6 +97,42 @@ def main() -> None:
     from scconsensus_tpu import plot_contingency_table, recluster_de_consensus_fast
     from scconsensus_tpu.config import CompatFlags
 
+    probed = bool(env_flag("SCC_WILCOX_PROBE"))
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    if probed:
+        # a probed wall is a diagnosis, not a benchmark: route the full
+        # occupancy record to the PROFILE artifact and leave the SCALE
+        # artifact to an unprobed run
+        out = os.path.join(
+            base, f"PROFILE_r06_wilcox_{n_cells//1000 // 1000}m.json"
+            if n_cells >= 1_000_000
+            else f"PROFILE_r06_wilcox_{n_cells//1000}k.json"
+        )
+    else:
+        out = os.path.join(
+            base, f"SCALE_r06_cpu_{n_cells//1000}k_fullpipe_sparse.json"
+        )
+
+    # Flight recorder: this driver runs 30-60 min and used to leave NOTHING
+    # when killed. Heartbeats default ON here (SCC_OBS_HEARTBEAT still
+    # overrides the tick; the in-process stall watchdog dumps stacks after
+    # SCC_OBS_STALL_S, default 10 min for this driver).
+    from scconsensus_tpu.obs.live import LiveRecorder
+
+    # driver defaults apply only when the flags are UNSET — an explicit
+    # SCC_OBS_HEARTBEAT=0 / SCC_OBS_STALL_S=0 still means off (the
+    # registered semantics), same as every other recorder call site
+    recorder = LiveRecorder(
+        os.path.splitext(out)[0],
+        metric="sparse 1M full-pipeline flight record",
+        extra={"platform": env_flag("SCC_1M_PLATFORM"),
+               "n_cells": n_cells, "n_genes": n_genes},
+        heartbeat_s=(float(env_flag("SCC_OBS_HEARTBEAT"))
+                     if "SCC_OBS_HEARTBEAT" in os.environ else 30.0),
+        stall_s=(float(env_flag("SCC_OBS_STALL_S"))
+                 if "SCC_OBS_STALL_S" in os.environ else 600.0),
+    ).start()
+
     t_all = time.perf_counter()
     t0 = time.perf_counter()
     mat, truth = gen_sparse_scrna(n_cells, n_genes, n_clusters, seed=7)
@@ -135,9 +171,6 @@ def main() -> None:
         (s["occupancy"] for s in stage_recs
          if s.get("stage") == "wilcox_test" and "occupancy" in s), None
     )
-    from scconsensus_tpu.config import env_flag
-
-    probed = bool(env_flag("SCC_WILCOX_PROBE"))
     peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
     dense_gb = n_cells * n_genes * 4 / 1e9
     sil = [
@@ -173,19 +206,7 @@ def main() -> None:
             "total_wall_s": round(time.perf_counter() - t_all, 1),
         },
     )
-    base = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
-    out = os.path.join(
-        base, f"SCALE_r06_cpu_{n_cells//1000}k_fullpipe_sparse.json"
-    )
     if probed:
-        # a probed wall is a diagnosis, not a benchmark: route the full
-        # occupancy record to the PROFILE artifact and leave the SCALE
-        # artifact to an unprobed run
-        out = os.path.join(
-            base, f"PROFILE_r06_wilcox_{n_cells//1000 // 1000}m.json"
-            if n_cells >= 1_000_000
-            else f"PROFILE_r06_wilcox_{n_cells//1000}k.json"
-        )
         record["extra"]["occupancy"] = occupancy
     elif occupancy is not None:
         # unprobed runs still carry the cheap (unsynced) bucket shape stats
@@ -199,6 +220,7 @@ def main() -> None:
 
     write_chrome_trace(out.replace(".json", "_trace.json"),
                        record["spans"])
+    recorder.stop("clean")
     print(json.dumps(record), flush=True)
 
 

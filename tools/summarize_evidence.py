@@ -54,6 +54,13 @@ def _fmt(rec: dict) -> str:
         bits.append("DEGRADED")
     if ex.get("partial"):
         bits.append("PARTIAL")
+    term = rec.get("termination")
+    if isinstance(term, dict) and term.get("cause") not in (None, "clean"):
+        # flight-recorder partial: say how the run died and where it was
+        desc = f"TERMINATED={term['cause']}"
+        if term.get("last_span"):
+            desc += f"@{term['last_span']}"
+        bits.append(desc)
     if ex.get("wilcox_s") is not None:
         bits.append(f"wilcox_s={ex['wilcox_s']}")
     if ex.get("stage_throughput"):

@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Render a flight-recorder heartbeat stream as a live terminal view.
+
+Reads the ``*_heartbeat.jsonl`` stream an obs.live recorder appends to
+(bench workers, tools/run_sparse_1m.py, tunnel probes) and renders one
+status panel: last heartbeat age, uptime, host RSS / device HBM, compile
+stats, the open-span stack with elapsed walls, stall events, and — when
+the evidence ledger holds baseline history for the run's key — a
+per-stage ETA from the noise-banded baselines
+(``obs.regress.stage_baselines``). The sibling ``*_partial.json`` record
+(incrementally flushed by the same recorder) supplies completed-stage
+walls and the termination stamp.
+
+Usage:
+  python tools/tail_run.py RUN_heartbeat.jsonl            # one snapshot
+  python tools/tail_run.py RUN_heartbeat.jsonl --follow   # live view
+  ... [--evidence DIR] (ETA baselines; default SCC_EVIDENCE_DIR or
+      <repo>/evidence) [--interval S] [--no-eta]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if not n:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return "?"
+
+
+def _fmt_dur(s: Optional[float]) -> str:
+    if s is None:
+        return "?"
+    s = max(float(s), 0.0)
+    if s < 60:
+        return f"{s:.1f}s"
+    m, sec = divmod(int(s), 60)
+    h, m = divmod(m, 60)
+    return f"{h}h{m:02d}m{sec:02d}s" if h else f"{m}m{sec:02d}s"
+
+
+def read_stream(path: str, tail_bytes: int = 256 << 10
+                ) -> List[Dict[str, Any]]:
+    """Parsed stream lines: the file head (header/annotate always survive)
+    plus the most recent ``tail_bytes``. Lines mid-append parse-fail and
+    are skipped — crash-safety is line-granular by design."""
+    out: List[Dict[str, Any]] = []
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            chunk = f.read(16 << 10)
+            if size > len(chunk) + tail_bytes:
+                f.seek(size - tail_bytes)
+                chunk += b"\n" + f.read()
+            else:
+                chunk += f.read()
+    except OSError as e:
+        raise SystemExit(f"tail_run: cannot read {path}: {e}")
+    for line in chunk.decode("utf-8", errors="replace").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return out
+
+
+def _stream_state(lines: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the stream into one render state: header ∪ annotations, the
+    last heartbeat, the last stall event, and the end stamp if any."""
+    st: Dict[str, Any] = {"header": None, "key": None, "hb": None,
+                          "stall": None, "end": None, "extra": {}}
+    for ln in lines:
+        t = ln.get("t")
+        if t == "header":
+            st["header"] = ln
+            st["extra"].update(ln.get("extra") or {})
+            st["key"] = ln.get("key") or st["key"]
+        elif t == "annotate":
+            st["extra"].update(ln.get("extra") or {})
+            st["key"] = ln.get("key") or st["key"]
+        elif t == "hb":
+            st["hb"] = ln
+        elif t == "stall":
+            st["stall"] = ln
+        elif t == "end":
+            st["end"] = ln
+    return st
+
+
+def _baselines_for(key: Optional[Dict[str, str]], evidence_dir: str
+                   ) -> Dict[str, Dict[str, float]]:
+    """Noise-banded per-stage baselines for the stream's run key, or {}
+    (no key, no ledger, no history — the view degrades to walls only)."""
+    if not key or not os.path.isdir(evidence_dir):
+        return {}
+    try:
+        from scconsensus_tpu.obs.ledger import Ledger
+        from scconsensus_tpu.obs.regress import stage_baselines
+
+        return stage_baselines(Ledger(evidence_dir).history(key))
+    except Exception:
+        return {}
+
+
+def _partial_sidecar(stream_path: str) -> Optional[Dict[str, Any]]:
+    """The `<base>_partial.json` the same recorder flushes, via obs.live's
+    canonical naming (one scheme, no string-twin drift)."""
+    from scconsensus_tpu.obs.live import heartbeat_path, partial_record_path
+
+    base = stream_path[: -len("_heartbeat.jsonl")]
+    if heartbeat_path(base) != stream_path:
+        return None  # not a stream path the recorder would have produced
+    try:
+        with open(partial_record_path(base)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _span_line(sp: Dict[str, Any],
+               baselines: Dict[str, Dict[str, float]]) -> str:
+    name = sp.get("name", "?")
+    indent = "  " * (1 + int(sp.get("depth") or 0))
+    line = (f"{indent}{name:<24} {sp.get('kind', '?'):<7}"
+            f" {_fmt_dur(sp.get('elapsed_s')):>9}")
+    base = baselines.get(name)
+    if base and sp.get("kind") == "stage":
+        eta = base["baseline_s"] - float(sp.get("elapsed_s") or 0.0)
+        line += (f"   [baseline {_fmt_dur(base['baseline_s'])}"
+                 f" ±{_fmt_dur(base['band_s'])}"
+                 + (f" → ETA ~{_fmt_dur(eta)}" if eta > 0
+                    else " → over baseline"))
+        if eta <= 0 and base["band_s"]:
+            over = -eta
+            line += (" (within band)" if over <= base["band_s"]
+                     else f" by {_fmt_dur(over - base['band_s'])} past band")
+        line += "]"
+    return line
+
+
+def render(lines: List[Dict[str, Any]],
+           baselines: Optional[Dict[str, Dict[str, float]]] = None,
+           partial: Optional[Dict[str, Any]] = None,
+           now: Optional[float] = None) -> str:
+    """One status panel as text (pure function of its inputs — the render
+    smoke test drives it over a committed fixture stream)."""
+    baselines = baselines or {}
+    now = time.time() if now is None else now
+    st = _stream_state(lines)
+    out: List[str] = []
+    hdr = st["header"] or {}
+    out.append(f"flight record: {hdr.get('metric', '?')}"
+               + (f"   [pid {hdr['pid']}]" if hdr.get("pid") else ""))
+    if st["extra"]:
+        ident = ", ".join(f"{k}={v}" for k, v in sorted(st["extra"].items())
+                          if isinstance(v, (str, int, float, bool)))
+        if ident:
+            out.append(f"  workload: {ident}")
+    hb = st["hb"]
+    if hb is None:
+        out.append("  no heartbeat yet"
+                   + ("" if hdr else " (stream has no header either)"))
+    else:
+        age = now - float(hb.get("ts") or now)
+        bits = [f"last heartbeat {_fmt_dur(age)} ago",
+                f"tick #{hb.get('seq')}",
+                f"up {_fmt_dur(hb.get('up_s'))}",
+                f"rss {_fmt_bytes(hb.get('rss_bytes'))}"]
+        hbm = hb.get("hbm") or {}
+        if hbm.get("bytes_in_use") is not None:
+            bits.append(f"hbm {_fmt_bytes(hbm['bytes_in_use'])}"
+                        + (f"/{_fmt_bytes(hbm['bytes_limit'])}"
+                           if hbm.get("bytes_limit") else ""))
+        comp = hb.get("compile") or {}
+        if comp.get("events"):
+            bits.append(f"compiles {comp['events']}"
+                        f" ({_fmt_dur(comp.get('total_s'))})")
+        out.append("  " + "   ".join(bits))
+        out.append(f"  progress: last transition "
+                   f"{_fmt_dur(hb.get('since_progress_s'))} ago"
+                   f"   spans done: {hb.get('spans_done')}"
+                   f"   stalls: {hb.get('stalls', 0)}")
+        opens = hb.get("open_spans") or []
+        if opens:
+            out.append("  open spans:")
+            for sp in opens:
+                out.append(_span_line(sp, baselines))
+        else:
+            out.append("  open spans: (none)")
+    if st["stall"]:
+        sl = st["stall"]
+        out.append(f"  STALL #{sl.get('stalls')} at +{_fmt_dur((sl.get('ts') or 0) - float((st['header'] or {}).get('ts') or 0))}"
+                   f" (no progress {_fmt_dur(sl.get('since_progress_s'))});"
+                   " all-thread stack dump in stream"
+                   + (f"; capture → {sl['capture']}" if sl.get("capture")
+                      else ""))
+    if partial:
+        walls: List[Tuple[str, float]] = []
+        for s in partial.get("spans") or []:
+            if (isinstance(s, dict) and s.get("kind") == "stage"
+                    and not (s.get("attrs") or {}).get("open")):
+                w = s.get("wall_synced_s")
+                walls.append((s["name"], float(
+                    w if w is not None else s.get("wall_submitted_s", 0.0))))
+        if walls:
+            out.append("  completed stages: " + " | ".join(
+                f"{n} {_fmt_dur(w)}" for n, w in walls[-12:]))
+        term = partial.get("termination")
+        if isinstance(term, dict):
+            out.append(f"  partial record: cause={term.get('cause')}"
+                       + (f" last_span={term.get('last_span')}"
+                          if term.get("last_span") else "")
+                       + f" (flushed {_fmt_dur(now - float(term.get('flushed_unix') or now))} ago)")
+    if st["end"]:
+        out.append(f"  ended: cause={st['end'].get('cause')} after "
+                   f"{st['end'].get('ticks')} ticks, "
+                   f"{st['end'].get('stalls')} stall(s)")
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a flight-recorder heartbeat stream")
+    ap.add_argument("stream", help="*_heartbeat.jsonl path")
+    ap.add_argument("--follow", action="store_true",
+                    help="redraw every --interval seconds until the "
+                         "stream ends")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--evidence", default=None,
+                    help="ledger dir for per-stage ETA baselines "
+                         "(default: SCC_EVIDENCE_DIR or <repo>/evidence)")
+    ap.add_argument("--no-eta", action="store_true",
+                    help="skip the ledger baseline lookup")
+    args = ap.parse_args(argv)
+
+    from scconsensus_tpu.obs.ledger import default_evidence_dir
+
+    evidence = args.evidence or default_evidence_dir(_REPO)
+    baselines: Dict[str, Dict[str, float]] = {}
+    while True:
+        lines = read_stream(args.stream)
+        if not args.no_eta and not baselines:
+            baselines = _baselines_for(
+                _stream_state(lines)["key"], evidence
+            )
+        panel = render(lines, baselines,
+                       partial=_partial_sidecar(args.stream))
+        if args.follow:
+            sys.stdout.write("\x1b[2J\x1b[H" + panel + "\n")
+            sys.stdout.flush()
+            if any(ln.get("t") == "end" for ln in lines):
+                return 0
+            time.sleep(args.interval)
+        else:
+            print(panel)
+            return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

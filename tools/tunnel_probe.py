@@ -22,6 +22,14 @@ structured record per attempt to TUNNEL_LOG.jsonl:
 ``outcome``: alive | dead (probe answered but backend down) | timeout
 (killed at the deadline) | error (crashed / non-JSON output).
 
+Flight recorder (round 9): each child probe runs its phases (backend
+init / upload / download / matmul) inside tracer spans and emits an
+obs.live heartbeat stream; the parent records the probe's last heartbeat
+age + last open span in the TUNNEL_LOG entry (``"heartbeat"``), so a
+post-mortem can tell tunnel death (wedged mid-``upload``) from slow
+backend init (wedged in ``backend_init``) from an interpreter that never
+came up at all (no heartbeats).
+
 Usage: tunnel_probe.py [mb] [--timeout S] [--attempts N] [--log PATH]
        (defaults: 64 MB payload, 90 s per probe, 2 attempts,
        <repo>/TUNNEL_LOG.jsonl; --log '' disables logging)
@@ -35,45 +43,112 @@ import sys
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
 BACKOFF_BASE_S = 2.0
 BACKOFF_CAP_S = 60.0
+PROBE_HEARTBEAT_S = 1.0  # probes always heartbeat (short-lived, cheap)
 
 
-def probe_once(mb: float) -> dict:
+def _start_recorder(hb_base: str):
+    """Child-side flight recorder + tracer (obs.live): the stream is the
+    parent's post-mortem when this process wedges and gets killed."""
+    try:
+        from scconsensus_tpu.config import env_flag
+        from scconsensus_tpu.obs.live import LiveRecorder
+        from scconsensus_tpu.obs.trace import Tracer
+
+        rec = LiveRecorder(
+            hb_base, metric="tunnel probe",
+            heartbeat_s=float(env_flag("SCC_OBS_HEARTBEAT"))
+            or PROBE_HEARTBEAT_S,
+            flush_every_s=10.0,
+        ).start(install_signals=False)  # SIGKILLed children get no signals
+        return rec, Tracer(sync="off")
+    except Exception as e:
+        print(f"[tunnel_probe] recorder failed: {e!r}", file=sys.stderr)
+        return None, None
+
+
+def probe_once(mb: float, hb_base: str = "", hang_s: float = 0.0) -> dict:
     """The measurement itself (child side). Any hang here is the parent's
-    problem — by design this function takes no defensive timeouts."""
+    problem — by design this function takes no defensive timeouts; the
+    heartbeat stream (phase spans: backend_init / upload / download /
+    matmul) is what tells the parent WHERE it wedged."""
+    from contextlib import nullcontext
+
+    recorder, tracer = _start_recorder(hb_base) if hb_base else (None, None)
+
+    def _sp(name):
+        return (tracer.span(name, kind="stage", sync=False)
+                if tracer is not None else nullcontext())
+
     out = {"alive": False}
     t0 = time.perf_counter()
     try:
-        import jax
-        import jax.numpy as jnp
-        import numpy as np
+        with _sp("backend_init"):
+            if hang_s:  # simulated wedged backend init (tests)
+                time.sleep(hang_s)
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
 
-        dev = jax.devices()[0]
+            dev = jax.devices()[0]
         out["platform"] = dev.platform
         out["init_s"] = round(time.perf_counter() - t0, 2)
 
         host = np.ones((int(mb * 1e6 / 4),), np.float32)
-        t = time.perf_counter()
-        d = jax.device_put(host, dev)
-        d.block_until_ready()
-        up = time.perf_counter() - t
+        with _sp("upload"):
+            t = time.perf_counter()
+            d = jax.device_put(host, dev)
+            d.block_until_ready()
+            up = time.perf_counter() - t
         out["up_MBps"] = round(mb / up, 2)
 
-        t = time.perf_counter()
-        _ = np.asarray(d)
-        out["down_MBps"] = round(mb / (time.perf_counter() - t), 2)
+        with _sp("download"):
+            t = time.perf_counter()
+            _ = np.asarray(d)
+            out["down_MBps"] = round(mb / (time.perf_counter() - t), 2)
 
-        x = jnp.ones((2048, 2048), jnp.float32)
-        y = (x @ x).block_until_ready()  # noqa: F841  (compile + run)
-        t = time.perf_counter()
-        (x @ x).block_until_ready()
-        out["matmul_s"] = round(time.perf_counter() - t, 4)
+        with _sp("matmul"):
+            x = jnp.ones((2048, 2048), jnp.float32)
+            y = (x @ x).block_until_ready()  # noqa: F841  (compile + run)
+            t = time.perf_counter()
+            (x @ x).block_until_ready()
+            out["matmul_s"] = round(time.perf_counter() - t, 4)
         out["alive"] = True
     except Exception as e:  # fast failures; hangs are killed by the parent
         out["error"] = repr(e)[:300]
+    finally:
+        if recorder is not None:
+            recorder.stop("clean" if out["alive"] else "crash")
     return out
+
+
+def _heartbeat_summary(hb_base: str) -> "dict | None":
+    """Parent-side post-mortem of a child's stream: last heartbeat age,
+    tick count, and the span it was inside when last heard from. None =
+    the child never heartbeat at all (died before the recorder started —
+    itself diagnostic: not even the interpreter came up)."""
+    try:
+        from scconsensus_tpu.obs.live import (
+            heartbeat_path,
+            read_heartbeat_tail,
+        )
+
+        tail = read_heartbeat_tail(heartbeat_path(hb_base))
+    except Exception:
+        return None
+    if not tail:
+        return None
+    opens = tail.get("open_spans") or []
+    return {
+        "age_s": round(time.time() - float(tail.get("ts") or 0.0), 2),
+        "ticks": tail.get("seq"),
+        "last_t": tail.get("t"),
+        "last_span": opens[-1]["name"] if opens else None,
+        "since_progress_s": tail.get("since_progress_s"),
+    }
 
 
 def _append_log(path: str, record: dict) -> None:
@@ -88,11 +163,14 @@ def _append_log(path: str, record: dict) -> None:
         print(f"[tunnel_probe] log append failed: {e!r}", file=sys.stderr)
 
 
-def _run_child(mb: float, timeout_s: float, hang_s: float) -> tuple:
+def _run_child(mb: float, timeout_s: float, hang_s: float,
+               hb_base: str = "") -> tuple:
     """(outcome, probe_dict, wall_s) for one hard-timeout child attempt."""
     cmd = [sys.executable, os.path.abspath(__file__), str(mb), "--once"]
     if hang_s:
         cmd += ["--test-hang-s", str(hang_s)]
+    if hb_base:
+        cmd += ["--hb-base", hb_base]
     t0 = time.perf_counter()
     try:
         proc = subprocess.run(
@@ -131,43 +209,56 @@ def main() -> int:
                     help="attempt-log path ('' disables)")
     ap.add_argument("--once", action="store_true",
                     help="run the measurement in-process (child mode)")
+    ap.add_argument("--hb-base", default="",
+                    help="flight-recorder path base for the child probe "
+                         "(parent-managed; '' skips the recorder)")
     ap.add_argument("--test-hang-s", type=float, default=0.0,
                     help=argparse.SUPPRESS)  # simulates a wedged backend
     args = ap.parse_args()
 
     if args.once:
-        if args.test_hang_s:
-            time.sleep(args.test_hang_s)
-        print(json.dumps(probe_once(args.mb)), flush=True)
+        print(json.dumps(probe_once(
+            args.mb, hb_base=args.hb_base, hang_s=args.test_hang_s
+        )), flush=True)
         return 0
 
+    import shutil
+    import tempfile
+
+    hb_dir = tempfile.mkdtemp(prefix="scc-probe-hb-")
     probe: dict = {"alive": False}
-    for attempt in range(1, max(1, args.attempts) + 1):
-        outcome, probe, wall = _run_child(
-            args.mb, args.timeout, args.test_hang_s
-        )
-        last = outcome == "alive" or attempt >= args.attempts
-        backoff = 0.0 if last else min(
-            BACKOFF_BASE_S * 2 ** (attempt - 1), BACKOFF_CAP_S
-        )
-        _append_log(args.log, {
-            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(),
-            "attempt": attempt,
-            "of": max(1, args.attempts),
-            "timeout_s": args.timeout,
-            "wall_s": round(wall, 2),
-            "outcome": outcome,
-            "backoff_s": backoff,
-            "probe": probe,
-        })
-        if outcome == "alive":
-            break
-        print(f"[tunnel_probe] attempt {attempt}/{args.attempts}: "
-              f"{outcome} after {wall:.1f}s"
-              + (f"; backing off {backoff:.0f}s" if backoff else ""),
-              file=sys.stderr, flush=True)
-        if backoff:
-            time.sleep(backoff)
+    try:
+        for attempt in range(1, max(1, args.attempts) + 1):
+            hb_base = os.path.join(hb_dir, f"attempt{attempt}")
+            outcome, probe, wall = _run_child(
+                args.mb, args.timeout, args.test_hang_s, hb_base=hb_base
+            )
+            last = outcome == "alive" or attempt >= args.attempts
+            backoff = 0.0 if last else min(
+                BACKOFF_BASE_S * 2 ** (attempt - 1), BACKOFF_CAP_S
+            )
+            _append_log(args.log, {
+                "ts": datetime.datetime.now(
+                    datetime.timezone.utc).isoformat(),
+                "attempt": attempt,
+                "of": max(1, args.attempts),
+                "timeout_s": args.timeout,
+                "wall_s": round(wall, 2),
+                "outcome": outcome,
+                "backoff_s": backoff,
+                "probe": probe,
+                "heartbeat": _heartbeat_summary(hb_base),
+            })
+            if outcome == "alive":
+                break
+            print(f"[tunnel_probe] attempt {attempt}/{args.attempts}: "
+                  f"{outcome} after {wall:.1f}s"
+                  + (f"; backing off {backoff:.0f}s" if backoff else ""),
+                  file=sys.stderr, flush=True)
+            if backoff:
+                time.sleep(backoff)
+    finally:
+        shutil.rmtree(hb_dir, ignore_errors=True)
     print(json.dumps(probe), flush=True)
     return 0 if probe.get("alive") else 1
 
