@@ -124,6 +124,17 @@ def _trim_line(parsed: dict) -> str:
         parsed["spans"] = []
         parsed.setdefault("extra", {})["truncated"] = True
         line = json.dumps(parsed)
+    # quality section next (funnel per-pair lists scale with K²): it
+    # lives whole in the checkpoint + ledger record; the tail keeps only
+    # the sentinel-trip count, the one quality fact a driver must see
+    if len(line) > 1500 and parsed.get("quality"):
+        trips = (parsed["quality"].get("numeric_health") or {}).get(
+            "trips") or []
+        parsed.pop("quality")
+        if trips:
+            parsed.setdefault("extra", {})["sentinel_trips"] = len(trips)
+        parsed.setdefault("extra", {})["truncated"] = True
+        line = json.dumps(parsed)
     drop_order = ("wilcox_occupancy", "stage_throughput",
                   "numeric_fingerprint", "prior_failures", "pallas_vs_xla",
                   "mfu", "edger_error", "wilcox_error", "wilcox_stages",
@@ -425,7 +436,26 @@ def run_refine_config(n_cells, n_genes, n_clusters, n_way=2, method="wilcox",
                 data, consensus, method="wilcox",
                 deep_split_values=(1, 2, 3, 4), **refine_kw,
             )
-        return time.perf_counter() - t0, result
+        elapsed = time.perf_counter() - t0
+        try:
+            # the pipeline scored ARI vs the CONSENSUS it was handed; the
+            # bench additionally scores the final cut against BOTH raw
+            # input labelings (quality.ari_final_vs — the same
+            # implementation cluster_structure uses)
+            from scconsensus_tpu.obs import quality as obs_quality
+
+            cs = (result.metrics.get("quality") or {}).get(
+                "cluster_structure")
+            if cs is not None:
+                refs = obs_quality.ari_final_vs(
+                    result.dynamic_labels,
+                    {f"input_{i}": lab for i, lab in enumerate(labelings)},
+                )
+                if refs:
+                    cs["ari_final_vs"] = refs
+        except Exception as e:
+            log(f"[bench] ari_final_vs failed: {e!r}")
+        return elapsed, result
 
     return once
 
@@ -708,17 +738,29 @@ DEGRADED = {
 
 def _stamp_fingerprint(extra: dict, result) -> None:
     """Numeric-drift sentinel payload on the run record: DE log-p
-    quantiles + NB tagwise-dispersion quantiles (edgeR runs only). The
-    perf gate compares these against evidence/NUMERIC_PINS.json and
-    requires a drift-ledger acknowledgement for any shift."""
+    quantiles, NB tagwise-dispersion quantiles (edgeR runs), and the
+    final-label ARI vs the input consensus (from the pipeline's quality
+    section). Stamped on EVERY run; the ledger copies it onto the
+    manifest entry, so the perf gate can flag quality drift on any
+    dataset — against evidence/NUMERIC_PINS.json when the dataset is
+    pinned, else against the key's previous clean run — with the
+    DRIFT_LEDGER.jsonl acknowledgement flow either way."""
     try:
         from scconsensus_tpu.obs.regress import drift_fingerprint
 
         aux = result.de.aux or {}
-        extra["numeric_fingerprint"] = drift_fingerprint(
+        fp = drift_fingerprint(
             log_p=result.de.log_p,
             dispersions=aux.get("tagwise_dispersion"),
         )
+        q = (result.metrics or {}).get("quality") or {}
+        ari = (q.get("cluster_structure") or {}).get("ari_vs_input") or {}
+        if ari:
+            # the LAST deepSplit cut's agreement with the input labeling:
+            # a quality shift here is exactly the silent-recut failure
+            # mode the drift ledger exists to force into the open
+            fp["label_ari_vs_input"] = list(ari.values())[-1]
+        extra["numeric_fingerprint"] = fp
     except Exception as e:
         log(f"[bench] fingerprint failed: {e!r}")
 
@@ -765,6 +807,9 @@ def _worker_body() -> None:
     # achieved vs. cost-model throughput (one memoized AOT compile per
     # kernel shape; steady-state walls are unaffected)
     os.environ.setdefault("SCC_OBS_COST", "1")
+    # numeric-health sentinels on by default too (obs.quality): a NaN mid-
+    # pipeline must land span-attributed on the run record, not in labels
+    os.environ.setdefault("SCC_OBS_NUMERIC", "1")
 
     import jax
 
@@ -867,7 +912,8 @@ def _worker_body() -> None:
     if kind == "flagship":
         n_cells = cfg["n_cells"]
         size = f"{n_cells // 1000}k" if n_cells >= 1000 else str(n_cells)
-        state = {"edger": None, "wilcox": None, "spans": None}
+        state = {"edger": None, "wilcox": None, "spans": None,
+                 "quality": None}
 
         def _record():
             """Cumulative flagship record from whatever has finished."""
@@ -905,6 +951,7 @@ def _worker_body() -> None:
                 metric=metric, value=value, unit="seconds",
                 vs_baseline=vsb, extra=extra,
                 spans=state.get("spans") or [],
+                quality=state.get("quality"),
             )
 
         def _ckpt():
@@ -944,8 +991,10 @@ def _worker_body() -> None:
             extra["edger_stages"] = _stage_dict(result)
             extra["union_size"] = int(result.de_gene_union_idx.size)
             _stamp_fingerprint(extra, result)
-            # the headline workload's span tree rides the run record
+            # the headline workload's span tree + quality section ride
+            # the run record
             state["spans"] = result.metrics.get("spans") or state["spans"]
+            state["quality"] = result.metrics.get("quality")
             return elapsed
 
         state["edger"] = _section(extra, "edger", _edger)
@@ -971,6 +1020,8 @@ def _worker_body() -> None:
                 extra["wilcox_occupancy"] = occ
             if not state["spans"]:  # edgeR section died: wilcox spans stand in
                 state["spans"] = fast_res.metrics.get("spans")
+            if not state["quality"]:
+                state["quality"] = fast_res.metrics.get("quality")
             return fast_s
 
         state["wilcox"] = _section(extra, "wilcox", _wilcox)
@@ -1008,9 +1059,11 @@ def _worker_body() -> None:
             vs_baseline=_vsb(secs, extra),
             extra=extra,
             spans=refine_state.get("spans") or [],
+            quality=refine_state.get("quality"),
         )
 
-    refine_state = {"secs": None, "phase": "cold", "spans": None}
+    refine_state = {"secs": None, "phase": "cold", "spans": None,
+                    "quality": None}
     _install_term_handler(lambda: _refine_record(refine_state["secs"]))
     if _LIVE is not None:
         _LIVE.record_fn = lambda: _refine_record(refine_state["secs"])
@@ -1019,8 +1072,10 @@ def _worker_body() -> None:
     log(f"[bench] cold run (includes XLA compiles): {cold_s:.2f}s")
     extra["cold_s"] = round(cold_s, 3)
     refine_state["secs"] = cold_s
-    # spans only; drop the cold result before the measured steady run
+    # spans + quality only; drop the cold result before the measured
+    # steady run
     refine_state["spans"] = cold_res.metrics.get("spans")
+    refine_state["quality"] = cold_res.metrics.get("quality")
     del cold_res
     if env_flag("SCC_BENCH_COLD"):
         elapsed = cold_s
@@ -1031,6 +1086,7 @@ def _worker_body() -> None:
         # cold number under a steady-labeled metric
         refine_state["secs"] = elapsed
         refine_state["spans"] = result.metrics.get("spans")
+        refine_state["quality"] = result.metrics.get("quality")
         refine_state["phase"] = "steady"
         log(f"[bench] steady-state run: {elapsed:.2f}s; union="
             f"{result.de_gene_union_idx.size} genes; "

@@ -80,6 +80,13 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "Evidence-ledger directory override (default <cwd>/evidence"
                 "; bench.py anchors it next to itself). The test suite "
                 "points it at a tmp dir."),
+        EnvFlag("SCC_OBS_NUMERIC", bool, False,
+                "Numeric-health sentinels (obs.quality): cheap NaN/Inf "
+                "guards at stage boundaries in the pipeline, the DE "
+                "engine, and the NB driver. A tripped sentinel records "
+                "the offending span + array name + count into span "
+                "metrics and the run record's quality section. bench.py "
+                "workers and tools/run_sparse_1m.py default it on."),
         # --- DE engine ---
         EnvFlag("SCC_WILCOX_PROBE", bool, False,
                 "Synced per-bucket occupancy DIAGNOSIS of the Wilcoxon "

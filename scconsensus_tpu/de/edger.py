@@ -73,6 +73,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from scconsensus_tpu.obs import quality as obs_quality
 from scconsensus_tpu.obs.cost import attach_cost
 from scconsensus_tpu.ops.negbin import (
     common_dispersion_grid,
@@ -525,6 +526,11 @@ def run_edger_pairs(
         common_parts.append(common_dispersion_grid(cl, j_deltas)[: p1 - p0])
     # chunks dispatch async; ONE (P,) fetch instead of a sync per chunk
     common = np.asarray(jnp.concatenate(common_parts))
+    if obs_quality.enabled():
+        # a NaN/Inf dispersion here poisons every downstream tagwise grid
+        # and exact test — catch it at the phase boundary, span-attributed
+        obs_quality.check_array("common_dispersion", common,
+                                where="edger_nb")
 
     prof.mark("common_grid")
 
@@ -575,6 +581,9 @@ def run_edger_pairs(
     # per-task dispersions here, and the caller exposes the full array only
     # through a lazy fetch.
     j_tagwise = jnp.concatenate(tw_parts, axis=0)
+    if obs_quality.enabled():
+        obs_quality.check_array("tagwise_dispersion", j_tagwise,
+                                where="edger_nb")
 
     prof.mark("tagwise")
 
@@ -654,6 +663,9 @@ def run_edger_pairs(
             jnp.concatenate(all_rows)
         ].set(jnp.concatenate(all_vals)).reshape(P, G)
 
+    if obs_quality.enabled():
+        obs_quality.check_array("exact_test_log_p", j_log_p, kinds=("nan",),
+                                where="edger_nb")
     prof.mark("exact_small")
 
     # ---- logFC from equalized abundances --------------------------------

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from scconsensus_tpu.config import ReclusterConfig
+from scconsensus_tpu.obs import quality as obs_quality
 from scconsensus_tpu.ops.gates import (
     compute_aggregates_cid,
     pair_gates_fast,
@@ -887,6 +888,7 @@ def pairwise_de(
     if method in ("wilcox", "wilcoxon", "roc", "bimod", "t"):
         slow = method == "wilcoxon"
         j_ok = jnp.asarray(pair_ok)
+        funnel_gate = None
         with timer.stage("gates"):
             if slow:
                 mean_gate, log_fc = pair_gates_slow(
@@ -909,6 +911,13 @@ def pairwise_de(
                     only_pos=config.only_pos,
                 )
                 tested = gate & j_ok[:, None]
+                # per-pair survivors of the FULL Seurat gate battery
+                # (pct ∧ mean-expression ∧ |logFC|) — the funnel's
+                # logfc_gate stage. The mean gate lives inside the jitted
+                # composite; counting here is the only place the engine's
+                # literal gating is observable, so the funnel's
+                # tested-stage drop measures group-size skips ONLY
+                funnel_gate = jnp.sum(gate, axis=1)
         aux: Optional[Dict[str, np.ndarray]] = None
         stage_name = (
             "wilcox_test" if method in ("wilcox", "wilcoxon") else f"{method}_test"
@@ -982,7 +991,28 @@ def pairwise_de(
             # are NaN on every path.
             log_p = jnp.where(tested if not slow else j_ok[:, None],
                               log_p, jnp.nan)
-        with timer.stage("bh_adjust"):
+            if obs_quality.enabled():
+                # Legitimate NaN budget: untested entries, PLUS tested
+                # entries whose (pair, gene) slice is degenerate — pooled
+                # variance ~0 (constant/all-zero genes) NaNs the rank
+                # test (all ties → sigma 0) and Welch t (0/0) by
+                # documented contract. Without this the slow path, which
+                # gates nothing, would false-trip on every all-zero gene
+                # of a sparse matrix.
+                npool = jnp.maximum(
+                    agg.counts[pi] + agg.counts[pj], 1.0
+                )[:, None]
+                pmean = (agg.sum_log[:, pi].T + agg.sum_log[:, pj].T) \
+                    / npool
+                pvar = (agg.sum_sq[:, pi].T + agg.sum_sq[:, pj].T) \
+                    / npool - pmean * pmean
+                degen = pvar <= 1e-4 * jnp.maximum(pmean * pmean, 1e-6)
+                obs_quality.check_array(
+                    "log_p", log_p, kinds=("nan",),
+                    expected_nan=log_p.size - jnp.sum(tested & ~degen),
+                    span=srec,
+                )
+        with timer.stage("bh_adjust") as bh_rec:
             if slow:
                 # BH with explicit n = G over all genes (§2d-4 slow semantics).
                 log_q = (
@@ -992,6 +1022,17 @@ def pairwise_de(
                 )
             else:
                 log_q = bh_adjust_masked(log_p, tested)
+            if obs_quality.enabled():
+                # BH masks out non-FINITE p (a -inf underflow gets NaN q
+                # by design), so the legitimate-NaN budget is everything
+                # outside tested-and-finite
+                obs_quality.check_array(
+                    "log_q", log_q, kinds=("nan",),
+                    expected_nan=log_q.size - jnp.sum(
+                        tested & jnp.isfinite(log_p)
+                    ),
+                    span=bh_rec,
+                )
         with timer.stage("de_call"):
             log_thr = float(np.log(np.float32(config.q_val_thrs)))
             if slow:
@@ -1003,6 +1044,10 @@ def pairwise_de(
             else:
                 de = tested & (log_q < log_thr)
             de = de & ~jnp.isnan(log_q)
+        if funnel_gate is not None:
+            # (P,)-sized, rides aux so de_funnel can report the engine's
+            # LITERAL gate battery instead of re-deriving part of it
+            aux = {**(aux or {}), "funnel_gate_full": funnel_gate}
         # The (P, G) statistics stay DEVICE arrays inside the result and
         # materialize per field on first access (class docstring) — the
         # pipeline consumes only de_mask + log_fc; nothing forces the other
@@ -1055,12 +1100,32 @@ def pairwise_de(
         # _expand_rows_any accepts both forms.
         log_p = _expand_rows_any(nb.log_p, ok_rows, P)
         log_fc = _expand_rows(nb.log_fc, ok_rows, P)
-        with timer.stage("bh_adjust"):
+        if obs_quality.enabled():
+            # rows of group-size-skipped pairs are legitimate NaN
+            skipped_nan = int(P - ok_rows.size) * G
+            obs_quality.check_array(
+                "nb_log_p", jnp.asarray(log_p), kinds=("nan",),
+                expected_nan=skipped_nan, where="edger_nb",
+            )
+            obs_quality.check_array(
+                "nb_log_fc", log_fc, kinds=("inf",), where="edger_nb",
+            )
+        with timer.stage("bh_adjust") as bh_rec:
             log_q = (
                 bh_adjust(jnp.asarray(log_p), n=jnp.asarray(float(G)))
                 if config.compat.bh_reference_n
                 else bh_adjust(jnp.asarray(log_p))
             )
+            if obs_quality.enabled():
+                # non-finite p (skipped pairs' NaN, -inf underflow) is
+                # masked out of BH and legitimately NaN in q
+                obs_quality.check_array(
+                    "log_q", log_q, kinds=("nan",),
+                    expected_nan=log_q.size - jnp.sum(
+                        jnp.isfinite(jnp.asarray(log_p))
+                    ),
+                    span=bh_rec,
+                )
         with timer.stage("de_call"):
             log_thr = float(np.log(np.float32(config.q_val_thrs)))
             if config.compat.edger_drop_logfc:

@@ -20,6 +20,7 @@ import numpy as np
 
 from scconsensus_tpu.config import CompatFlags, ReclusterConfig
 from scconsensus_tpu.de import de_gene_union, pairwise_de
+from scconsensus_tpu.obs import quality as obs_quality
 from scconsensus_tpu.de.engine import PairwiseDEResult
 from scconsensus_tpu.ops.colors import labels_to_colors
 from scconsensus_tpu.ops.linkage import HClustTree, ward_linkage
@@ -231,6 +232,10 @@ def _refine_impl(
             return {"scores": np.asarray(scores)}
 
         embedding = store.cached("embed", _embed)["scores"]
+        if obs_quality.enabled():
+            # a NaN/Inf PCA score silently corrupts every downstream
+            # distance/tree/cut — trip here, span-attributed
+            obs_quality.check_array("embedding", embedding, span=rec)
 
     with timer.stage("tree", n_cells=N) as rec:
         approx = N > config.approx_threshold
@@ -367,6 +372,38 @@ def _refine_impl(
         # interpreted loop (R/reclusterDEConsensus.R:272-275) is one reduction
         nodg = sparse_nodg(data)
 
+    # Quality telemetry (obs.quality): the DE gate funnel, window-ladder
+    # occupancy, cluster structure vs the input labeling, and any
+    # numeric-sentinel trips — assembled into result.metrics["quality"]
+    # (and from there onto bench/driver run records as the schema's
+    # additive `quality` section). Never fatal: a quality failure must
+    # not cost the science it describes.
+    quality_section = None
+    with timer.stage("quality") as qrec:
+        try:
+            if config.compat.return_silhouette and obs_quality.enabled():
+                sils = np.array([
+                    d["silhouette"] for d in deep_split_info
+                    if d.get("silhouette") is not None
+                ], np.float64)
+                obs_quality.check_array("silhouette", sils, span=qrec,
+                                        where="silhouette")
+            quality_section = obs_quality.build_quality_section(
+                de_result=de_res, config=config,
+                dynamic_labels=dynamic_labels,
+                deep_split_info=deep_split_info,
+                input_labels=np.asarray(labels).astype(str),
+                occupancy=obs_quality.occupancy_from_stage_records(
+                    timer.records
+                ),
+                tracer=timer.tracer,
+            )
+            for k, v in (quality_section.get("de_funnel") or {}).get(
+                    "total", {}).items():
+                qrec.metrics.counter(k).add(float(v))
+        except Exception as e:  # pragma: no cover - defensive
+            timer.logger.warning("quality telemetry failed: %r", e)
+
     union_names = (
         np.asarray(gene_names)[union] if gene_names is not None else union.copy()
     )
@@ -383,6 +420,8 @@ def _refine_impl(
         de=de_res,
         metrics=timer.as_dict(),
     )
+    if quality_section is not None:
+        result.metrics["quality"] = quality_section
 
     if config.plot_name:
         with timer.stage("report"):

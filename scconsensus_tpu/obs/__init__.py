@@ -25,7 +25,13 @@ Four layers, consumed together through one versioned run-record schema:
     in-process stall watchdog with faulthandler stack dumps (and
     on-demand profiler captures), crash-safe incremental partial run
     records stamped with a termination cause (``tools/tail_run.py``
-    renders the stream live).
+    renders the stream live);
+  * ``obs.quality`` — scientific quality telemetry: numeric-health
+    sentinels (SCC_OBS_NUMERIC NaN/Inf guards at stage boundaries),
+    the DE gate funnel / rank-sum ladder occupancy / cluster-structure
+    sections of the run record, and the quality-schema validator
+    (``tools/explain_run.py`` renders one run — or a two-run diff — as
+    a Markdown report).
 
 ``utils.logging.StageTimer`` remains as a thin back-compat shim over
 ``Tracer``; ``bench.py`` and the ``tools/`` emitters all build their
@@ -42,6 +48,8 @@ from scconsensus_tpu.obs.trace import (
 from scconsensus_tpu.obs.cost import attach_cost, stage_cost_summary
 from scconsensus_tpu.obs.live import LiveRecorder, active_recorder, flush_active
 from scconsensus_tpu.obs.metrics import MetricSet
+from scconsensus_tpu.obs import quality  # noqa: F401 (after trace: it
+#                                          reads the partially-built pkg)
 from scconsensus_tpu.obs.export import (
     SCHEMA_NAME,
     SCHEMA_VERSION,
@@ -53,6 +61,7 @@ from scconsensus_tpu.obs.export import (
 )
 
 __all__ = [
+    "quality",
     "Span",
     "Tracer",
     "current_tracer",

@@ -29,6 +29,14 @@ schema ``scc-run-record`` version 1 — top-level keys:
                     records written by a clean single-shot emitter; any
                     cause other than "clean" marks the record PARTIAL —
                     ledger-ingestible but never a regression baseline.
+  quality           OPTIONAL (still schema version 1 — additive): the
+                    scientific-quality section (obs.quality) — DE gate
+                    funnel (per pair + aggregated, counts monotone down
+                    the funnel), rank-sum window-ladder occupancy,
+                    consensus/cluster structure (size histograms,
+                    contingency entropy, ARI vs inputs, churn, per-
+                    deepSplit silhouette), and numeric-health sentinel
+                    trips. Validated by obs.quality.validate_quality.
 
 The Chrome trace export (:func:`chrome_trace`) converts the span tree to
 ``traceEvents`` complete ("X") events — open the file in Perfetto
@@ -95,11 +103,14 @@ def build_run_record(
     device: Optional[Dict[str, Any]] = None,
     transfers: Optional[Dict[str, Any]] = None,
     platform: Optional[str] = None,
+    quality: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One schema-v1 run record. Pass ``tracer`` to take spans + compile
     stats from it; or pre-built ``spans`` (e.g. a resumed pipeline's
     ``result.metrics["spans"]``); or neither (orchestrator-side records
-    written before any measurement ran)."""
+    written before any measurement ran). ``quality`` (optional) attaches
+    the obs.quality section — funnels, cluster structure, sentinel
+    trips."""
     if spans is None:
         spans = tracer.span_records() if tracer is not None else []
     extra = dict(extra or {})
@@ -114,7 +125,7 @@ def build_run_record(
             run["jax_version"] = sys.modules["jax"].__version__
         except Exception:
             pass
-    return {
+    rec = {
         "schema": SCHEMA_NAME,
         "schema_version": SCHEMA_VERSION,
         "metric": metric,
@@ -127,6 +138,9 @@ def build_run_record(
         else _device_section(tracer, transfers),
         "extra": extra,
     }
+    if quality is not None:
+        rec["quality"] = quality
+    return rec
 
 
 def check_schema_version(rec: Dict[str, Any], source: str = "record") -> str:
@@ -203,6 +217,13 @@ def validate_run_record(rec: Dict[str, Any]) -> None:
             raise ValueError("termination.last_span must be a string or null")
         if not isinstance(term.get("open_spans", []), list):
             raise ValueError("termination.open_spans must be a list")
+    qual = rec.get("quality")
+    if qual is not None:
+        # lazy import: quality pulls in the trace layer, which exporters
+        # (and the jax-free orchestrator) must not load unconditionally
+        from scconsensus_tpu.obs.quality import validate_quality
+
+        validate_quality(qual)
 
 
 # --------------------------------------------------------------------------

@@ -357,6 +357,16 @@ class Ledger:
             # baseline computation (regress.stage_baselines) reads only
             # the manifest and must skip partials without loading files
             entry["termination"] = cause
+        fp = (rec.get("extra") or {}).get("numeric_fingerprint")
+        if isinstance(fp, dict) and fp:
+            # every ingested run is fingerprint-stamped on its manifest
+            # entry (not just the pinned reference workload), so the gate
+            # can flag quality drift on ANY dataset by comparing a
+            # candidate against its own key's newest clean entry
+            # (regress.history_pins) under the DRIFT_LEDGER ack flow
+            entry["numeric_fingerprint"] = {
+                k: v for k, v in fp.items() if not k.startswith("_")
+            }
         try:
             from scconsensus_tpu.obs.cost import stage_cost_summary
 

@@ -438,6 +438,16 @@ class LiveRecorder:
         }
         if metrics:
             hb["metrics"] = metrics
+        try:
+            # quality panel: sentinel trip count + latest funnel totals,
+            # so tail_run shows NaN storms and empty funnels LIVE
+            from scconsensus_tpu.obs import quality as obs_quality
+
+            q = obs_quality.live_summary(tr)
+            if q:
+                hb["quality"] = q
+        except Exception:
+            pass
         mem = obs_device.memory_snapshot()
         if mem is not None:
             hb["hbm"] = mem

@@ -1,0 +1,645 @@
+"""Scientific quality telemetry: what the pipeline COMPUTED, not just
+where the time went.
+
+Rounds 7–9 made every run's performance self-describing (spans, device
+samplers, the ledger, the flight recorder) but left the science opaque:
+unexplained numeric variance and "the sparsity math doesn't visibly add
+up" could only be chased by rereading raw JSON, and the r8 drift
+sentinels pinned three quantities on one fixed reference workload. This
+module adds the quality half, riding the same tracer/ledger machinery:
+
+  * **Numeric-health sentinels** (``SCC_OBS_NUMERIC``; bench workers and
+    the 1M driver default it on) — cheap NaN/Inf guards attached at
+    stage boundaries. A tripped sentinel records the offending span,
+    array name, and counts into span metrics AND the run record's
+    ``quality.numeric_health`` section, instead of letting a NaN
+    silently propagate to labels. Arrays where NaN is the legitimate
+    untested marker (the (P, G) ``log_p``) pass their expected NaN count
+    so only EXCESS NaNs trip.
+
+  * **Algorithm funnels** — the DE gate funnel (genes in → pct-gate →
+    logFC-gate → tested → significant, per pair and aggregated), the
+    rank-sum window-ladder occupancy (the ``SCC_WILCOX_PROBE`` payload
+    promoted to first-class schema), and consensus/cluster structure
+    (cluster-size histograms, contingency entropy vs the input labeling,
+    ARI of final labels vs inputs, label churn across the deepSplit
+    ladder, per-deepSplit silhouette).
+
+  * **The ``quality`` run-record section** — an additive
+    ``scc-run-record`` v1 extension (validated by
+    ``export.validate_run_record`` via :func:`validate_quality`), built
+    by the pipeline's ``quality`` stage and stamped onto bench/driver
+    records; ``tools/explain_run.py`` renders it as the Markdown report
+    a reviewer reads instead of raw JSON.
+
+Every compute entry point accumulates its own wall into a module counter
+(:func:`consumed_cpu_s`) so the tier-1 overhead guard can assert quality
+telemetry stays <2 % of an instrumented run's wall.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import weakref
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from scconsensus_tpu.config import env_flag
+from scconsensus_tpu.obs import trace as obs_trace
+
+__all__ = [
+    "FUNNEL_STAGES",
+    "enabled",
+    "check_array",
+    "trips",
+    "note_funnel",
+    "numeric_health",
+    "de_funnel",
+    "wilcox_ladder",
+    "occupancy_from_stage_records",
+    "ari_final_vs",
+    "cluster_structure",
+    "build_quality_section",
+    "validate_quality",
+    "live_summary",
+    "consumed_cpu_s",
+    "reset_cpu",
+]
+
+_LOG = logging.getLogger("scconsensus_tpu")
+
+# Canonical funnel order: counts must be monotone non-increasing along it.
+# The pct/logFC gate stages exist only on the fast (Seurat-gated) path;
+# slow-path and NB funnels carry input → tested → significant.
+FUNNEL_STAGES = ("input", "pct_gate", "logfc_gate", "tested", "significant")
+
+
+# --------------------------------------------------------------------------
+# overhead accounting (the <2%-of-wall guard reads this)
+# --------------------------------------------------------------------------
+
+_CPU = {"s": 0.0}
+
+
+def consumed_cpu_s() -> float:
+    """Cumulative wall-clock spent inside quality computations in this
+    process (sentinel checks included — their device fetch waits are real
+    overhead and are charged here on purpose)."""
+    return _CPU["s"]
+
+
+def reset_cpu() -> None:
+    _CPU["s"] = 0.0
+
+
+@contextmanager
+def _timed():
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _CPU["s"] += time.perf_counter() - t0
+
+
+# --------------------------------------------------------------------------
+# numeric-health sentinels
+# --------------------------------------------------------------------------
+
+def enabled() -> bool:
+    """Sentinel master switch (``SCC_OBS_NUMERIC``). Off by default so
+    library users pay zero extra device dispatches; bench workers and the
+    long drivers default it on."""
+    return bool(env_flag("SCC_OBS_NUMERIC"))
+
+
+# Trips (and the latest funnel totals for the live quality panel) are
+# keyed by tracer (weakref — a finished run's state must not outlive its
+# span tree) with a bounded orphan sink for tracer-less use. Tracer
+# scoping matters for the funnel too: a process-global "last funnel"
+# would leak one section's funnel into the next section's heartbeats
+# (bench runs edger → wilcox → probes in one process).
+_TRIPS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_ORPHAN: Dict[str, Any] = {"checks": 0, "trips": []}
+_TRIP_CAP = 64
+
+
+def _sink(tracer=None) -> Dict[str, Any]:
+    if tracer is None:
+        tracer = obs_trace.current_tracer() or obs_trace.last_tracer()
+    if tracer is None:
+        return _ORPHAN
+    sink = _TRIPS.get(tracer)
+    if sink is None:
+        sink = {"checks": 0, "trips": []}
+        _TRIPS[tracer] = sink
+    return sink
+
+
+def trips(tracer=None) -> List[Dict[str, Any]]:
+    """Sentinel trips recorded against ``tracer`` (default: the ambient /
+    most recent tracer, falling back to the orphan list)."""
+    return list(_sink(tracer)["trips"])
+
+
+def note_funnel(totals: Dict[str, Any], tracer=None) -> None:
+    """Record a run's latest DE-funnel totals against its tracer so the
+    live heartbeat's quality panel can show them (the funnel lands once
+    per run, late; the heartbeat wants the newest for THIS run only)."""
+    _sink(tracer)["funnel"] = dict(totals)
+
+
+def checks_run(tracer=None) -> int:
+    return int(_sink(tracer)["checks"])
+
+
+def _is_jax(x) -> bool:
+    return type(x).__module__.startswith("jax")
+
+
+def check_array(name: str, x, kinds: Sequence[str] = ("nan", "inf"),
+                expected_nan=0, span=None, where: Optional[str] = None,
+                ) -> Optional[Dict[str, Any]]:
+    """Numeric-health check of one array at a stage boundary.
+
+    No-op (and dispatch-free) when the sentinel flag is off. ``kinds``
+    picks the guards; ``expected_nan`` is the count of LEGITIMATE NaNs
+    (the untested-entry marker in ``log_p``) — host int or device scalar,
+    fetched together with the counts in one transfer. Only an excess
+    trips. A trip is recorded onto the innermost span's metrics
+    (``numeric_nan``/``numeric_inf`` counters + a ``numeric_trips`` attrs
+    list), the tracer's trip list, and the package logger — surfaced,
+    never swallowed, and never fatal."""
+    if not enabled() or x is None:
+        return None
+    with _timed():
+        try:
+            if _is_jax(x):
+                import jax
+                import jax.numpy as jnp
+
+                if not jnp.issubdtype(x.dtype, jnp.floating):
+                    return None
+                nan_d = jnp.sum(jnp.isnan(x)) if "nan" in kinds else 0
+                inf_d = jnp.sum(jnp.isinf(x)) if "inf" in kinds else 0
+                nan_c, inf_c, exp_c = (int(v) for v in jax.device_get(
+                    (nan_d, inf_d, expected_nan)
+                ))
+                size = int(x.size)
+            else:
+                xa = np.asarray(x)
+                if not np.issubdtype(xa.dtype, np.floating):
+                    return None
+                nan_c = int(np.isnan(xa).sum()) if "nan" in kinds else 0
+                inf_c = int(np.isinf(xa).sum()) if "inf" in kinds else 0
+                exp_c = int(np.asarray(expected_nan))
+                size = int(xa.size)
+        except Exception as e:  # a guard must never kill the pipeline
+            _LOG.warning("numeric sentinel %r failed: %r", name, e)
+            return None
+        sink = _sink(None)
+        sink["checks"] += 1
+        excess_nan = max(nan_c - exp_c, 0)
+        if excess_nan == 0 and inf_c == 0:
+            return None
+        if span is None:
+            span = obs_trace.current_span()
+        span_name = where or (span.name if span is not None else "<no-span>")
+        trip = {
+            "span": span_name,
+            "array": name,
+            "nan": excess_nan,
+            "inf": inf_c,
+            "size": size,
+        }
+        if span is not None and span.span_id >= 0:
+            try:
+                span.metrics.counter("numeric_nan").add(excess_nan)
+                span.metrics.counter("numeric_inf").add(inf_c)
+                span.setdefault("numeric_trips", []).append(
+                    {"array": name, "nan": excess_nan, "inf": inf_c}
+                )
+            except Exception:
+                pass
+        if len(sink["trips"]) < _TRIP_CAP:
+            sink["trips"].append(trip)
+        _LOG.warning(
+            "NUMERIC SENTINEL: %s/%s has %d unexpected NaN, %d Inf "
+            "(of %d elements)", span_name, name, excess_nan, inf_c, size,
+        )
+        return trip
+
+
+def numeric_health(tracer=None) -> Dict[str, Any]:
+    """The run record's ``quality.numeric_health`` section."""
+    sink = _sink(tracer)
+    return {
+        "enabled": enabled(),
+        "checks": int(sink["checks"]),
+        "trips": list(sink["trips"]),
+    }
+
+
+# --------------------------------------------------------------------------
+# DE gate funnel
+# --------------------------------------------------------------------------
+
+def _row_counts(mask) -> np.ndarray:
+    """(P,) per-pair True counts of a (P, G) bool mask, host or device —
+    only the (P,)-sized result ever crosses the link."""
+    if _is_jax(mask):
+        import jax.numpy as jnp
+
+        return np.asarray(jnp.sum(mask, axis=1)).astype(np.int64)
+    return np.asarray(mask).sum(axis=1).astype(np.int64)
+
+
+def de_funnel(result, config) -> Optional[Dict[str, Any]]:
+    """Gate funnel of one :class:`~scconsensus_tpu.de.engine.PairwiseDEResult`
+    under its config: genes in → pct-gate → logFC-gate → tested →
+    significant, per pair and aggregated. Reads the RAW (possibly still
+    device-resident) result fields and fetches only (P,)-sized count
+    vectors — the funnel must not force the (P, G) statistics through the
+    slow link. Gate stages appear only when the fast-path pct arrays
+    exist; slow/NB funnels are input → tested → significant.
+
+    ``logfc_gate`` is the engine's LITERAL full gate battery (pct ∧
+    mean-expression ∧ |logFC|) when the result carries the engine's
+    count (``aux["funnel_gate_full"]``), so the tested-stage drop
+    measures group-size skips only; on older stored results it degrades
+    to a pct ∧ |logFC| recomputation (then the mean gate's rejections
+    land in the tested drop)."""
+    with _timed():
+        raw = lambda f: object.__getattribute__(result, f)  # noqa: E731
+        tested = raw("tested")
+        de_mask = raw("de_mask")
+        P = int(result.n_pairs)
+        G = int(tested.shape[1])
+        per_pair: Dict[str, np.ndarray] = {
+            "input": np.full(P, G, np.int64),
+        }
+        pct1, pct2 = raw("pct1"), raw("pct2")
+        if pct1 is not None and pct2 is not None:
+            xp = None
+            if _is_jax(pct1):
+                import jax.numpy as xp
+            else:
+                xp = np
+            alpha = xp.maximum(pct1, pct2)
+            pct_gate = alpha > config.min_pct
+            if config.min_diff_pct > -float("inf"):
+                pct_gate = pct_gate & (
+                    (alpha - xp.minimum(pct1, pct2)) > config.min_diff_pct
+                )
+            per_pair["pct_gate"] = _row_counts(pct_gate)
+            # raw attr access: touching result.aux would materialize the
+            # WHOLE aux dict (roc's (P, G) auc/power) through the link
+            gate_full = (raw("aux") or {}).get("funnel_gate_full")
+            if gate_full is not None:
+                per_pair["logfc_gate"] = np.asarray(
+                    gate_full).astype(np.int64)
+            else:
+                log_fc = raw("log_fc")
+                if config.only_pos:
+                    fc_ok = log_fc > config.log_fc_thrs
+                else:
+                    fc_ok = xp.abs(log_fc) > config.log_fc_thrs
+                per_pair["logfc_gate"] = _row_counts(pct_gate & fc_ok)
+        per_pair["tested"] = _row_counts(tested)
+        per_pair["significant"] = _row_counts(de_mask)
+        total = {k: int(v.sum()) for k, v in per_pair.items()}
+        out = {
+            "n_pairs": P,
+            "n_genes": G,
+            "cluster_names": [str(n) for n in result.cluster_names],
+            "pair_i": [int(v) for v in result.pair_i],
+            "pair_j": [int(v) for v in result.pair_j],
+            "per_pair": {k: [int(x) for x in v]
+                         for k, v in per_pair.items()},
+            "total": total,
+        }
+        note_funnel(total)
+        return out
+
+
+# --------------------------------------------------------------------------
+# rank-sum window-ladder occupancy (SCC_WILCOX_PROBE payload, promoted)
+# --------------------------------------------------------------------------
+
+_LADDER_BUCKET_KEYS = (
+    "window", "scan_width", "sort_width", "n_genes", "padded_rows",
+    "real_elems", "padded_elems", "pad_ratio", "nnz_min", "nnz_max",
+    "table_height", "overflow_genes", "wall_s", "sort_s",
+)
+
+
+def wilcox_ladder(occupancy: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Normalize an engine occupancy-probe payload into the schema's
+    ``quality.wilcox_ladder`` section: the per-bucket rows plus the
+    aggregate padded-vs-real accounting that makes the sparsity math
+    visibly add up."""
+    if not isinstance(occupancy, dict):
+        return None
+    with _timed():
+        buckets = [
+            {k: b.get(k) for k in _LADDER_BUCKET_KEYS if b.get(k) is not None}
+            for b in occupancy.get("buckets") or []
+            if isinstance(b, dict)
+        ]
+        real = sum(int(b.get("real_elems") or 0) for b in buckets)
+        padded = sum(int(b.get("padded_elems") or 0) for b in buckets)
+        out = {
+            "windowed": bool(occupancy.get("windowed")),
+            "input": occupancy.get("input"),
+            "kernel": occupancy.get("kernel"),
+            "n_genes": int(occupancy.get("n_genes") or 0),
+            "n_cells": int(occupancy.get("n_cells") or 0),
+            "window_floor": occupancy.get("window_floor"),
+            "n_buckets": len(buckets),
+            "genes_bucketed": sum(
+                int(b.get("n_genes") or 0) for b in buckets
+            ),
+            "real_elems": real,
+            "padded_elems": padded,
+            "pad_ratio": round(padded / real, 3) if real else None,
+            "overflow_genes": sum(
+                int(b.get("overflow_genes") or 0) for b in buckets
+            ),
+            "buckets": buckets,
+        }
+        return out
+
+
+def occupancy_from_stage_records(stage_records) -> Optional[Dict[str, Any]]:
+    """The engine's occupancy probe, wherever a stage record carries it."""
+    for rec in stage_records or []:
+        if isinstance(rec, dict) and isinstance(rec.get("occupancy"), dict):
+            return rec["occupancy"]
+    return None
+
+
+# --------------------------------------------------------------------------
+# consensus / cluster structure
+# --------------------------------------------------------------------------
+
+def _entropy(counts: np.ndarray) -> float:
+    p = counts[counts > 0].astype(np.float64)
+    p /= p.sum()
+    return float(-(p * np.log(p)).sum())
+
+
+def _contingency_entropy(a: np.ndarray, b: np.ndarray) -> float:
+    """Shannon entropy (nats) of the joint contingency distribution of
+    two labelings — low when the cut merely renames the input clusters,
+    high when mass spreads across many (input, output) cells."""
+    _, ai = np.unique(a, return_inverse=True)
+    _, bi = np.unique(b, return_inverse=True)
+    c = np.zeros((ai.max() + 1, bi.max() + 1), np.int64)
+    np.add.at(c, (ai, bi), 1)
+    return _entropy(c.ravel())
+
+
+def ari_final_vs(dynamic_labels: Dict[str, np.ndarray],
+                 ref_labelings: Dict[str, Any]) -> Dict[str, float]:
+    """ARI of the FINAL cut against named reference labelings (e.g. a
+    bench run's two raw input labelings). The one implementation behind
+    both :func:`cluster_structure` and bench's post-hoc stamp — size-
+    mismatched references are skipped, not crashed on."""
+    from scconsensus_tpu.obs.regress import adjusted_rand_index
+
+    if not dynamic_labels or not ref_labelings:
+        return {}
+    final = np.asarray(dynamic_labels[list(dynamic_labels)[-1]])
+    out: Dict[str, float] = {}
+    for rname, rl in ref_labelings.items():
+        rl = np.asarray(rl)
+        if rl.size == final.size:
+            out[str(rname)] = round(adjusted_rand_index(final, rl), 6)
+    return out
+
+
+def cluster_structure(dynamic_labels: Dict[str, np.ndarray],
+                      deep_split_info: Optional[List[Dict]] = None,
+                      input_labels=None,
+                      ref_labelings: Optional[Dict[str, Any]] = None,
+                      ) -> Dict[str, Any]:
+    """Cluster-structure section: per-cut size histograms + silhouette,
+    contingency entropy and ARI vs the input labeling(s), and label churn
+    (ARI between consecutive deepSplit cuts). ``ref_labelings`` adds
+    named extra references (e.g. a bench run's two raw input labelings)
+    scored against the FINAL cut."""
+    from scconsensus_tpu.obs.regress import adjusted_rand_index
+
+    with _timed():
+        info_by_ds = {
+            int(d.get("deep_split")): d for d in (deep_split_info or [])
+            if isinstance(d, dict) and d.get("deep_split") is not None
+        }
+        inp = np.asarray(input_labels) if input_labels is not None else None
+        cuts: List[Dict[str, Any]] = []
+        ari_vs_input: Dict[str, float] = {}
+        names = list(dynamic_labels)
+        for key in names:
+            lab = np.asarray(dynamic_labels[key])
+            assigned = lab[lab > 0] if np.issubdtype(
+                lab.dtype, np.number) else lab
+            _, counts = np.unique(assigned, return_counts=True)
+            sizes = sorted((int(c) for c in counts), reverse=True)
+            cut: Dict[str, Any] = {
+                "cut": key,
+                "n_clusters": len(sizes),
+                "n_cells": int(lab.size),
+                "n_unassigned": int(lab.size - int(counts.sum())),
+                "sizes": sizes,
+            }
+            try:
+                ds = int(str(key).rsplit(":", 1)[-1])
+            except ValueError:
+                ds = None
+            d = info_by_ds.get(ds)
+            if d and d.get("silhouette") is not None:
+                cut["silhouette"] = float(d["silhouette"])
+                if d.get("silhouette_method"):
+                    cut["silhouette_method"] = d["silhouette_method"]
+            if inp is not None and inp.size == lab.size:
+                cut["contingency_entropy"] = round(
+                    _contingency_entropy(inp, lab), 6
+                )
+                ari_vs_input[key] = round(
+                    adjusted_rand_index(lab, inp), 6
+                )
+            cuts.append(cut)
+        churn = []
+        for a, b in zip(names, names[1:]):
+            la = np.asarray(dynamic_labels[a])
+            lb = np.asarray(dynamic_labels[b])
+            if la.size == lb.size:
+                churn.append({
+                    "from": a, "to": b,
+                    "ari": round(adjusted_rand_index(la, lb), 6),
+                })
+        out: Dict[str, Any] = {"cuts": cuts, "churn": churn}
+        if ari_vs_input:
+            out["ari_vs_input"] = ari_vs_input
+        if inp is not None:
+            _, ic = np.unique(inp, return_counts=True)
+            out["input_entropy"] = round(_entropy(ic), 6)
+            out["n_input_clusters"] = int(ic.size)
+        if ref_labelings and names:
+            refs = ari_final_vs(dynamic_labels, ref_labelings)
+            if refs:
+                out["ari_final_vs"] = refs
+        return out
+
+
+# --------------------------------------------------------------------------
+# assembly + validation
+# --------------------------------------------------------------------------
+
+def build_quality_section(de_result=None, config=None,
+                          dynamic_labels=None, deep_split_info=None,
+                          input_labels=None, ref_labelings=None,
+                          occupancy=None, tracer=None) -> Dict[str, Any]:
+    """One ``quality`` section from whatever the run computed — every
+    sub-section optional, numeric health always present."""
+    q: Dict[str, Any] = {}
+    if de_result is not None and config is not None:
+        f = de_funnel(de_result, config)
+        if f:
+            q["de_funnel"] = f
+    if occupancy is not None:
+        lad = wilcox_ladder(occupancy)
+        if lad:
+            q["wilcox_ladder"] = lad
+    if dynamic_labels:
+        q["cluster_structure"] = cluster_structure(
+            dynamic_labels, deep_split_info, input_labels, ref_labelings,
+        )
+    q["numeric_health"] = numeric_health(tracer)
+    return q
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(f"quality section: {msg}")
+
+
+def validate_quality(q: Dict[str, Any]) -> None:
+    """Structural validation of a record's ``quality`` section (the
+    additive schema-v1 extension). Raises ValueError on the first
+    violation; ``export.validate_run_record`` calls this, so 'schema-
+    valid' covers quality fields everywhere it covers spans."""
+    _require(isinstance(q, dict), "must be an object")
+    f = q.get("de_funnel")
+    if f is not None:
+        _require(isinstance(f, dict), "de_funnel must be an object")
+        total = f.get("total")
+        _require(isinstance(total, dict) and total,
+                 "de_funnel.total must be a non-empty object")
+        stages = [s for s in FUNNEL_STAGES if s in total]
+        _require("input" in stages and "significant" in stages,
+                 "de_funnel.total needs at least input and significant")
+        for s in total:
+            _require(s in FUNNEL_STAGES,
+                     f"unknown funnel stage {s!r}")
+            v = total[s]
+            _require(isinstance(v, (int, float)) and v >= 0,
+                     f"de_funnel.total.{s} must be a count >= 0")
+        for a, b in zip(stages, stages[1:]):
+            _require(total[a] >= total[b],
+                     f"funnel not monotone: total.{a}={total[a]} < "
+                     f"total.{b}={total[b]}")
+        pp = f.get("per_pair")
+        if pp is not None:
+            _require(isinstance(pp, dict), "de_funnel.per_pair must be "
+                     "an object")
+            n_pairs = f.get("n_pairs")
+            for s, vals in pp.items():
+                _require(s in FUNNEL_STAGES,
+                         f"unknown per_pair funnel stage {s!r}")
+                _require(isinstance(vals, list),
+                         f"per_pair.{s} must be a list")
+                if isinstance(n_pairs, int):
+                    _require(len(vals) == n_pairs,
+                             f"per_pair.{s} has {len(vals)} entries, "
+                             f"n_pairs={n_pairs}")
+                if s in total:
+                    _require(sum(vals) == total[s],
+                             f"per_pair.{s} sums to {sum(vals)}, "
+                             f"total.{s}={total[s]}")
+            pstages = [s for s in FUNNEL_STAGES if s in pp]
+            for a, b in zip(pstages, pstages[1:]):
+                for i, (va, vb) in enumerate(zip(pp[a], pp[b])):
+                    _require(va >= vb,
+                             f"funnel not monotone at pair {i}: "
+                             f"{a}={va} < {b}={vb}")
+    cs = q.get("cluster_structure")
+    if cs is not None:
+        _require(isinstance(cs, dict), "cluster_structure must be an "
+                 "object")
+        _require(isinstance(cs.get("cuts"), list),
+                 "cluster_structure.cuts must be a list")
+        for i, cut in enumerate(cs["cuts"]):
+            _require(isinstance(cut, dict), f"cuts[{i}] is not an object")
+            _require(isinstance(cut.get("n_clusters"), int)
+                     and cut["n_clusters"] >= 0,
+                     f"cuts[{i}].n_clusters must be an int >= 0")
+            sizes = cut.get("sizes")
+            _require(isinstance(sizes, list)
+                     and len(sizes) == cut["n_clusters"],
+                     f"cuts[{i}].sizes must list one size per cluster")
+            _require(all(isinstance(s, int) and s >= 0 for s in sizes),
+                     f"cuts[{i}].sizes must be counts >= 0")
+        for key in ("ari_vs_input", "ari_final_vs"):
+            d = cs.get(key)
+            if d is not None:
+                _require(isinstance(d, dict), f"{key} must be an object")
+                for k, v in d.items():
+                    _require(isinstance(v, (int, float))
+                             and -1.0 - 1e-9 <= v <= 1.0 + 1e-9,
+                             f"{key}[{k!r}] must be an ARI in [-1, 1]")
+    nh = q.get("numeric_health")
+    if nh is not None:
+        _require(isinstance(nh, dict), "numeric_health must be an object")
+        _require(isinstance(nh.get("trips", []), list),
+                 "numeric_health.trips must be a list")
+        for i, t in enumerate(nh.get("trips", [])):
+            _require(isinstance(t, dict), f"trips[{i}] is not an object")
+            for k in ("span", "array"):
+                _require(isinstance(t.get(k), str) and t[k],
+                         f"trips[{i}].{k} must be a non-empty string")
+            for k in ("nan", "inf"):
+                _require(isinstance(t.get(k, 0), int) and t.get(k, 0) >= 0,
+                         f"trips[{i}].{k} must be an int >= 0")
+    lad = q.get("wilcox_ladder")
+    if lad is not None:
+        _require(isinstance(lad, dict), "wilcox_ladder must be an object")
+        _require(isinstance(lad.get("buckets", []), list),
+                 "wilcox_ladder.buckets must be a list")
+        for i, b in enumerate(lad.get("buckets", [])):
+            _require(isinstance(b, dict)
+                     and isinstance(b.get("window"), int)
+                     and isinstance(b.get("n_genes"), int),
+                     f"wilcox_ladder.buckets[{i}] needs int window/"
+                     "n_genes")
+
+
+# --------------------------------------------------------------------------
+# live view (heartbeat quality panel)
+# --------------------------------------------------------------------------
+
+def live_summary(tracer=None) -> Optional[Dict[str, Any]]:
+    """Compact quality snapshot for one heartbeat tick: sentinel trip
+    count (+ the newest trip) and the latest DE funnel totals. None when
+    there is nothing to say — the stream stays lean on healthy runs that
+    have not reached the funnel yet."""
+    sink = _sink(tracer)
+    out: Dict[str, Any] = {}
+    if sink["trips"]:
+        out["trips"] = len(sink["trips"])
+        out["last_trip"] = dict(sink["trips"][-1])
+    if sink.get("funnel"):
+        out["funnel"] = dict(sink["funnel"])
+    return out or None

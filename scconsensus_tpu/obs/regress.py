@@ -32,7 +32,7 @@ import json
 import math
 import os
 import time
-from typing import Any, Dict, Iterable, List, Optional, Sequence
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = [
     "ANCHOR_RUNS",
@@ -42,8 +42,11 @@ __all__ = [
     "diff_span_trees",
     "gate_record",
     "DRIFT_LEDGER_NAME",
+    "PINS_NAME",
     "REFERENCE_DATASET",
     "pins_for_dataset",
+    "history_pins",
+    "resolve_pins",
     "drift_fingerprint",
     "load_drift_acks",
     "append_drift_ack",
@@ -493,6 +496,57 @@ def pins_for_dataset(pins_doc: Any, dataset: str
         return None
     pins = pins_doc.get(dataset)
     return pins if isinstance(pins, dict) else None
+
+
+PINS_NAME = "NUMERIC_PINS.json"
+
+
+def resolve_pins(evidence_dir: str, dataset: str,
+                 history: Sequence[Dict[str, Any]]
+                 ) -> "Tuple[Optional[Dict[str, Any]], Optional[str]]":
+    """ONE pin-resolution policy for every fingerprint consumer
+    (perf_gate and explain_run must never disagree about what a
+    candidate is compared against): (1) the evidence dir's
+    ``NUMERIC_PINS.json`` entry for ``dataset`` when present and
+    non-empty; (2) else the key's newest clean manifest entry
+    (:func:`history_pins`); (3) else ``(None, None)`` — the candidate
+    seeds. Returns ``(pins, source)`` where source is the pins filename
+    or ``"history"``. An unreadable pins file falls through to the
+    history fallback rather than erroring — a half-written pins file
+    must not mask drift checking entirely."""
+    pins = None
+    path = os.path.join(evidence_dir, PINS_NAME)
+    try:
+        with open(path) as f:
+            pins = pins_for_dataset(json.load(f), dataset)
+    except (OSError, json.JSONDecodeError):
+        pins = None
+    if pins:
+        return pins, PINS_NAME
+    hp = history_pins(history)
+    if hp:
+        return hp, "history"
+    return None, None
+
+
+def history_pins(history: Sequence[Dict[str, Any]]
+                 ) -> Optional[Dict[str, Any]]:
+    """Implicit pins for a dataset with no NUMERIC_PINS entry: the newest
+    CLEAN manifest entry's ledger-stamped ``numeric_fingerprint`` (every
+    ingested run is stamped — obs.ledger). The quality-drift contract
+    then covers any dataset: a candidate fingerprint shifting against its
+    own key's previous run fails the gate until acknowledged in the drift
+    ledger, exactly like a pinned-reference shift. Returns None with no
+    usable history (a first run seeds, it cannot drift)."""
+    from scconsensus_tpu.obs.ledger import is_partial_entry
+
+    for e in reversed(list(history)):
+        if is_partial_entry(e):
+            continue  # a truncated run's fingerprint is not a contract
+        fp = e.get("numeric_fingerprint")
+        if isinstance(fp, dict) and fp:
+            return fp
+    return None
 
 
 def write_pins(path: str) -> Dict[str, Any]:

@@ -50,8 +50,29 @@ class TestRegistryLint:
         for name in ("SCC_WILCOX_PROBE", "SCC_NO_RUNSPACE",
                      "SCC_EDGER_PROFILE", "SCC_STAGE_SYNC",
                      "SCC_TRACE_SYNC", "SCC_TRACE_DIR",
-                     "SCC_OBS_TRANSFERS"):
+                     "SCC_OBS_TRANSFERS", "SCC_OBS_NUMERIC"):
             assert name in ENV_FLAGS
+
+    def test_readme_flag_table_matches_registry(self):
+        """The README SCC_* reference table is GENERATED from the
+        registry (tools/gen_env_docs.py); a flag added without rerunning
+        the generator fails here — 3 r9 flags shipped with no doc
+        updates, which is the drift this pins shut."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "scc_gen_env_docs", REPO / "tools" / "gen_env_docs.py"
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.update_readme(str(REPO / "README.md"), check=True), (
+            "README SCC_* flag table is stale — run "
+            "`python tools/gen_env_docs.py`"
+        )
+        # every registered flag has a row; no ghost rows for dead flags
+        table = mod.render_table()
+        for name in ENV_FLAGS:
+            assert f"`{name}`" in table
 
 
 class TestEnvFlagParsing:

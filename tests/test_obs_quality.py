@@ -1,0 +1,589 @@
+"""Quality-telemetry layer (ISSUE 5 tentpole acceptance): numeric-health
+sentinels attribute an injected NaN to its span on the run record (the
+pipeline surfaces, never swallows, the event); the DE gate funnel is
+conserved (counts monotone down the funnel, per-pair sums equal totals);
+a cite8k-shaped record validates with funnel + cluster-structure +
+fingerprint fields populated and ``tools/explain_run.py`` renders it
+(and a two-run diff) to Markdown; fingerprint drift gates against the
+key's previous clean run when no pins exist; and quality-telemetry
+overhead stays under 2% of an instrumented run's wall (the r9
+sampler-guard pattern)."""
+
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from scconsensus_tpu.config import ReclusterConfig
+from scconsensus_tpu.models.pipeline import recluster_de_consensus_fast
+from scconsensus_tpu.obs import quality
+from scconsensus_tpu.obs import regress
+from scconsensus_tpu.obs.export import build_run_record, validate_run_record
+from scconsensus_tpu.obs.ledger import Ledger, run_key
+from scconsensus_tpu.obs.trace import Tracer
+from scconsensus_tpu.utils.synthetic import noisy_labeling, synthetic_scrna
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture
+def numeric_on(monkeypatch):
+    monkeypatch.setenv("SCC_OBS_NUMERIC", "1")
+
+
+def _tiny():
+    data, truth, _ = synthetic_scrna(
+        n_genes=100, n_cells=240, n_clusters=3, n_markers_per_cluster=8,
+        seed=5,
+    )
+    return data, noisy_labeling(truth, 0.05, seed=2)
+
+
+# --------------------------------------------------------------------------
+# numeric-health sentinels
+# --------------------------------------------------------------------------
+
+class TestSentinel:
+    def test_trip_records_span_metrics_and_registry(self, numeric_on):
+        tr = Tracer(sync="off")
+        with tr.span("stage_x") as sp:
+            x = np.ones(50, np.float32)
+            x[3] = np.nan
+            x[7] = np.inf
+            trip = quality.check_array("bad", x, span=sp)
+        assert trip == {"span": "stage_x", "array": "bad", "nan": 1,
+                        "inf": 1, "size": 50}
+        assert quality.trips(tr) == [trip]
+        rec = sp.record()
+        assert rec["metrics"]["numeric_nan"]["value"] == 1
+        assert rec["metrics"]["numeric_inf"]["value"] == 1
+        assert rec["attrs"]["numeric_trips"] == [
+            {"array": "bad", "nan": 1, "inf": 1}
+        ]
+
+    def test_expected_nan_does_not_trip(self, numeric_on):
+        tr = Tracer(sync="off")
+        with tr.span("s") as sp:
+            x = np.full(10, np.nan, np.float32)
+            assert quality.check_array("lp", x, kinds=("nan",),
+                                       expected_nan=10, span=sp) is None
+            # one MORE NaN than expected trips with the excess only
+            trip = quality.check_array("lp", x, kinds=("nan",),
+                                       expected_nan=9, span=sp)
+        assert trip["nan"] == 1
+        assert quality.checks_run(tr) == 2
+
+    def test_disabled_flag_is_noop(self, monkeypatch):
+        monkeypatch.delenv("SCC_OBS_NUMERIC", raising=False)
+        tr = Tracer(sync="off")
+        with tr.span("s"):
+            x = np.full(4, np.nan, np.float32)
+            assert quality.check_array("lp", x) is None
+        assert quality.trips(tr) == []
+
+    def test_device_array_and_device_expected(self, numeric_on):
+        import jax.numpy as jnp
+
+        tr = Tracer(sync="off")
+        with tr.span("s") as sp:
+            x = jnp.where(jnp.arange(6) < 2, jnp.nan, 1.0)
+            trip = quality.check_array(
+                "dev", x, kinds=("nan",),
+                expected_nan=jnp.asarray(1), span=sp,
+            )
+        assert trip["nan"] == 1
+
+    def test_injected_nan_mid_wilcox_names_span_on_record(
+            self, numeric_on, monkeypatch):
+        """Acceptance: NaN injected mid-``wilcox_test`` on a tiny
+        workload → the run record names the span and the pipeline
+        surfaces (warns + records) instead of swallowing."""
+        import logging
+
+        import jax.numpy as jnp
+
+        import scconsensus_tpu.de.engine as eng
+
+        orig = eng._run_wilcox_device
+
+        def poisoned(*a, **kw):
+            lp, u = orig(*a, **kw)
+            return lp.at[0, :5].set(jnp.nan), u  # NaN in TESTED entries
+
+        monkeypatch.setattr(eng, "_run_wilcox_device", poisoned)
+        data, labels = _tiny()
+        # the package logger is propagate=False: capture with our own
+        # handler rather than relying on propagation to caplog
+        messages = []
+        handler = logging.Handler()
+        handler.emit = lambda r: messages.append(r.getMessage())
+        pkg_logger = logging.getLogger("scconsensus_tpu")
+        pkg_logger.addHandler(handler)
+        try:
+            res = recluster_de_consensus_fast(
+                data, labels, deep_split_values=(1,), mesh=None,
+            )
+        finally:
+            pkg_logger.removeHandler(handler)
+        nh = res.metrics["quality"]["numeric_health"]
+        (trip,) = [t for t in nh["trips"] if t["array"] == "log_p"]
+        assert trip["span"] == "wilcox_test"
+        assert trip["nan"] == 5
+        # span-attributed on the span tree itself, not just the summary
+        tripped = [s for s in res.metrics["spans"]
+                   if (s.get("attrs") or {}).get("numeric_trips")]
+        assert any(s["name"] == "wilcox_test" for s in tripped)
+        # surfaced through the logger too
+        assert any("NUMERIC SENTINEL" in m for m in messages)
+        # and the assembled run record round-trips through validation
+        rec = build_run_record(
+            "t", 1.0, spans=res.metrics["spans"],
+            quality=res.metrics["quality"],
+            extra={"config": "quick", "platform": "cpu"},
+        )
+        validate_run_record(rec)
+        assert rec["quality"]["numeric_health"]["trips"][0]["span"] == \
+            "wilcox_test"
+
+
+# --------------------------------------------------------------------------
+# funnel conservation (property tests)
+# --------------------------------------------------------------------------
+
+def _funnel_is_conserved(f):
+    stages = [s for s in quality.FUNNEL_STAGES if s in f["total"]]
+    # monotone totals down the funnel
+    for a, b in zip(stages, stages[1:]):
+        assert f["total"][a] >= f["total"][b], (a, b, f["total"])
+    # per-pair monotone + sums consistent with totals
+    for s in stages:
+        assert len(f["per_pair"][s]) == f["n_pairs"]
+        assert sum(f["per_pair"][s]) == f["total"][s]
+    for a, b in zip(stages, stages[1:]):
+        for va, vb in zip(f["per_pair"][a], f["per_pair"][b]):
+            assert va >= vb
+
+
+class TestFunnel:
+    def test_fast_path_funnel_conserved(self):
+        data, labels = _tiny()
+        res = recluster_de_consensus_fast(
+            data, labels, deep_split_values=(1,), mesh=None,
+        )
+        f = res.metrics["quality"]["de_funnel"]
+        assert set(f["total"]) == set(quality.FUNNEL_STAGES)
+        assert f["total"]["input"] == f["n_pairs"] * f["n_genes"]
+        _funnel_is_conserved(f)
+        # the pipeline's union stage consumed the same significant mask
+        assert f["total"]["significant"] == int(
+            res.de.de_mask.sum()
+        )
+
+    def test_slow_path_funnel_omits_gate_stages(self):
+        from scconsensus_tpu.de.engine import pairwise_de
+
+        data, labels = _tiny()
+        cfg = ReclusterConfig.slow_path_preset(
+            q_val_thrs=0.05, fc_thrs=1.5, method="wilcoxon",
+        )
+        res = pairwise_de(data, labels, cfg)
+        f = quality.de_funnel(res, cfg)
+        assert "pct_gate" not in f["total"]
+        assert "logfc_gate" not in f["total"]
+        _funnel_is_conserved(f)
+
+    def test_funnel_stays_on_device_sized_fetches(self):
+        """The funnel must not materialize the (P, G) device fields to
+        host — lazily-fetched result fields stay device arrays after."""
+        data, labels = _tiny()
+        cfg = ReclusterConfig()  # fast path
+        from scconsensus_tpu.de.engine import pairwise_de
+
+        res = pairwise_de(data, labels, cfg)
+        quality.de_funnel(res, cfg)
+        raw = object.__getattribute__(res, "log_p")
+        assert not isinstance(raw, np.ndarray), (
+            "de_funnel forced a (P, G) host materialization"
+        )
+
+
+# --------------------------------------------------------------------------
+# cluster structure
+# --------------------------------------------------------------------------
+
+class TestClusterStructure:
+    def test_sizes_entropy_ari_and_churn(self):
+        rng = np.random.default_rng(0)
+        inp = rng.integers(0, 3, 200)
+        cut1 = inp.copy() + 1                     # identical (labels > 0)
+        cut2 = np.where(cut1 == 3, 4, cut1)       # renamed cluster
+        cut2[:5] = 0                              # a few unassigned
+        cs = quality.cluster_structure(
+            {"deepsplit: 1": cut1, "deepsplit: 2": cut2},
+            deep_split_info=[{"deep_split": 1, "silhouette": 0.5}],
+            input_labels=inp,
+            ref_labelings={"sup": inp},
+        )
+        c1, c2 = cs["cuts"]
+        assert c1["n_clusters"] == 3 and sum(c1["sizes"]) == 200
+        assert c1["silhouette"] == 0.5
+        assert c2["n_unassigned"] == 5
+        assert cs["ari_vs_input"]["deepsplit: 1"] == 1.0
+        assert cs["input_entropy"] > 0
+        assert c1["contingency_entropy"] == pytest.approx(
+            cs["input_entropy"])  # identical labeling: joint == marginal
+        (ch,) = cs["churn"]
+        assert ch["from"] == "deepsplit: 1" and ch["ari"] > 0.9
+        assert cs["ari_final_vs"]["sup"] > 0.9
+
+    def test_pipeline_section_validates(self):
+        data, labels = _tiny()
+        res = recluster_de_consensus_fast(
+            data, labels, deep_split_values=(1, 2), mesh=None,
+        )
+        q = res.metrics["quality"]
+        quality.validate_quality(q)
+        cs = q["cluster_structure"]
+        assert len(cs["cuts"]) == 2
+        assert all("silhouette" in c for c in cs["cuts"])
+        assert len(cs["churn"]) == 1
+        # ladder occupancy promoted from the wilcox stage probe
+        lad = q["wilcox_ladder"]
+        assert lad["n_buckets"] >= 1
+        assert lad["genes_bucketed"] == lad["n_genes"]
+        assert lad["real_elems"] <= lad["padded_elems"]
+
+
+# --------------------------------------------------------------------------
+# schema validation of the quality section
+# --------------------------------------------------------------------------
+
+class TestValidation:
+    def _base(self):
+        return {
+            "de_funnel": {
+                "n_pairs": 2, "n_genes": 10,
+                "per_pair": {"input": [10, 10], "tested": [8, 7],
+                             "significant": [2, 1]},
+                "total": {"input": 20, "tested": 15, "significant": 3},
+            },
+            "numeric_health": {"enabled": True, "checks": 1, "trips": []},
+        }
+
+    def test_valid_section_passes(self):
+        rec = build_run_record("t", 1.0, quality=self._base())
+        validate_run_record(rec)
+
+    def test_non_monotone_total_rejected(self):
+        q = self._base()
+        q["de_funnel"]["total"]["significant"] = 99
+        with pytest.raises(ValueError, match="not monotone"):
+            quality.validate_quality(q)
+
+    def test_per_pair_sum_mismatch_rejected(self):
+        q = self._base()
+        q["de_funnel"]["per_pair"]["tested"] = [8, 8]
+        with pytest.raises(ValueError, match="sums to"):
+            quality.validate_quality(q)
+
+    def test_malformed_trip_rejected(self):
+        q = self._base()
+        q["numeric_health"]["trips"] = [{"array": "x", "nan": 1}]
+        with pytest.raises(ValueError, match="span"):
+            quality.validate_quality(q)
+
+    def test_unknown_funnel_stage_rejected(self):
+        q = self._base()
+        q["de_funnel"]["total"]["bogus"] = 1
+        with pytest.raises(ValueError, match="unknown funnel stage"):
+            quality.validate_quality(q)
+
+    def test_cluster_sizes_must_match_count(self):
+        q = {"cluster_structure": {"cuts": [
+            {"cut": "c", "n_clusters": 2, "sizes": [5]},
+        ]}}
+        with pytest.raises(ValueError, match="sizes"):
+            quality.validate_quality(q)
+
+
+# --------------------------------------------------------------------------
+# fingerprint on every ingested run + history-fallback drift gating
+# --------------------------------------------------------------------------
+
+def _fp_record(value, created, fp):
+    tr = Tracer(sync="off")
+    with tr.span("aggregates"):
+        pass
+    rec = build_run_record(
+        "m", value, tracer=tr,
+        extra={"platform": "cpu", "config": "anydataset",
+               "numeric_fingerprint": fp},
+    )
+    rec["run"]["created_unix"] = created
+    return rec
+
+
+class TestFingerprintEverywhere:
+    def test_ledger_stamps_fingerprint_on_entry(self, tmp_path):
+        led = Ledger(str(tmp_path))
+        entry = led.ingest(_fp_record(1.0, 100.0, {"label_ari": 0.9,
+                                                   "_meta": "x"}))
+        assert entry["numeric_fingerprint"] == {"label_ari": 0.9}
+
+    def test_history_pins_prefers_newest_clean(self, tmp_path):
+        led = Ledger(str(tmp_path))
+        led.ingest(_fp_record(1.0, 100.0, {"label_ari": 0.7}))
+        led.ingest(_fp_record(1.0, 200.0, {"label_ari": 0.9}))
+        partial = _fp_record(-1.0, 300.0, {"label_ari": 0.1})
+        partial["termination"] = {"cause": "stall", "last_span": None,
+                                  "open_spans": [], "stall_count": 1}
+        led.ingest(partial)
+        hist = led.history(run_key(_fp_record(0, 0, {})))
+        assert regress.history_pins(hist) == {"label_ari": 0.9}
+        assert regress.history_pins([]) is None
+
+    def test_perf_gate_flags_drift_vs_history_without_pins(self, tmp_path):
+        """No NUMERIC_PINS entry for this dataset → the gate compares
+        against the key's previous clean run and fails unacknowledged."""
+        sys.path.insert(0, str(REPO / "tools"))
+        import perf_gate
+
+        ev = tmp_path / "evidence"
+        led = Ledger(str(ev))
+        led.ingest(_fp_record(1.0, 100.0, {"label_ari": 0.9}))
+        cand = tmp_path / "cand.json"
+        cand.write_text(json.dumps(_fp_record(1.0, 200.0,
+                                              {"label_ari": 0.5})))
+        verdict, drifts = perf_gate.run_gate(str(cand), str(ev))
+        (d,) = drifts
+        assert d["field"] == "label_ari" and not d["acknowledged"]
+        assert d["pins_source"] == "history"
+        # acknowledging in the drift ledger clears it
+        regress.append_drift_ack(
+            str(ev / regress.DRIFT_LEDGER_NAME),
+            "label_ari", 0.9, 0.5, reason="deliberate recut change",
+        )
+        _, drifts2 = perf_gate.run_gate(str(cand), str(ev))
+        assert all(d["acknowledged"] for d in drifts2)
+
+    def test_matching_history_fingerprint_is_quiet(self, tmp_path):
+        sys.path.insert(0, str(REPO / "tools"))
+        import perf_gate
+
+        ev = tmp_path / "evidence"
+        Ledger(str(ev)).ingest(_fp_record(1.0, 100.0, {"label_ari": 0.9}))
+        cand = tmp_path / "cand.json"
+        cand.write_text(json.dumps(_fp_record(1.1, 200.0,
+                                              {"label_ari": 0.9})))
+        _, drifts = perf_gate.run_gate(str(cand), str(ev))
+        assert drifts == []
+
+
+# --------------------------------------------------------------------------
+# acceptance: cite8k-shaped record validates populated; explain_run
+# renders it and a two-run diff to Markdown
+# --------------------------------------------------------------------------
+
+class TestCite8kRecordAndExplain:
+    @pytest.fixture(scope="class")
+    def records(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("explain")
+        ev = tmp / "evidence"
+        led = Ledger(str(ev))
+        data, truth, _ = synthetic_scrna(
+            n_genes=120, n_cells=300, n_clusters=4,
+            n_markers_per_cluster=8, seed=3,
+        )
+        labels = noisy_labeling(truth, 0.05, seed=2)
+        files = []
+        for i in range(2):
+            res = recluster_de_consensus_fast(
+                data, labels, deep_split_values=(1, 2), mesh=None,
+            )
+            fp = regress.drift_fingerprint(log_p=res.de.log_p)
+            ari = (res.metrics["quality"]["cluster_structure"]
+                   .get("ari_vs_input") or {})
+            if ari:
+                fp["label_ari_vs_input"] = list(ari.values())[-1]
+            rec = build_run_record(
+                "cite8k-shaped end-to-end wall-clock", 3.1 + 0.1 * i,
+                spans=res.metrics["spans"],
+                quality=res.metrics["quality"],
+                extra={"config": "cite8k", "platform": "cpu",
+                       "numeric_fingerprint": fp},
+            )
+            rec = json.loads(json.dumps(rec, default=str))
+            rec["run"]["created_unix"] = 1000.0 + i
+            entry = led.ingest(rec)
+            files.append(ev / entry["file"])
+        return ev, files
+
+    def test_record_validates_with_quality_populated(self, records):
+        ev, files = records
+        rec = json.loads(files[-1].read_text())
+        validate_run_record(rec)
+        q = rec["quality"]
+        assert q["de_funnel"]["total"]["significant"] > 0
+        assert q["cluster_structure"]["cuts"]
+        assert q["wilcox_ladder"]["n_buckets"] >= 1
+        assert rec["extra"]["numeric_fingerprint"]["de_logp_q"]
+        # manifest entry carries the fingerprint (ledger-stamped)
+        led = Ledger(str(ev))
+        entry = next(e for e in led.entries()
+                     if e["file"] == files[-1].name)
+        assert "de_logp_q" in entry["numeric_fingerprint"]
+
+    def test_explain_run_renders_markdown_report(self, records):
+        ev, files = records
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "explain_run.py"),
+             files[-1].name, "--evidence", str(ev)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        out = proc.stdout
+        assert out.startswith("# Run report:")
+        for heading in ("## Stage walls", "## DE gate funnel",
+                        "## Rank-sum window-ladder occupancy",
+                        "## Cluster structure", "## Numeric health",
+                        "## Numeric fingerprint"):
+            assert heading in out, heading
+        assert "| significant |" in out or "| significant " in out
+        assert "previous clean run" in out  # history-fallback pins named
+        assert "baseline s" in out         # ledger baselines resolved
+
+    def test_explain_run_renders_two_run_diff(self, records):
+        ev, files = records
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "explain_run.py"),
+             files[1].name, "--baseline", files[0].name,
+             "--evidence", str(ev)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        out = proc.stdout
+        assert out.startswith("# Run diff:")
+        assert "## Stage walls" in out
+        assert "## DE gate funnel (totals)" in out
+        assert "## Fingerprint deltas" in out
+        # identical workloads: no fingerprint field flagged as shifted
+        assert "**yes**" not in out
+
+    def test_explain_run_rejects_legacy_record(self, records, tmp_path):
+        ev, _ = records
+        p = tmp_path / "legacy.json"
+        p.write_text(json.dumps({"metric": "m", "value": 1}))
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "explain_run.py"),
+             str(p), "--evidence", str(ev)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 2
+        assert "upgrade" in proc.stderr
+
+
+# --------------------------------------------------------------------------
+# live quality panel (tail_run satellite)
+# --------------------------------------------------------------------------
+
+class TestLiveQualityPanel:
+    def test_heartbeat_carries_trips_and_funnel(self, numeric_on,
+                                                tmp_path):
+        from scconsensus_tpu.obs.live import LiveRecorder
+
+        rec = LiveRecorder(str(tmp_path / "q"), metric="t",
+                           heartbeat_s=0.05, stall_s=0.0).start(
+                               install_signals=False)
+        tr = Tracer(sync="off")
+        with tr.span("stage_q") as sp:
+            x = np.array([np.nan, 1.0], np.float32)
+            quality.check_array("poison", x, span=sp)
+            quality.note_funnel({"input": 100, "significant": 3})
+            time.sleep(0.3)
+        rec.stop("clean")
+        lines = [json.loads(ln) for ln in
+                 pathlib.Path(rec.hb_path).read_text().strip()
+                 .splitlines()]
+        hbs = [ln for ln in lines if ln["t"] == "hb" and "quality" in ln]
+        assert hbs, "no heartbeat carried the quality panel"
+        q = hbs[-1]["quality"]
+        assert q["trips"] >= 1
+        assert q["last_trip"]["array"] == "poison"
+        assert q["funnel"]["significant"] == 3
+
+    def test_funnel_is_tracer_scoped(self):
+        """One run's funnel must not leak into the next run's heartbeats
+        (bench runs edger → wilcox in one process, each on its own
+        tracer)."""
+        tr1 = Tracer(sync="off")
+        with tr1.span("a"):
+            quality.note_funnel({"input": 1})
+        tr2 = Tracer(sync="off")
+        with tr2.span("b"):
+            pass
+        assert quality.live_summary(tr1)["funnel"] == {"input": 1}
+        s2 = quality.live_summary(tr2)
+        assert s2 is None or "funnel" not in s2
+
+    def test_tail_run_renders_quality_panel(self):
+        sys.path.insert(0, str(REPO / "tools"))
+        import tail_run
+
+        lines = tail_run.read_stream(str(
+            REPO / "tests" / "fixtures" / "heartbeat" /
+            "sample_heartbeat.jsonl"
+        ))
+        panel = tail_run.render(lines)
+        assert "SENTINEL TRIPS: 1" in panel
+        assert "wilcox_test/log_p" in panel
+        assert "significant=7300" in panel
+
+
+# --------------------------------------------------------------------------
+# overhead guard (acceptance: quality telemetry <2% of wall)
+# --------------------------------------------------------------------------
+
+class TestQualityOverhead:
+    def test_quality_overhead_under_two_percent(self, numeric_on):
+        """Sentinel checks + funnel + cluster structure, self-measured
+        (quality.consumed_cpu_s) on a warm pipeline run, must stay under
+        2% of the run's wall — the quality layer must never become the
+        thing the stage walls measure."""
+        # bench-representative-ish shape: the wall must be large enough
+        # that the 2% bar measures the quality layer, not dispatch noise
+        # (quality cost is ~a dozen small device fetches, shape-
+        # independent to first order)
+        data, truth, _ = synthetic_scrna(
+            n_genes=600, n_cells=1500, n_clusters=5,
+            n_markers_per_cluster=10, seed=9,
+        )
+        labels = noisy_labeling(truth, 0.05, seed=2)
+
+        def run():
+            return recluster_de_consensus_fast(
+                data, labels, deep_split_values=(1, 2), mesh=None,
+            )
+
+        run()  # warm: XLA compiles (incl. the sentinels' reductions)
+        # best-of-3: the bar measures the layer's intrinsic cost, not a
+        # scheduler hiccup landing inside one ~10 ms quality window on a
+        # loaded single-core suite host
+        fracs = []
+        for _ in range(3):
+            quality.reset_cpu()
+            t0 = time.perf_counter()
+            res = run()
+            wall = time.perf_counter() - t0
+            spent = quality.consumed_cpu_s()
+            assert res.metrics["quality"]["numeric_health"]["checks"] > 0
+            fracs.append((spent / wall, spent, wall))
+        frac, spent, wall = min(fracs)
+        assert frac < 0.02, (
+            f"quality telemetry burned {frac:.2%} of wall on the best "
+            f"of 3 runs ({spent:.4f}s over {wall:.2f}s; all: "
+            f"{[round(f, 4) for f, _, _ in fracs]})"
+        )

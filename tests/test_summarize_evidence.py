@@ -1,6 +1,9 @@
 """tools/summarize_evidence.py ingest contract: legacy artifacts render,
 schema-v1 records render with span counts, unknown schema versions are a
-hard error (ISSUE 2 CI satellite)."""
+hard error (ISSUE 2 CI satellite). The root-level transition scan was
+removed in round 10 (all 32 legacy artifacts relocated in r8): only
+``evidence/`` renders; a stray root artifact gets a one-line stderr
+notice, never a table row."""
 
 import json
 import pathlib
@@ -28,6 +31,12 @@ def test_repo_root_artifacts_all_ingest():
     assert proc.stdout.strip(), "expected at least one evidence row"
 
 
+def _evdir(tmp_path):
+    ev = tmp_path / "evidence"
+    ev.mkdir(exist_ok=True)
+    return ev
+
+
 def test_schema_v1_record_renders_with_span_count(tmp_path):
     rec = build_run_record(
         "t", 1.0,
@@ -38,24 +47,44 @@ def test_schema_v1_record_renders_with_span_count(tmp_path):
         }],
         extra={"platform": "cpu"},
     )
-    (tmp_path / "SCALE_r99_test.json").write_text(json.dumps(rec))
+    (_evdir(tmp_path) / "SCALE_r99_test.json").write_text(json.dumps(rec))
     proc = _run(tmp_path)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert f"schema={SCHEMA_VERSION}" in proc.stdout
     assert "spans=1" in proc.stdout
 
 
+def test_quality_fields_render(tmp_path):
+    rec = build_run_record(
+        "t", 1.0, extra={"platform": "cpu"},
+        quality={
+            "de_funnel": {"total": {"input": 100, "significant": 7}},
+            "numeric_health": {
+                "enabled": True, "checks": 3,
+                "trips": [{"span": "wilcox_test", "array": "log_p",
+                           "nan": 5, "inf": 0}],
+            },
+        },
+    )
+    (_evdir(tmp_path) / "RUN_q.json").write_text(json.dumps(rec))
+    proc = _run(tmp_path)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "de_sig=7" in proc.stdout
+    assert "SENTINEL_TRIPS=1" in proc.stdout
+
+
 def test_unknown_schema_version_is_hard_error(tmp_path):
     rec = build_run_record("t", 1.0)
     rec["schema_version"] = SCHEMA_VERSION + 7
-    (tmp_path / "SCALE_r99_future.json").write_text(json.dumps(rec))
+    (_evdir(tmp_path) / "SCALE_r99_future.json").write_text(
+        json.dumps(rec))
     proc = _run(tmp_path)
     assert proc.returncode != 0
     assert "unsupported" in (proc.stderr + proc.stdout)
 
 
 def test_unknown_schema_name_is_hard_error(tmp_path):
-    (tmp_path / "BENCH_CHECKPOINT_x.json").write_text(
+    (_evdir(tmp_path) / "BENCH_CHECKPOINT_x.json").write_text(
         json.dumps({"schema": "not-ours", "value": 1})
     )
     proc = _run(tmp_path)
@@ -64,7 +93,7 @@ def test_unknown_schema_name_is_hard_error(tmp_path):
 
 
 # --------------------------------------------------------------------------
-# evidence/-vs-root transition (ISSUE 3 satellite)
+# root-scan removal (round 10): evidence/ is the only rendered location
 # --------------------------------------------------------------------------
 
 def _mkrec():
@@ -79,34 +108,52 @@ def _mkrec():
     )
 
 
-def test_root_level_ingest_warns_deprecation(tmp_path):
-    (tmp_path / "SCALE_r99_root.json").write_text(json.dumps(_mkrec()))
-    proc = _run(tmp_path)
-    assert proc.returncode == 0
-    assert "SCALE_r99_root.json" in proc.stdout
-    assert "DeprecationWarning" in proc.stderr
-    assert "perf_gate.py --upgrade" in proc.stderr
+def test_committed_repo_root_has_no_stray_evidence():
+    """The removal's precondition, pinned: every relocatable artifact
+    lives under evidence/ (relocated in r8). A new root-level BENCH_*/
+    SCALE_*/... JSON would be invisible to the table — fail here so it
+    gets relocated instead of silently unrendered."""
+    import sys
+
+    sys.path.insert(0, str(REPO / "tools"))
+    import summarize_evidence as se
+
+    assert se._stray_root_files(str(REPO)) == []
 
 
-def test_evidence_dir_ingest_does_not_warn(tmp_path):
-    ev = tmp_path / "evidence"
-    ev.mkdir()
-    (ev / "SCALE_r99_moved.json").write_text(json.dumps(_mkrec()))
-    proc = _run(tmp_path)
-    assert proc.returncode == 0
-    assert "evidence/SCALE_r99_moved.json" in proc.stdout
-    assert "DeprecationWarning" not in proc.stderr
-
-
-def test_both_locations_render_in_one_table(tmp_path):
+def test_stray_root_file_notices_but_does_not_render(tmp_path):
     (tmp_path / "SCALE_r98_root.json").write_text(json.dumps(_mkrec()))
     ev = tmp_path / "evidence"
     ev.mkdir()
     (ev / "SCALE_r99_moved.json").write_text(json.dumps(_mkrec()))
     proc = _run(tmp_path)
     assert proc.returncode == 0
-    assert "SCALE_r98_root.json" in proc.stdout
+    # stray root file: one stderr notice pointing at the upgrader, no row
+    assert "SCALE_r98_root.json" not in proc.stdout
+    assert "SCALE_r98_root.json" in proc.stderr
+    assert "perf_gate.py --upgrade" in proc.stderr
     assert "evidence/SCALE_r99_moved.json" in proc.stdout
+
+
+def test_live_root_transients_still_render(tmp_path):
+    """BENCH_TPU_* watcher capture targets legitimately live at the
+    root (the upgrader can never relocate them) — they must keep
+    rendering, with no stray-file notice."""
+    (tmp_path / "BENCH_TPU_flagship.json").write_text(json.dumps(_mkrec()))
+    proc = _run(tmp_path)
+    assert proc.returncode == 0
+    assert "BENCH_TPU_flagship.json" in proc.stdout
+    assert "NOTE:" not in proc.stderr
+
+
+def test_evidence_dir_ingest_does_not_notice(tmp_path):
+    ev = tmp_path / "evidence"
+    ev.mkdir()
+    (ev / "SCALE_r99_moved.json").write_text(json.dumps(_mkrec()))
+    proc = _run(tmp_path)
+    assert proc.returncode == 0
+    assert "evidence/SCALE_r99_moved.json" in proc.stdout
+    assert "NOTE:" not in proc.stderr
 
 
 def test_relocated_legacy_renders_through_original_shape(tmp_path):

@@ -1,0 +1,419 @@
+#!/usr/bin/env python
+"""Render one evidence run record — or a two-run diff — as a Markdown
+report.
+
+The ledger answers "where did the time go"; the quality section answers
+"what did the pipeline compute". This tool folds both into the artifact a
+reviewer reads instead of raw JSON: stage walls against the key's
+noise-banded baselines, the DE gate funnel (aggregate + worst pairs),
+rank-sum ladder occupancy, cluster structure (sizes, silhouette, ARI,
+churn), numeric-health sentinel trips, and the numeric fingerprint with
+its drift status (against NUMERIC_PINS.json when the dataset is pinned,
+else against the key's previous clean run).
+
+Usage:
+  python tools/explain_run.py RECORD.json                # one report
+  python tools/explain_run.py RECORD.json --baseline OLD.json   # diff
+  ... [--evidence DIR] [--out report.md]
+
+RECORD may be a path or a bare evidence entry name (resolved against the
+evidence dir). Output goes to stdout unless --out is given.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from scconsensus_tpu.obs import regress  # noqa: E402
+from scconsensus_tpu.obs.export import (  # noqa: E402
+    check_schema_version,
+    validate_run_record,
+)
+from scconsensus_tpu.obs.ledger import (  # noqa: E402
+    Ledger,
+    default_evidence_dir,
+    run_key,
+    stage_walls,
+    termination_cause,
+)
+
+_TOP_PAIRS = 8  # funnel table: worst pairs shown individually
+
+
+def _fmt(v, digits: int = 3) -> str:
+    if v is None:
+        return "–"
+    if isinstance(v, float):
+        return f"{v:.{digits}f}"
+    return str(v)
+
+
+def _load_record(spec: str, evidence_dir: str) -> Dict[str, Any]:
+    path = spec
+    if not os.path.exists(path):
+        cand = os.path.join(evidence_dir, spec)
+        if os.path.exists(cand):
+            path = cand
+        else:
+            raise FileNotFoundError(f"no such record: {spec}")
+    with open(path) as f:
+        rec = json.load(f)
+    if check_schema_version(rec, source=spec) == "legacy":
+        raise ValueError(
+            f"{spec}: pre-schema record — upgrade it first "
+            "(tools/perf_gate.py --upgrade)"
+        )
+    validate_run_record(rec)
+    rec["_source_file"] = os.path.basename(path)
+    return rec
+
+
+# --------------------------------------------------------------------------
+# sections
+# --------------------------------------------------------------------------
+
+def _header(rec: Dict[str, Any]) -> List[str]:
+    key = run_key(rec)
+    out = [f"# Run report: {rec.get('metric')}", ""]
+    out.append(f"- **headline**: {_fmt(rec.get('value'))} "
+               f"{rec.get('unit')}"
+               + (f" (vs_baseline {_fmt(rec.get('vs_baseline'))})"
+                  if rec.get("vs_baseline") is not None else ""))
+    out.append(f"- **key**: dataset=`{key['dataset']}` "
+               f"backend=`{key['backend']}` config_fp=`{key['config_fp']}`")
+    run = rec.get("run") or {}
+    out.append(f"- **created_unix**: {run.get('created_unix')}"
+               + (f", jax {run['jax_version']}"
+                  if run.get("jax_version") else ""))
+    cause = termination_cause(rec)
+    if cause is not None and cause != "clean":
+        term = rec["termination"]
+        out.append(f"- **PARTIAL record**: termination.cause=`{cause}`"
+                   + (f" at span `{term.get('last_span')}`"
+                      if term.get("last_span") else ""))
+    return out
+
+
+def stage_table(rec: Dict[str, Any],
+                baselines: Dict[str, Dict[str, float]]) -> List[str]:
+    walls = stage_walls(rec)
+    if not walls:
+        return []
+    out = ["## Stage walls", ""]
+    if baselines:
+        out += ["| stage | wall s | baseline s | band s | status |",
+                "|---|---:|---:|---:|---|"]
+    else:
+        out += ["| stage | wall s |", "|---|---:|"]
+    for stage, wall in sorted(walls.items(), key=lambda kv: -kv[1]):
+        if baselines:
+            b = baselines.get(stage)
+            if b is None:
+                status = "no baseline (new stage)"
+                out.append(f"| {stage} | {wall:.3f} | – | – | {status} |")
+                continue
+            limit = b["baseline_s"] + b["band_s"]
+            status = ("**REGRESSED** "
+                      f"(+{wall - limit:.3f}s past band)"
+                      if wall > limit else "ok")
+            out.append(f"| {stage} | {wall:.3f} | {b['baseline_s']:.3f} "
+                       f"| {b['band_s']:.3f} | {status} |")
+        else:
+            out.append(f"| {stage} | {wall:.3f} |")
+    return out
+
+
+def funnel_table(quality: Dict[str, Any]) -> List[str]:
+    f = (quality or {}).get("de_funnel")
+    if not f:
+        return []
+    total = f.get("total") or {}
+    stages = [s for s in ("input", "pct_gate", "logfc_gate", "tested",
+                          "significant") if s in total]
+    out = ["## DE gate funnel", "",
+           f"{f.get('n_pairs')} pairs × {f.get('n_genes')} genes", "",
+           "| stage | genes (all pairs) | % of input |", "|---|---:|---:|"]
+    inp = float(total.get("input") or 1) or 1.0
+    for s in stages:
+        out.append(f"| {s} | {total[s]} | {100.0 * total[s] / inp:.1f}% |")
+    pp = f.get("per_pair") or {}
+    names = f.get("cluster_names") or []
+    pi, pj = f.get("pair_i") or [], f.get("pair_j") or []
+    sig = pp.get("significant")
+    if sig and pi and pj:
+        def pair_name(r):
+            try:
+                return f"{names[pi[r]]} vs {names[pj[r]]}"
+            except (IndexError, TypeError):
+                return f"pair {r}"
+
+        order = sorted(range(len(sig)), key=lambda r: sig[r])
+        worst = order[:_TOP_PAIRS]
+        out += ["", f"Fewest-significant pairs (bottom {len(worst)}):", "",
+                "| pair | " + " | ".join(stages) + " |",
+                "|---|" + "---:|" * len(stages)]
+        for r in worst:
+            out.append(f"| {pair_name(r)} | " + " | ".join(
+                str(pp[s][r]) if s in pp else "–" for s in stages
+            ) + " |")
+    return out
+
+
+def ladder_table(quality: Dict[str, Any]) -> List[str]:
+    lad = (quality or {}).get("wilcox_ladder")
+    if not lad:
+        return []
+    out = ["## Rank-sum window-ladder occupancy", "",
+           f"input=`{lad.get('input')}` kernel=`{lad.get('kernel')}` "
+           f"windowed={lad.get('windowed')} "
+           f"window_floor={lad.get('window_floor')}",
+           "",
+           f"- buckets: {lad.get('n_buckets')} covering "
+           f"{lad.get('genes_bucketed')} genes",
+           f"- padded vs real elements: {lad.get('padded_elems')} / "
+           f"{lad.get('real_elems')}"
+           + (f" (pad ratio {lad.get('pad_ratio')})"
+              if lad.get("pad_ratio") is not None else ""),
+           f"- overflow redos: {lad.get('overflow_genes')}"]
+    buckets = lad.get("buckets") or []
+    if buckets:
+        out += ["", "| window | genes | pad ratio | nnz range | overflow |",
+                "|---:|---:|---:|---|---:|"]
+        for b in buckets:
+            out.append(
+                f"| {b.get('window')} | {b.get('n_genes')} "
+                f"| {_fmt(b.get('pad_ratio'))} "
+                f"| {b.get('nnz_min')}–{b.get('nnz_max')} "
+                f"| {b.get('overflow_genes', 0)} |"
+            )
+    return out
+
+
+def cluster_table(quality: Dict[str, Any]) -> List[str]:
+    cs = (quality or {}).get("cluster_structure")
+    if not cs:
+        return []
+    out = ["## Cluster structure", "",
+           "| cut | clusters | largest | smallest | unassigned "
+           "| silhouette | entropy | ARI vs input |",
+           "|---|---:|---:|---:|---:|---:|---:|---:|"]
+    ari = cs.get("ari_vs_input") or {}
+    for cut in cs.get("cuts") or []:
+        sizes = cut.get("sizes") or []
+        out.append(
+            f"| {cut.get('cut')} | {cut.get('n_clusters')} "
+            f"| {sizes[0] if sizes else '–'} "
+            f"| {sizes[-1] if sizes else '–'} "
+            f"| {cut.get('n_unassigned', 0)} "
+            f"| {_fmt(cut.get('silhouette'))} "
+            f"| {_fmt(cut.get('contingency_entropy'))} "
+            f"| {_fmt(ari.get(cut.get('cut')))} |"
+        )
+    churn = cs.get("churn") or []
+    if churn:
+        out += ["", "Label churn across the deepSplit ladder:"]
+        for c in churn:
+            out.append(f"- `{c.get('from')}` → `{c.get('to')}`: "
+                       f"ARI {_fmt(c.get('ari'))}")
+    refs = cs.get("ari_final_vs") or {}
+    if refs:
+        out += ["", "Final cut vs input labelings: "
+                + ", ".join(f"{k}={_fmt(v)}" for k, v in refs.items())]
+    if cs.get("input_entropy") is not None:
+        out += ["", f"Input labeling: {cs.get('n_input_clusters')} "
+                f"clusters, entropy {_fmt(cs['input_entropy'])}"]
+    return out
+
+
+def health_section(quality: Dict[str, Any]) -> List[str]:
+    nh = (quality or {}).get("numeric_health")
+    if not nh:
+        return []
+    out = ["## Numeric health", ""]
+    trips = nh.get("trips") or []
+    if not trips:
+        state = "enabled" if nh.get("enabled") else "DISABLED"
+        out.append(f"No sentinel trips ({nh.get('checks', 0)} checks, "
+                   f"sentinels {state}).")
+        return out
+    out += [f"**{len(trips)} sentinel trip(s)** over "
+            f"{nh.get('checks', 0)} checks:", "",
+            "| span | array | NaN | Inf |", "|---|---|---:|---:|"]
+    for t in trips:
+        out.append(f"| {t.get('span')} | {t.get('array')} "
+                   f"| {t.get('nan', 0)} | {t.get('inf', 0)} |")
+    return out
+
+
+def fingerprint_section(rec: Dict[str, Any], evidence_dir: str,
+                        history: List[Dict[str, Any]]) -> List[str]:
+    fp = (rec.get("extra") or {}).get("numeric_fingerprint")
+    if not fp:
+        return []
+    out = ["## Numeric fingerprint", ""]
+    # shared resolution with perf_gate (regress.resolve_pins): the gate
+    # and this report must name the same comparison target
+    pins, source = regress.resolve_pins(
+        evidence_dir, run_key(rec)["dataset"], history
+    )
+    if source == "history":
+        source = "previous clean run of this key (history)"
+    if pins is None:
+        out.append("No pins and no prior fingerprint for this key — this "
+                   "run seeds the quality baseline.")
+        for k, v in sorted(fp.items()):
+            if not k.startswith("_"):
+                out.append(f"- `{k}`: {_fmt(v, 6)}")
+        return out
+    acks = regress.load_drift_acks(
+        os.path.join(evidence_dir, regress.DRIFT_LEDGER_NAME)
+    )
+    drifts = regress.check_drift(fp, pins, acks)
+    by_field = {d["field"]: d for d in drifts}
+    out += [f"Compared against: {source}", "",
+            "| field | current | pinned | status |", "|---|---|---|---|"]
+    for k in sorted(set(fp) | set(pins)):
+        if k.startswith("_"):
+            continue
+        d = by_field.get(k)
+        if d is None:
+            status = "match"
+        elif d["acknowledged"]:
+            status = "drift (acknowledged)"
+        else:
+            status = "**DRIFT (unacknowledged)**"
+        out.append(f"| {k} | {_fmt(fp.get(k), 6)} "
+                   f"| {_fmt(pins.get(k), 6)} | {status} |")
+    return out
+
+
+# --------------------------------------------------------------------------
+# two-run diff
+# --------------------------------------------------------------------------
+
+def diff_report(cand: Dict[str, Any], base: Dict[str, Any]) -> str:
+    out = [f"# Run diff: {cand.get('metric')}", "",
+           f"- candidate: `{cand.get('_source_file', '?')}` "
+           f"value={_fmt(cand.get('value'))} {cand.get('unit')}",
+           f"- baseline:  `{base.get('_source_file', '?')}` "
+           f"value={_fmt(base.get('value'))} {base.get('unit')}", ""]
+    cw, bw = stage_walls(cand), stage_walls(base)
+    if cw or bw:
+        out += ["## Stage walls", "",
+                "| stage | candidate s | baseline s | delta s |",
+                "|---|---:|---:|---:|"]
+        deltas = {
+            s: cw.get(s, 0.0) - bw.get(s, 0.0) for s in set(cw) | set(bw)
+        }
+        for s in sorted(deltas, key=lambda k: -abs(deltas[k])):
+            out.append(f"| {s} | {_fmt(cw.get(s))} | {_fmt(bw.get(s))} "
+                       f"| {deltas[s]:+.3f} |")
+    cf = ((cand.get("quality") or {}).get("de_funnel") or {}).get("total")
+    bf = ((base.get("quality") or {}).get("de_funnel") or {}).get("total")
+    if cf or bf:
+        cf, bf = cf or {}, bf or {}
+        out += ["", "## DE gate funnel (totals)", "",
+                "| stage | candidate | baseline | delta |",
+                "|---|---:|---:|---:|"]
+        for s in ("input", "pct_gate", "logfc_gate", "tested",
+                  "significant"):
+            if s in cf or s in bf:
+                # +g, not +d: validate_quality admits float counts
+                d = (cf.get(s) or 0) - (bf.get(s) or 0)
+                out.append(f"| {s} | {_fmt(cf.get(s))} "
+                           f"| {_fmt(bf.get(s))} | {d:+g} |")
+    cfp = (cand.get("extra") or {}).get("numeric_fingerprint") or {}
+    bfp = (base.get("extra") or {}).get("numeric_fingerprint") or {}
+    fields = sorted((set(cfp) | set(bfp)))
+    fields = [f for f in fields if not f.startswith("_")]
+    if fields:
+        out += ["", "## Fingerprint deltas", "",
+                "| field | candidate | baseline | shifted |",
+                "|---|---|---|---|"]
+        drifts = {d["field"]: d for d in regress.check_drift(cfp, bfp)}
+        for f in fields:
+            out.append(f"| {f} | {_fmt(cfp.get(f), 6)} "
+                       f"| {_fmt(bfp.get(f), 6)} "
+                       f"| {'**yes**' if f in drifts else 'no'} |")
+    for label, rec in (("candidate", cand), ("baseline", base)):
+        trips = ((rec.get("quality") or {}).get("numeric_health") or {}
+                 ).get("trips") or []
+        if trips:
+            out += ["", f"## Sentinel trips ({label})"]
+            for t in trips:
+                out.append(f"- {t.get('span')}/{t.get('array')}: "
+                           f"nan={t.get('nan', 0)} inf={t.get('inf', 0)}")
+    return "\n".join(out) + "\n"
+
+
+def report(rec: Dict[str, Any], evidence_dir: str) -> str:
+    history: List[Dict[str, Any]] = []
+    baselines: Dict[str, Dict[str, float]] = {}
+    try:
+        ledger = Ledger(evidence_dir)
+        history = ledger.history(
+            run_key(rec),
+            exclude_files=[rec.get("_source_file", "")],
+        )
+        baselines = regress.stage_baselines(history)
+    except Exception:
+        pass
+    parts = [_header(rec)]
+    quality = rec.get("quality") or {}
+    parts.append(stage_table(rec, baselines))
+    parts.append(funnel_table(quality))
+    parts.append(ladder_table(quality))
+    parts.append(cluster_table(quality))
+    parts.append(health_section(quality))
+    parts.append(fingerprint_section(rec, evidence_dir, history))
+    if not quality:
+        parts.append(["_This record carries no quality section (emitted "
+                      "before the quality-telemetry layer, or by a "
+                      "quality-free emitter)._"])
+    return "\n\n".join(
+        "\n".join(p) for p in parts if p
+    ) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render an evidence run record as a Markdown report")
+    ap.add_argument("record", help="run-record JSON path or evidence "
+                                   "entry name")
+    ap.add_argument("--baseline", default=None,
+                    help="second record: render a two-run diff instead")
+    ap.add_argument("--evidence", default=None,
+                    help="ledger dir (default: SCC_EVIDENCE_DIR or "
+                         "<repo>/evidence)")
+    ap.add_argument("--out", default=None, help="write the report here "
+                                                "instead of stdout")
+    args = ap.parse_args(argv)
+    evidence = args.evidence or default_evidence_dir(_REPO)
+    try:
+        rec = _load_record(args.record, evidence)
+        if args.baseline:
+            base = _load_record(args.baseline, evidence)
+            text = diff_report(rec, base)
+        else:
+            text = report(rec, evidence)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"explain_run: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}")
+    else:
+        print(text, end="")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Generate the SCC_* env-flag reference table in README.md from the
+registry (config.ENV_FLAGS).
+
+Three flags landed in round 9 without README updates — docs drifting from
+the registry is exactly the failure the registry exists to prevent, so
+the README table is now GENERATED: this tool rewrites the block between
+the markers below from ``config.ENV_FLAGS``, and a tier-1 lint test runs
+``--check`` so a new flag cannot ship without its doc row.
+
+Usage:
+  python tools/gen_env_docs.py            # rewrite README.md in place
+  python tools/gen_env_docs.py --check    # exit 1 if README is stale
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from scconsensus_tpu.config import ENV_FLAGS  # noqa: E402
+
+README = os.path.join(_REPO, "README.md")
+BEGIN_MARK = ("<!-- BEGIN scc-env-flags "
+              "(generated: python tools/gen_env_docs.py; do not edit) -->")
+END_MARK = "<!-- END scc-env-flags -->"
+
+
+def _md(text: str) -> str:
+    """Escape a doc string for a Markdown table cell."""
+    return str(text).replace("|", "\\|").replace("\n", " ")
+
+
+def render_table() -> str:
+    """The generated block, markers included."""
+    lines: List[str] = [
+        BEGIN_MARK,
+        "| flag | type | default | effect |",
+        "|---|---|---|---|",
+    ]
+    for name, spec in ENV_FLAGS.items():  # registry order is the doc order
+        default = "unset" if spec.default is None else repr(spec.default)
+        lines.append(
+            f"| `{name}` | {spec.type.__name__} | `{default}` "
+            f"| {_md(spec.doc)} |"
+        )
+    lines.append(END_MARK)
+    return "\n".join(lines)
+
+
+def update_readme(path: str = README, check: bool = False) -> bool:
+    """Rewrite (or with ``check``, verify) the generated block. Returns
+    True when the file already matched. Raises SystemExit if the markers
+    are missing — the block must exist for the generator to own it."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        head, rest = text.split(BEGIN_MARK, 1)
+        _, tail = rest.split(END_MARK, 1)
+    except ValueError:
+        raise SystemExit(
+            f"{path}: generated-block markers missing "
+            f"({BEGIN_MARK!r} … {END_MARK!r})"
+        )
+    new = head + render_table() + tail
+    if new == text:
+        return True
+    if not check:
+        with open(path, "w") as f:
+            f.write(new)
+    return False
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="README SCC_* flag-table generator")
+    ap.add_argument("--check", action="store_true",
+                    help="verify only; exit 1 when README is stale")
+    ap.add_argument("--readme", default=README, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    fresh = update_readme(args.readme, check=args.check)
+    if args.check:
+        if not fresh:
+            print(f"{args.readme}: SCC_* flag table is STALE — run "
+                  "`python tools/gen_env_docs.py`", file=sys.stderr)
+            return 1
+        print("README flag table matches config.ENV_FLAGS")
+        return 0
+    print(f"{args.readme}: flag table "
+          + ("already current" if fresh else "rewritten"))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -24,10 +24,17 @@ Modes:
       records indexed by MANIFEST.json (lossless; see obs.ledger).
 
 Drift workflow: a run record may carry ``extra["numeric_fingerprint"]``
-(obs.regress.drift_fingerprint). When the evidence dir holds
-``NUMERIC_PINS.json``, the gate compares and fails on any shift that has
-no matching acknowledgement in ``DRIFT_LEDGER.jsonl`` — acknowledge with
+(obs.regress.drift_fingerprint). When ``NUMERIC_PINS.json`` pins the
+candidate's dataset, the gate compares against those pins; otherwise it
+falls back to the key's PREVIOUS clean run (every ingested run is
+fingerprint-stamped on the manifest entry — obs.ledger), so quality
+drift gates on any dataset. Either way a shift fails unless it has a
+matching acknowledgement in ``DRIFT_LEDGER.jsonl`` — acknowledge with
 ``obs.regress.append_drift_ack`` (and update the pin), never with prose.
+
+Candidates are additionally validated against the full run-record schema
+(quality section included): a record with a non-monotone DE funnel or
+malformed sentinel trips is a usage error, not a gate verdict.
 """
 
 from __future__ import annotations
@@ -50,7 +57,7 @@ from scconsensus_tpu.obs.ledger import (  # noqa: E402
     upgrade_tree,
 )
 
-PINS_NAME = "NUMERIC_PINS.json"
+PINS_NAME = regress.PINS_NAME  # one canonical filename (obs.regress)
 FIXTURES = os.path.join(_REPO, "tests", "fixtures", "perf_gate")
 
 
@@ -83,12 +90,18 @@ def _baseline_context(ledger: Ledger, history: List[Dict[str, Any]]
 def run_gate(candidate_path: str, evidence_dir: str
              ) -> Tuple[regress.GateVerdict, List[Dict[str, Any]]]:
     """(perf verdict, drift records) for one candidate file."""
+    from scconsensus_tpu.obs.export import validate_run_record
+
     candidate = _load_json(candidate_path)
     if check_schema_version(candidate, source=candidate_path) == "legacy":
         raise ValueError(
             f"{candidate_path}: pre-schema record — upgrade it first "
             "(perf_gate.py --upgrade)"
         )
+    # full structural validation, quality section included: a candidate
+    # with a non-monotone funnel or malformed sentinel trips must be
+    # rejected here, not rendered as if the quality fields meant something
+    validate_run_record(candidate)
     ledger = Ledger(evidence_dir)
     history = ledger.history(
         run_key(candidate),
@@ -100,20 +113,25 @@ def run_gate(candidate_path: str, evidence_dir: str
                                   baseline_cost=base_cost)
     drifts: List[Dict[str, Any]] = []
     fp = (candidate.get("extra") or {}).get("numeric_fingerprint")
-    pins_path = os.path.join(evidence_dir, PINS_NAME)
-    if fp and os.path.exists(pins_path):
+    if fp:
         # pins are keyed by dataset: the reference-workload pins must never
         # be compared against a cite8k/tm100k fingerprint (every real run
-        # would read as bogus drift). No pin entry for this dataset = no
-        # drift check, not a failure.
-        pins = regress.pins_for_dataset(
-            _load_json(pins_path), run_key(candidate)["dataset"]
+        # would read as bogus drift). A dataset with no pin entry falls
+        # back to its key's previous clean run (every ingested run is
+        # fingerprint-stamped on the manifest — obs.ledger), so quality
+        # drift gates on ANY dataset; with no history either, the
+        # candidate seeds. Resolution shared with explain_run
+        # (regress.resolve_pins), so gate and report cannot disagree.
+        pins, source = regress.resolve_pins(
+            evidence_dir, run_key(candidate)["dataset"], history
         )
         if pins:
             acks = regress.load_drift_acks(
                 os.path.join(evidence_dir, regress.DRIFT_LEDGER_NAME)
             )
             drifts = regress.check_drift(fp, pins, acks)
+            for d in drifts:
+                d["pins_source"] = source
     return verdict, drifts
 
 
@@ -153,8 +171,10 @@ def _report(verdict: regress.GateVerdict, drifts: List[Dict[str, Any]],
             print(line)
         for d in drifts:
             state = "acknowledged" if d["acknowledged"] else "UNACKNOWLEDGED"
+            src = d.get("pins_source")
             print(f"  drift {d['field']}: pinned={d['pinned']} "
-                  f"current={d['current']}  {state}")
+                  f"current={d['current']}  {state}"
+                  + (f"  [vs {src}]" if src else ""))
         print("PASS" if ok else "FAIL")
     return 0 if ok else 1
 
@@ -170,6 +190,16 @@ def _smoke(fixtures: str, as_json: bool) -> int:
     checks.append(("clean candidate passes",
                    verdict.ok and not [d for d in drifts
                                        if not d["acknowledged"]]))
+    # quality schema: the clean candidate carries funnel + cluster
+    # structure and passed run_gate's full validation above
+    clean = _load_json(os.path.join(fixtures, "candidate_clean.json"))
+    q = clean.get("quality") or {}
+    checks.append((
+        "clean candidate carries schema-valid quality fields "
+        "(funnel + cluster structure)",
+        bool((q.get("de_funnel") or {}).get("total"))
+        and bool((q.get("cluster_structure") or {}).get("cuts")),
+    ))
 
     verdict_r, drifts_r = run_gate(
         os.path.join(fixtures, "candidate_regressed.json"), evidence
@@ -184,6 +214,16 @@ def _smoke(fixtures: str, as_json: bool) -> int:
         "regressed fingerprint drift flagged unacknowledged",
         any(not d["acknowledged"] for d in drifts_r),
     ))
+
+    # a malformed quality section (non-monotone funnel) must be REJECTED
+    # by validation, never gated as if the counts meant something
+    try:
+        run_gate(os.path.join(fixtures, "candidate_bad_quality.json"),
+                 evidence)
+        bad_rejected = False
+    except ValueError as e:
+        bad_rejected = "funnel" in str(e)
+    checks.append(("non-monotone quality funnel rejected", bad_rejected))
 
     for label, ok in checks:
         print(f"[smoke] {'ok  ' if ok else 'FAIL'} {label}")

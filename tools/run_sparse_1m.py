@@ -83,6 +83,11 @@ def noisy(labels: np.ndarray, flip: float, k: int, seed: int, prefix: str):
 def main() -> None:
     from scconsensus_tpu.config import env_flag
 
+    # numeric-health sentinels default ON for this driver (like bench
+    # workers): a NaN born 40 minutes into a 1M run must land span-
+    # attributed on the artifact, not in the labels
+    os.environ.setdefault("SCC_OBS_NUMERIC", "1")
+
     import jax
 
     # The env var alone is NOT enough here: the site's axon sitecustomize
@@ -190,6 +195,7 @@ def main() -> None:
         unit="seconds",
         vs_baseline=None,  # no reference number exists (BASELINE.md)
         spans=res.metrics.get("spans", []),
+        quality=res.metrics.get("quality"),
         extra={
             "platform": jax.devices()[0].platform,
             "n_cells": n_cells, "n_genes": n_genes,

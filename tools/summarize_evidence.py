@@ -4,9 +4,14 @@
 Artifacts live under ``evidence/`` (the ledger layout: schema-v1 records
 indexed by MANIFEST.json; legacy files relocated there by
 ``tools/perf_gate.py --upgrade`` carry their original payload under
-``extra["legacy"]`` and render through their original shape). Root-level
-artifacts are still accepted during the transition — each root ingest
-emits a deprecation warning on stderr pointing at the upgrader.
+``extra["legacy"]`` and render through their original shape). The
+root-level transition scan was removed in round 10: all 32 legacy root
+artifacts were relocated in r8, so the deprecation path was dead code —
+a stray RELOCATABLE root artifact now gets one stderr notice pointing
+at the upgrader instead of rendering as if it were indexed evidence.
+Live working files at the root (BENCH_TPU_* watcher capture targets)
+are the exception: the upgrader can never relocate them, so they keep
+rendering.
 
 Covers driver artifacts (BENCH_r*.json: {n, cmd, rc, tail, parsed}),
 watcher TPU evidence (BENCH_TPU_*.json), bench checkpoints
@@ -65,6 +70,14 @@ def _fmt(rec: dict) -> str:
         bits.append(f"wilcox_s={ex['wilcox_s']}")
     if ex.get("stage_throughput"):
         bits.append(f"costed_stages={len(ex['stage_throughput'])}")
+    q = rec.get("quality")
+    if isinstance(q, dict):
+        tot = (q.get("de_funnel") or {}).get("total") or {}
+        if tot.get("significant") is not None:
+            bits.append(f"de_sig={tot['significant']}")
+        trips = (q.get("numeric_health") or {}).get("trips") or []
+        if trips:
+            bits.append(f"SENTINEL_TRIPS={len(trips)}")
     return "  ".join(str(b) for b in bits)
 
 
@@ -160,37 +173,49 @@ _PATTERNS = (
 )
 
 
-def _scan_dir(root: str, prefix: str = "") -> Tuple[List[Row], int]:
-    """Render every evidence artifact under ``root``. The returned count
-    is the number of RELOCATABLE files — live working files
-    (BENCH_CHECKPOINT_*/BENCH_TPU_*, which the upgrader deliberately
-    skips) still render but must not trigger the deprecation nag, since
-    `--upgrade` can never clear them."""
-    from scconsensus_tpu.obs.ledger import is_transient_artifact
+def _render_file(path: str, prefix: str) -> List[Row]:
+    name = os.path.basename(path)
+    d, err = _load(path)
+    if err:
+        return [(prefix + name, err)]
+    if not isinstance(d, dict):
+        return [(prefix + name, f"unexpected type {type(d).__name__}")]
+    return [(prefix + label, desc) for label, desc in _rows_for(name, d)]
 
-    rows: List[Row] = []
-    n = 0
+
+def _iter_artifacts(root: str):
     seen = set()
     for pat in _PATTERNS:
         for path in sorted(glob.glob(os.path.join(root, pat))):
-            if path in seen:
-                continue
-            seen.add(path)
-            if not is_transient_artifact(path):
-                n += 1
-            name = os.path.basename(path)
-            d, err = _load(path)
-            if err:
-                rows.append((prefix + name, err))
-                continue
-            if not isinstance(d, dict):
-                rows.append((prefix + name, f"unexpected type "
-                             f"{type(d).__name__}"))
-                continue
-            rows.extend(
-                (prefix + label, desc) for label, desc in _rows_for(name, d)
-            )
-    return rows, n
+            if path not in seen:
+                seen.add(path)
+                yield path
+
+
+def _scan_dir(root: str, prefix: str = "") -> List[Row]:
+    """Render every evidence artifact under ``root`` (the evidence-dir
+    mode: everything there is indexed or a live checkpoint)."""
+    rows: List[Row] = []
+    for path in _iter_artifacts(root):
+        rows.extend(_render_file(path, prefix))
+    return rows
+
+
+def _scan_root(root: str) -> Tuple[List[Row], List[str]]:
+    """ONE pass over the repo root: live working files (BENCH_TPU_*
+    watcher targets, checkpoints — the upgrader can never relocate them)
+    render; relocatable strays are returned by name for the stderr
+    notice, never rendered as if they were indexed evidence."""
+    from scconsensus_tpu.obs.ledger import is_transient_artifact
+
+    rows: List[Row] = []
+    stray: List[str] = []
+    for path in _iter_artifacts(root):
+        if is_transient_artifact(path):
+            rows.extend(_render_file(path, prefix=""))
+        else:
+            stray.append(os.path.basename(path))
+    return rows, stray
 
 
 def _tunnel_row(root: str) -> Optional[Row]:
@@ -242,14 +267,24 @@ def _manifest_row(ev_dir: str) -> Optional[Row]:
     return ("evidence/MANIFEST.json", desc)
 
 
+def _stray_root_files(root: str) -> List[str]:
+    """Relocatable evidence files sitting at the root (the repo-hygiene
+    test's hook; main() gets the same list from its single scan)."""
+    return _scan_root(root)[1]
+
+
 def main() -> None:
     rows: List[Row] = []
-    root_rows, n_root = _scan_dir(ROOT)
+    # live working files at the root (BENCH_TPU_* capture targets) still
+    # render — the watcher writes them there mid-campaign by design
+    root_rows, stray = _scan_root(ROOT)
     rows.extend(root_rows)
-    if n_root:
+    if stray:
         print(
-            f"DeprecationWarning: {n_root} root-level evidence file(s) "
-            f"under {ROOT} — relocate into evidence/ with "
+            f"NOTE: {len(stray)} un-indexed root-level evidence file(s) "
+            f"under {ROOT} ({', '.join(stray[:5])}"
+            + ("…" if len(stray) > 5 else "")
+            + ") — not rendered; relocate into evidence/ with "
             "`python tools/perf_gate.py --upgrade`",
             file=sys.stderr,
         )
@@ -258,8 +293,7 @@ def main() -> None:
         mrow = _manifest_row(ev_dir)
         if mrow:
             rows.append(mrow)
-        ev_rows, _ = _scan_dir(ev_dir, prefix="evidence/")
-        rows.extend(ev_rows)
+        rows.extend(_scan_dir(ev_dir, prefix="evidence/"))
     trow = _tunnel_row(ROOT)
     if trow:
         rows.append(trow)

@@ -4,7 +4,9 @@
 Reads the ``*_heartbeat.jsonl`` stream an obs.live recorder appends to
 (bench workers, tools/run_sparse_1m.py, tunnel probes) and renders one
 status panel: last heartbeat age, uptime, host RSS / device HBM, compile
-stats, the open-span stack with elapsed walls, stall events, and — when
+stats, the open-span stack with elapsed walls, stall events, a quality
+panel (numeric-sentinel trips + the latest DE-funnel totals, so NaN
+storms and empty funnels are visible live), and — when
 the evidence ledger holds baseline history for the run's key — a
 per-stage ETA from the noise-banded baselines
 (``obs.regress.stage_baselines``). The sibling ``*_partial.json`` record
@@ -203,6 +205,28 @@ def render(lines: List[Dict[str, Any]],
                 out.append(_span_line(sp, baselines))
         else:
             out.append("  open spans: (none)")
+        q = hb.get("quality") or {}
+        if q:
+            bits = []
+            trips = q.get("trips")
+            if trips:
+                last = q.get("last_trip") or {}
+                bits.append(
+                    f"SENTINEL TRIPS: {trips}"
+                    + (f" (last: {last.get('span')}/{last.get('array')}"
+                       f" nan={last.get('nan', 0)}"
+                       f" inf={last.get('inf', 0)})"
+                       if last else "")
+                )
+            funnel = q.get("funnel") or {}
+            if funnel:
+                bits.append("funnel " + " → ".join(
+                    f"{k}={funnel[k]}" for k in
+                    ("input", "pct_gate", "logfc_gate", "tested",
+                     "significant") if k in funnel
+                ))
+            if bits:
+                out.append("  quality: " + "   ".join(bits))
     if st["stall"]:
         sl = st["stall"]
         out.append(f"  STALL #{sl.get('stalls')} at +{_fmt_dur((sl.get('ts') or 0) - float((st['header'] or {}).get('ts') or 0))}"
