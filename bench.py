@@ -124,6 +124,28 @@ def _trim_line(parsed: dict) -> str:
         parsed["spans"] = []
         parsed.setdefault("extra", {})["truncated"] = True
         line = json.dumps(parsed)
+    # residency/kernels sections next: they live whole in the checkpoint
+    # + ledger record; the tail keeps one-line summaries (total transfer
+    # bytes + any enforce-mode violation count — the facts a driver must
+    # see)
+    if len(line) > 1500 and parsed.get("residency"):
+        res = parsed.pop("residency")
+        ex = parsed.setdefault("extra", {})
+        ex["transfer_bytes"] = (
+            (res.get("to_host") or {}).get("bytes", 0)
+            + (res.get("to_device") or {}).get("bytes", 0)
+        )
+        if res.get("violations"):
+            ex["residency_violations"] = len(res["violations"])
+        ex["truncated"] = True
+        line = json.dumps(parsed)
+    if len(line) > 1500 and parsed.get("kernels"):
+        kern = parsed.pop("kernels")
+        ex = parsed.setdefault("extra", {})
+        if kern.get("total_device_time_s") is not None:
+            ex["device_time_s"] = kern["total_device_time_s"]
+        ex["truncated"] = True
+        line = json.dumps(parsed)
     # quality section next (funnel per-pair lists scale with K²): it
     # lives whole in the checkpoint + ledger record; the tail keeps only
     # the sentinel-trip count, the one quality fact a driver must see
@@ -810,6 +832,11 @@ def _worker_body() -> None:
     # numeric-health sentinels on by default too (obs.quality): a NaN mid-
     # pipeline must land span-attributed on the run record, not in labels
     os.environ.setdefault("SCC_OBS_NUMERIC", "1")
+    # residency audit on by default (obs.residency): every bench record
+    # carries span-attributed transfer accounting, so the perf gate can
+    # baseline per-stage transfer bytes alongside walls. Audit, not
+    # enforce: a bench must measure a violation, not die of it.
+    os.environ.setdefault("SCC_OBS_RESIDENCY", "audit")
 
     import jax
 
@@ -913,7 +940,7 @@ def _worker_body() -> None:
         n_cells = cfg["n_cells"]
         size = f"{n_cells // 1000}k" if n_cells >= 1000 else str(n_cells)
         state = {"edger": None, "wilcox": None, "spans": None,
-                 "quality": None}
+                 "quality": None, "residency": None, "kernels": None}
 
         def _record():
             """Cumulative flagship record from whatever has finished."""
@@ -952,6 +979,8 @@ def _worker_body() -> None:
                 vs_baseline=vsb, extra=extra,
                 spans=state.get("spans") or [],
                 quality=state.get("quality"),
+                residency=state.get("residency"),
+                kernels=state.get("kernels"),
             )
 
         def _ckpt():
@@ -991,10 +1020,12 @@ def _worker_body() -> None:
             extra["edger_stages"] = _stage_dict(result)
             extra["union_size"] = int(result.de_gene_union_idx.size)
             _stamp_fingerprint(extra, result)
-            # the headline workload's span tree + quality section ride
-            # the run record
+            # the headline workload's span tree + quality/residency/
+            # kernels sections ride the run record
             state["spans"] = result.metrics.get("spans") or state["spans"]
             state["quality"] = result.metrics.get("quality")
+            state["residency"] = result.metrics.get("residency")
+            state["kernels"] = result.metrics.get("kernels")
             return elapsed
 
         state["edger"] = _section(extra, "edger", _edger)
@@ -1022,6 +1053,10 @@ def _worker_body() -> None:
                 state["spans"] = fast_res.metrics.get("spans")
             if not state["quality"]:
                 state["quality"] = fast_res.metrics.get("quality")
+            if not state["residency"]:
+                state["residency"] = fast_res.metrics.get("residency")
+            if not state["kernels"]:
+                state["kernels"] = fast_res.metrics.get("kernels")
             return fast_s
 
         state["wilcox"] = _section(extra, "wilcox", _wilcox)
@@ -1060,10 +1095,12 @@ def _worker_body() -> None:
             extra=extra,
             spans=refine_state.get("spans") or [],
             quality=refine_state.get("quality"),
+            residency=refine_state.get("residency"),
+            kernels=refine_state.get("kernels"),
         )
 
     refine_state = {"secs": None, "phase": "cold", "spans": None,
-                    "quality": None}
+                    "quality": None, "residency": None, "kernels": None}
     _install_term_handler(lambda: _refine_record(refine_state["secs"]))
     if _LIVE is not None:
         _LIVE.record_fn = lambda: _refine_record(refine_state["secs"])
@@ -1087,6 +1124,8 @@ def _worker_body() -> None:
         refine_state["secs"] = elapsed
         refine_state["spans"] = result.metrics.get("spans")
         refine_state["quality"] = result.metrics.get("quality")
+        refine_state["residency"] = result.metrics.get("residency")
+        refine_state["kernels"] = result.metrics.get("kernels")
         refine_state["phase"] = "steady"
         log(f"[bench] steady-state run: {elapsed:.2f}s; union="
             f"{result.de_gene_union_idx.size} genes; "

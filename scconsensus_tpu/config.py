@@ -80,6 +80,20 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "Evidence-ledger directory override (default <cwd>/evidence"
                 "; bench.py anchors it next to itself). The test suite "
                 "points it at a tmp dir."),
+        EnvFlag("SCC_OBS_RESIDENCY", str, "off",
+                "Host<->device residency auditor (obs.residency): 'off' "
+                "(default), 'audit' (record every transfer with direction, "
+                "bytes, owning span and source site onto the run record's "
+                "residency section), 'enforce' (any crossing outside the "
+                "declared boundary allowlist raises with the offending "
+                "span named; jax.transfer_guard backs the patched entry "
+                "points). bench.py workers default it to 'audit'."),
+        EnvFlag("SCC_OBS_KERNELS", str, None,
+                "Directory for a jax.profiler capture window around the "
+                "pipeline (obs.kernels): device-op events are parsed from "
+                "the trace, joined to tracer spans, and summarized as the "
+                "run record's kernels section (top-K kernels by device "
+                "time, achieved rates vs the cost model). Unset = off."),
         EnvFlag("SCC_OBS_NUMERIC", bool, False,
                 "Numeric-health sentinels (obs.quality): cheap NaN/Inf "
                 "guards at stage boundaries in the pipeline, the DE "

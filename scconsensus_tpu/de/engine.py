@@ -94,7 +94,12 @@ class PairwiseDEResult:
             with object.__getattribute__(self, "_fetch_lock"):
                 v = object.__getattribute__(self, name)  # re-check under lock
                 if not isinstance(v, np.ndarray):
-                    v = np.asarray(jax.device_get(v))
+                    from scconsensus_tpu.obs.residency import boundary
+
+                    # declared crossing: a host consumer asked for this
+                    # (P, G) field — the documented lazy materialization
+                    with boundary("de_result_fetch"):
+                        v = np.asarray(jax.device_get(v))
                     object.__setattr__(self, name, v)
         elif name == "aux" and v is not None and any(
             not isinstance(a, np.ndarray) for a in v.values()
@@ -102,8 +107,11 @@ class PairwiseDEResult:
             with object.__getattribute__(self, "_fetch_lock"):
                 v = object.__getattribute__(self, name)
                 if any(not isinstance(a, np.ndarray) for a in v.values()):
-                    v = {k: np.asarray(a)
-                         for k, a in jax.device_get(v).items()}
+                    from scconsensus_tpu.obs.residency import boundary
+
+                    with boundary("de_result_fetch"):
+                        v = {k: np.asarray(a)
+                             for k, a in jax.device_get(v).items()}
                     object.__setattr__(self, name, v)
         return v
 
@@ -116,8 +124,12 @@ class PairwiseDEResult:
         R/reclusterDEConsensus.R:172-178 — here a returned metric)."""
         raw = object.__getattribute__(self, "de_mask")
         if not isinstance(raw, np.ndarray):
-            # reduce on device: fetch P ints, not the (P, G) mask
-            return np.asarray(jnp.sum(raw, axis=1))
+            from scconsensus_tpu.obs.residency import boundary
+
+            # reduce on device: fetch P ints, not the (P, G) mask — the
+            # allowlisted (P,)-sized funnel-count crossing
+            with boundary("funnel_counts"):
+                return np.asarray(jnp.sum(raw, axis=1))
         return raw.sum(axis=1)
 
     _ARRAY_FIELDS = ("pair_i", "pair_j", "log_p", "log_q", "log_fc",
@@ -140,8 +152,11 @@ class PairwiseDEResult:
                 and not isinstance(object.__getattribute__(self, f), np.ndarray)
             }
             if pending:
-                for f, v in jax.device_get(pending).items():
-                    object.__setattr__(self, f, np.asarray(v))
+                from scconsensus_tpu.obs.residency import boundary
+
+                with boundary("de_result_fetch"):
+                    for f, v in jax.device_get(pending).items():
+                        object.__setattr__(self, f, np.asarray(v))
 
     def to_store(self) -> Tuple[Dict[str, np.ndarray], Dict]:
         """(arrays, meta) for ArtifactStore — the single serialization point,
@@ -290,14 +305,17 @@ def _gene_chunks(data, gc: int, jdata=None):
     callers pass the already-uploaded ``jdata`` so the matrix crosses
     host→device exactly once per pipeline run."""
     from scconsensus_tpu.io.sparsemat import is_sparse, padded_row_chunk
+    from scconsensus_tpu.obs.residency import boundary as _rbound
 
     G = data.shape[0]
     sparse = is_sparse(data)
     if jdata is None and not sparse:
-        jdata = jnp.asarray(data)
+        with _rbound("input_staging"):
+            jdata = jnp.asarray(data)
     for g0 in range(0, G, gc):
         if sparse:
-            chunk = jnp.asarray(padded_row_chunk(data, g0, gc))
+            with _rbound("input_staging"):  # per-chunk sparse densify+upload
+                chunk = jnp.asarray(padded_row_chunk(data, g0, gc))
         else:
             chunk = jdata[g0 : g0 + gc]
             if chunk.shape[0] < gc:
@@ -325,9 +343,11 @@ def _redo_overflow_genes(parts, overflow, refetch, jn, jpi, jpj, K,
     the cap; continuous data overflows and pays one cheap wasted pass).
     ``refetch(ids, window)`` rebuilds kernel inputs for a gene subset —
     dense-device rows or CSR-compacted windows, the caller knows which."""
+    from scconsensus_tpu.obs.residency import boundary as _rbound
     from scconsensus_tpu.ops.ranksum_allpairs import allpairs_ranksum_chunk
 
-    all_nr = jax.device_get([nr for _, _, _, nr in overflow])
+    with _rbound("overflow_redo"):
+        all_nr = jax.device_get([nr for _, _, _, nr in overflow])
     for (idx, ids, weff, _), nr in zip(overflow, all_nr):
         bad = np.nonzero(nr[: ids.size] > run_cap)[0]
         if probe is not None and idx < len(probe.get("buckets", [])):
@@ -355,9 +375,11 @@ def _redo_overflow_dense(outs, overflow, data, gc, jdata, jcid, jn, jpi,
     chunk overflowed — dense chunks are span-shaped, so per-gene splicing
     would re-gather anyway."""
     from scconsensus_tpu.io.sparsemat import is_sparse, padded_row_chunk
+    from scconsensus_tpu.obs.residency import boundary as _rbound
     from scconsensus_tpu.ops.ranksum_allpairs import allpairs_ranksum_chunk
 
-    all_nr = jax.device_get([nr for _, _, _, nr in overflow])
+    with _rbound("overflow_redo"):
+        all_nr = jax.device_get([nr for _, _, _, nr in overflow])
     sparse = is_sparse(data)
     if jdata is None and not sparse:
         # mirror _gene_chunks's defensive rebuild: its contract lets dense
@@ -491,9 +513,13 @@ def _run_wilcox_device(
     if jdata is not None:
         # nnz over ALL cells (excluded cells still occupy window slots) and
         # a negativity check (the decomposition needs zeros as the minimum).
-        nnz_g, any_neg = jax.device_get((
-            jnp.sum(jdata > 0, axis=1), jnp.any(jdata < 0)
-        ))
+        # Declared crossing (TODO(item-2)): O(G) ints to plan the ladder.
+        from scconsensus_tpu.obs.residency import boundary as _rbound
+
+        with _rbound("wilcox_ladder_plan"):
+            nnz_g, any_neg = jax.device_get((
+                jnp.sum(jdata > 0, axis=1), jnp.any(jdata < 0)
+            ))
         windowed = not bool(any_neg)
         src = "dense-device"
     elif sparse_in:
@@ -584,11 +610,17 @@ def _run_wilcox_device(
                     vals, wcid = csr_window_rows(
                         data, ids, w, cid, pad_rows=gcb_eff
                     )
-                    rows = jnp.asarray(vals)
-                    # the mesh path pads/uploads cid itself (int-preserving,
-                    # sharded_de) — uploading here would round-trip it back
-                    # to host first
-                    kcid = wcid if mesh is not None else jnp.asarray(wcid)
+                    from scconsensus_tpu.obs.residency import (
+                        boundary as _rb,
+                    )
+
+                    with _rb("input_staging"):  # compacted-window upload
+                        rows = jnp.asarray(vals)
+                        # the mesh path pads/uploads cid itself (int-
+                        # preserving, sharded_de) — uploading here would
+                        # round-trip it back to host first
+                        kcid = (wcid if mesh is not None
+                                else jnp.asarray(wcid))
                     weff = w  # compacted input ALWAYS runs zero-block mode
                 else:
                     rows = jnp.take(jdata, jnp.asarray(ids), axis=0)
@@ -663,7 +695,16 @@ def _run_wilcox_device(
                         jax.block_until_ready(sort_probe(rows, kcid))
                         brec["sort_s"] = round(time.perf_counter() - t_s, 4)
                         if nr_b is not None:
-                            nr = np.asarray(jax.device_get(nr_b))[: ids.size]
+                            from scconsensus_tpu.obs.residency import (
+                                boundary as _rbound,
+                            )
+
+                            # SCC_WILCOX_PROBE diagnosis fetch — measurement
+                            # overhead, billed to the obs boundary
+                            with _rbound("obs_internal"):
+                                nr = np.asarray(
+                                    jax.device_get(nr_b)
+                                )[: ids.size]
                             if nr.size:
                                 brec["tied_runs_p50"] = int(np.median(nr))
                                 brec["tied_runs_max"] = int(nr.max())
@@ -745,29 +786,35 @@ def _run_wilcox_device(
             (n_of[pair_i] < EXACT_N_LIMIT) & (n_of[pair_j] < EXACT_N_LIMIT)
         )[0]
         if small.size:
-            # Fetch only the small pairs' rows (u + tie indicator).
-            if outs is None:
-                ties = jnp.take(jnp.concatenate(
-                    [o[2][: ids.size] for ids, o in parts], axis=0
-                ), jinv, axis=0).T
-            else:
-                ties = jnp.concatenate(
-                    [ts[: g1 - g0] for g0, g1, (_, _, ts) in outs], axis=0
-                ).T
-            rows = jnp.asarray(small)
-            u_small, tie_small = jax.device_get(
-                (u_stat[rows], ties[rows])
-            )
-            lp_small = np.array(log_p[rows])  # writable host copy
-            for r, p in enumerate(small):
-                tiefree = tie_small[r] == 0
-                if tiefree.any():
-                    cols = np.nonzero(tiefree)[0]
-                    _exact_host_update(
-                        lp_small, r, cols, u_small[r][tiefree],
-                        int(n_of[pair_i[p]]), int(n_of[pair_j[p]]),
-                    )
-            log_p = log_p.at[rows].set(jnp.asarray(lp_small))
+            from scconsensus_tpu.obs.residency import boundary as _rbound
+
+            # Fetch only the small pairs' rows (u + tie indicator) —
+            # R's exact branch runs on host by statistical design
+            # (declared boundary, obs.residency.BOUNDARIES).
+            with _rbound("exact_small_pairs"):
+                if outs is None:
+                    ties = jnp.take(jnp.concatenate(
+                        [o[2][: ids.size] for ids, o in parts], axis=0
+                    ), jinv, axis=0).T
+                else:
+                    ties = jnp.concatenate(
+                        [ts[: g1 - g0] for g0, g1, (_, _, ts) in outs],
+                        axis=0,
+                    ).T
+                rows = jnp.asarray(small)
+                u_small, tie_small = jax.device_get(
+                    (u_stat[rows], ties[rows])
+                )
+                lp_small = np.array(log_p[rows])  # writable host copy
+                for r, p in enumerate(small):
+                    tiefree = tie_small[r] == 0
+                    if tiefree.any():
+                        cols = np.nonzero(tiefree)[0]
+                        _exact_host_update(
+                            lp_small, r, cols, u_small[r][tiefree],
+                            int(n_of[pair_i[p]]), int(n_of[pair_j[p]]),
+                        )
+                log_p = log_p.at[rows].set(jnp.asarray(lp_small))
     return log_p, u_stat
 
 
@@ -871,9 +918,13 @@ def pairwise_de(
             onehot = np.zeros((N, K), np.float32)
             valid = cell_idx >= 0
             onehot[np.nonzero(valid)[0], cell_idx[valid]] = 1.0
-            agg = ClusterAggregates(
-                *(jnp.asarray(a) for a in aggregates_from_sparse(data, onehot))
-            )
+            from scconsensus_tpu.obs.residency import boundary as _rbound
+
+            with _rbound("input_staging"):  # host-computed (G, K) aggregates
+                agg = ClusterAggregates(
+                    *(jnp.asarray(a)
+                      for a in aggregates_from_sparse(data, onehot))
+                )
         else:
             # cid form: CPU segment sums are O(G·N) vs the one-hot matmul's
             # O(G·N·K) — the K²-shaped blowup the r5 tm100k artifact measured
@@ -937,10 +988,15 @@ def pairwise_de(
                 sub_onehot = np.zeros((N, K), np.float32)
                 for k, ci in enumerate(cell_idx_of):
                     sub_onehot[ci, k] = 1.0
-                test_agg = ClusterAggregates(*(
-                    jnp.asarray(a)
-                    for a in aggregates_from_sparse(data, sub_onehot)
-                ))
+                from scconsensus_tpu.obs.residency import (
+                    boundary as _rbound,
+                )
+
+                with _rbound("input_staging"):
+                    test_agg = ClusterAggregates(*(
+                        jnp.asarray(a)
+                        for a in aggregates_from_sparse(data, sub_onehot)
+                    ))
             else:
                 # folded rebuild: the subsampled groups re-enter as a (N,)
                 # cid vector through the same K-pruned kernel — no second
@@ -1175,14 +1231,18 @@ def de_gene_union(
     raw_mask = object.__getattribute__(result, "de_mask")
     raw_fc = object.__getattribute__(result, "log_fc")
     if not (isinstance(raw_mask, np.ndarray) and isinstance(raw_fc, np.ndarray)):
+        from scconsensus_tpu.obs.residency import boundary
+
         # Device fast path: per-pair top-k on device, fetch (P, n_top) ints
-        # instead of materializing two (P, G) arrays through the slow link.
+        # instead of materializing two (P, G) arrays through the slow link
+        # — the allowlisted de_union_topk crossing.
         masked = jnp.where(
             jnp.asarray(raw_mask), jnp.abs(jnp.asarray(raw_fc)), -jnp.inf
         )
         k = min(n_top, masked.shape[1])
         vals, idx = jax.lax.top_k(masked, k)
-        vals, idx = jax.device_get((vals, idx))
+        with boundary("de_union_topk"):
+            vals, idx = jax.device_get((vals, idx))
         return np.unique(idx[vals > -np.inf]).astype(np.int64)
     union: set = set()
     for p in range(result.n_pairs):

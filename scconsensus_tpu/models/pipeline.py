@@ -21,6 +21,7 @@ import numpy as np
 from scconsensus_tpu.config import CompatFlags, ReclusterConfig
 from scconsensus_tpu.de import de_gene_union, pairwise_de
 from scconsensus_tpu.obs import quality as obs_quality
+from scconsensus_tpu.obs import residency
 from scconsensus_tpu.de.engine import PairwiseDEResult
 from scconsensus_tpu.ops.colors import labels_to_colors
 from scconsensus_tpu.ops.linkage import HClustTree, ward_linkage
@@ -81,6 +82,12 @@ def refine(
     Observability: every stage runs inside a tracer span (submitted +
     device-synced walls; obs.trace). SCC_OBS_TRANSFERS=1 additionally
     counts explicit host↔device transfer bytes onto the result metrics;
+    SCC_OBS_RESIDENCY=audit|enforce runs the whole pipeline under the
+    residency auditor (obs.residency: every transfer span-attributed on
+    ``result.metrics["residency"]``; enforce raises on crossings outside
+    the declared boundary allowlist); SCC_OBS_KERNELS=<dir> opens a
+    jax.profiler capture window around the run and joins the device-op
+    timeline to spans (``result.metrics["kernels"]``);
     SCC_TRACE_DIR=<dir> exports <dir>/run_record.json and a Perfetto-
     openable <dir>/trace.json after the run (even a failed one, for
     post-mortems).
@@ -88,15 +95,26 @@ def refine(
     from contextlib import nullcontext
 
     from scconsensus_tpu.config import env_flag
+    from scconsensus_tpu.obs import residency as obs_residency
+    from scconsensus_tpu.obs.kernels import KernelCapture
 
-    timer = timer or StageTimer(get_logger())
+    capture = KernelCapture()
+    if timer is None:
+        # the kernel join needs TraceAnnotation windows in the profiler
+        # timeline, which the tracer's annotate mode emits per span
+        timer = StageTimer(get_logger(), trace=capture.enabled)
     watch = None
     if env_flag("SCC_OBS_TRANSFERS"):
         from scconsensus_tpu.obs.device import TransferWatch
 
         watch = TransferWatch()
+    auditor = None
+    if obs_residency.mode() != "off":
+        auditor = obs_residency.ResidencyAuditor()
     try:
-        with (watch if watch is not None else nullcontext()):
+        with obs_residency.audit_region(auditor), \
+                (watch if watch is not None else nullcontext()), \
+                capture:
             result = _refine_impl(data, labels, config, gene_names, timer,
                                   mesh)
     finally:
@@ -105,6 +123,22 @@ def refine(
             _export_trace(trace_dir, timer, watch)
     if watch is not None:
         result.metrics["transfers"] = watch.report()
+    if auditor is not None:
+        result.metrics["residency"] = auditor.report()
+    if capture.enabled:
+        try:
+            from scconsensus_tpu.obs.cost import stage_cost_summary
+
+            sec = capture.section(
+                span_records=result.metrics.get("spans") or [],
+                stage_cost=stage_cost_summary(
+                    result.metrics.get("spans") or []
+                ) or None,
+            )
+            if sec is not None:
+                result.metrics["kernels"] = sec
+        except Exception as e:  # capture is evidence, never a crash
+            get_logger().warning("kernel capture section failed: %r", e)
     return result
 
 
@@ -229,7 +263,12 @@ def _refine_impl(
             else:
                 cells = _rows_dense(union).T
             scores = pca_scores(jnp.asarray(cells), n_pcs)
-            return {"scores": np.asarray(scores)}
+            # declared crossing: tree/cuts/silhouette are host algorithms
+            # today, so the (N, n_pcs) scores must land on host — the
+            # TODO(item-2) boundary the device-resident-graph refactor
+            # removes (obs.residency.BOUNDARIES)
+            with residency.boundary("embed_scores_fetch"):
+                return {"scores": np.asarray(scores)}
 
         embedding = store.cached("embed", _embed)["scores"]
         if obs_quality.enabled():
@@ -369,8 +408,11 @@ def _refine_impl(
 
     with timer.stage("nodg"):
         # per-cell number of detected genes; the reference's O(N·G)
-        # interpreted loop (R/reclusterDEConsensus.R:272-275) is one reduction
-        nodg = sparse_nodg(data)
+        # interpreted loop (R/reclusterDEConsensus.R:272-275) is one
+        # reduction. Declared crossing: the (N,) counts are a pipeline
+        # output and must reach the host once.
+        with residency.boundary("label_fetch"):
+            nodg = sparse_nodg(data)
 
     # Quality telemetry (obs.quality): the DE gate funnel, window-ladder
     # occupancy, cluster structure vs the input labeling, and any
@@ -424,7 +466,7 @@ def _refine_impl(
         result.metrics["quality"] = quality_section
 
     if config.plot_name:
-        with timer.stage("report"):
+        with timer.stage("report"), residency.boundary("label_fetch"):
             from scconsensus_tpu.report.de_heatmap import cell_type_de_plot
 
             cell_type_de_plot(

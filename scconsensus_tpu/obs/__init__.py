@@ -31,7 +31,18 @@ Four layers, consumed together through one versioned run-record schema:
     the DE gate funnel / rank-sum ladder occupancy / cluster-structure
     sections of the run record, and the quality-schema validator
     (``tools/explain_run.py`` renders one run — or a two-run diff — as
-    a Markdown report).
+    a Markdown report);
+  * ``obs.residency`` — the span-attributed host↔device residency
+    auditor (SCC_OBS_RESIDENCY audit|enforce): implicit transfers
+    caught at the np/jnp conversion entry points with a
+    jax.transfer_guard backstop, aggregated into the run record's
+    ``residency`` section and enforced against the declared boundary
+    allowlist (the ROADMAP item-2 acceptance layer);
+  * ``obs.kernels`` — the device-kernel timeline: a jax.profiler
+    capture window (SCC_OBS_KERNELS) parsed into per-kernel device
+    times, joined to tracer spans and the obs.cost FLOPs/bytes model
+    as the run record's ``kernels`` section (the roofline-style
+    evidence ROADMAP item 3 gates on).
 
 ``utils.logging.StageTimer`` remains as a thin back-compat shim over
 ``Tracer``; ``bench.py`` and the ``tools/`` emitters all build their
@@ -50,6 +61,7 @@ from scconsensus_tpu.obs.live import LiveRecorder, active_recorder, flush_active
 from scconsensus_tpu.obs.metrics import MetricSet
 from scconsensus_tpu.obs import quality  # noqa: F401 (after trace: it
 #                                          reads the partially-built pkg)
+from scconsensus_tpu.obs import kernels, residency  # noqa: F401
 from scconsensus_tpu.obs.export import (
     SCHEMA_NAME,
     SCHEMA_VERSION,
@@ -62,6 +74,8 @@ from scconsensus_tpu.obs.export import (
 
 __all__ = [
     "quality",
+    "residency",
+    "kernels",
     "Span",
     "Tracer",
     "current_tracer",

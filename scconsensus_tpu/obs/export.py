@@ -37,6 +37,18 @@ schema ``scc-run-record`` version 1 — top-level keys:
                     contingency entropy, ARI vs inputs, churn, per-
                     deepSplit silhouette), and numeric-health sentinel
                     trips. Validated by obs.quality.validate_quality.
+  residency         OPTIONAL (still schema version 1 — additive): the
+                    host↔device residency audit (obs.residency) — mode,
+                    per-direction byte/call totals, per-stage and per-
+                    boundary aggregates, span-attributed transfer
+                    events, enforce-mode violations. Validated by
+                    obs.residency.validate_residency.
+  kernels           OPTIONAL (still schema version 1 — additive): the
+                    device-kernel timeline (obs.kernels) — top-K kernels
+                    by device time from a jax.profiler capture window,
+                    joined to tracer spans and the obs.cost FLOPs/bytes
+                    model (achieved device-time rates). Validated by
+                    obs.kernels.validate_kernels.
 
 The Chrome trace export (:func:`chrome_trace`) converts the span tree to
 ``traceEvents`` complete ("X") events — open the file in Perfetto
@@ -104,13 +116,17 @@ def build_run_record(
     transfers: Optional[Dict[str, Any]] = None,
     platform: Optional[str] = None,
     quality: Optional[Dict[str, Any]] = None,
+    residency: Optional[Dict[str, Any]] = None,
+    kernels: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One schema-v1 run record. Pass ``tracer`` to take spans + compile
     stats from it; or pre-built ``spans`` (e.g. a resumed pipeline's
     ``result.metrics["spans"]``); or neither (orchestrator-side records
     written before any measurement ran). ``quality`` (optional) attaches
     the obs.quality section — funnels, cluster structure, sentinel
-    trips."""
+    trips; ``residency`` / ``kernels`` (optional) attach the
+    obs.residency transfer audit and the obs.kernels device-op
+    timeline."""
     if spans is None:
         spans = tracer.span_records() if tracer is not None else []
     extra = dict(extra or {})
@@ -140,6 +156,10 @@ def build_run_record(
     }
     if quality is not None:
         rec["quality"] = quality
+    if residency is not None:
+        rec["residency"] = residency
+    if kernels is not None:
+        rec["kernels"] = kernels
     return rec
 
 
@@ -224,6 +244,16 @@ def validate_run_record(rec: Dict[str, Any]) -> None:
         from scconsensus_tpu.obs.quality import validate_quality
 
         validate_quality(qual)
+    res = rec.get("residency")
+    if res is not None:
+        from scconsensus_tpu.obs.residency import validate_residency
+
+        validate_residency(res)
+    kern = rec.get("kernels")
+    if kern is not None:
+        from scconsensus_tpu.obs.kernels import validate_kernels
+
+        validate_kernels(kern)
 
 
 # --------------------------------------------------------------------------

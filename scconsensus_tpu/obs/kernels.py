@@ -1,0 +1,363 @@
+"""Device-kernel timeline: a ``jax.profiler`` capture window joined to
+tracer spans and the XLA cost model.
+
+The r8 cost attribution (obs.cost) prices what a run *asked for* —
+static FLOPs/bytes from ``cost_analysis`` against host-side span walls.
+Nothing yet measures what the device actually *did*: per-kernel device
+time is the denominator ROADMAP item 3's accelerator evidence needs
+(host walls include dispatch, Python, and the transfer link). This
+module opens a ``jax.profiler.start_trace`` window around chosen stages,
+parses the Perfetto ``*.trace.json.gz`` the profiler writes, and joins:
+
+  * **device-op events** — trace X-events carrying an ``hlo_op`` arg
+    (the XLA executor stamps these on every backend: CPU thunks, GPU
+    streams, TPU TensorCore planes), keyed ``(hlo_module, hlo_op)``;
+    pure call-wrapper ops are dropped so a fusion is not double-counted
+    under its enclosing ``call``;
+  * **tracer spans** — the tracer's ``annotate=True`` mode wraps every
+    span in ``jax.profiler.TraceAnnotation``, so span windows appear in
+    the same profiler timeline; a kernel event joins to the innermost
+    annotation window covering its start timestamp;
+  * **the cost model** — per-stage ``cost_analysis`` totals (obs.cost)
+    divided by *device* time instead of wall time give achieved FLOP/s
+    and bytes/s against the cost-model ceiling: the roofline-style
+    number a wall-based rate understates whenever the host is the
+    bottleneck.
+
+The result is the run record's validated ``kernels`` section: top-K
+kernels by total device time (with per-span attribution), total device
+time, and per-stage achieved rates. Capture is gated by the registered
+``SCC_OBS_KERNELS`` flag naming the capture directory; everything is
+best-effort — a backend whose trace carries no ``hlo_op`` events yields
+an honest ``n_events: 0`` section, never a crash.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from scconsensus_tpu.config import env_flag
+
+__all__ = [
+    "capture_dir",
+    "KernelCapture",
+    "parse_trace_file",
+    "device_op_events",
+    "annotation_windows",
+    "join_kernels_to_spans",
+    "kernels_section",
+    "validate_kernels",
+]
+
+DEFAULT_TOP_K = 12
+
+
+def capture_dir() -> Optional[str]:
+    """The ``SCC_OBS_KERNELS`` capture directory, or None (= capture off)."""
+    d = env_flag("SCC_OBS_KERNELS")
+    return str(d) if d else None
+
+
+# --------------------------------------------------------------------------
+# capture window
+# --------------------------------------------------------------------------
+
+class KernelCapture:
+    """One profiler capture window. ``with KernelCapture(dir):`` starts a
+    trace on entry and stops it on exit; :meth:`section` then parses the
+    newest trace file written after the window opened and builds the
+    run-record section. Never the process's first jax touch, and never
+    fatal: a wedged or unavailable profiler records ``error`` and moves
+    on (the flight recorder owns stall diagnosis, not this window)."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 top_k: int = DEFAULT_TOP_K):
+        self.directory = directory if directory is not None else capture_dir()
+        self.top_k = int(top_k)
+        self.t_open = 0.0
+        self.open_ok = False
+        self.error: Optional[str] = None
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.directory)
+
+    def __enter__(self) -> "KernelCapture":
+        if not self.enabled:
+            return self
+        self.t_open = time.time()
+        try:
+            import jax.profiler
+
+            os.makedirs(self.directory, exist_ok=True)
+            jax.profiler.start_trace(self.directory)
+            self.open_ok = True
+        except Exception as e:
+            self.error = f"start_trace failed: {e!r}"[:200]
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if not self.open_ok:
+            return
+        try:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+        except Exception as e:
+            self.error = f"stop_trace failed: {e!r}"[:200]
+            self.open_ok = False
+
+    def trace_file(self) -> Optional[str]:
+        """Newest ``*.trace.json.gz`` under the capture dir written after
+        this window opened (the profiler nests runs under
+        ``plugins/profile/<timestamp>/``)."""
+        if not self.enabled:
+            return None
+        cands = [
+            p for p in glob.glob(
+                os.path.join(self.directory, "**", "*.trace.json.gz"),
+                recursive=True,
+            )
+            if os.path.getmtime(p) >= self.t_open - 1.0
+        ]
+        return max(cands, key=os.path.getmtime) if cands else None
+
+    def section(self, span_records: Optional[List[Dict[str, Any]]] = None,
+                stage_cost: Optional[Dict[str, Dict[str, Any]]] = None,
+                ) -> Optional[Dict[str, Any]]:
+        """The run record's ``kernels`` section, or None when capture was
+        off. Parse failures degrade to an error-stamped section — a TPU
+        capture that half-wrote its trace must still leave evidence that
+        a capture was attempted."""
+        if not self.enabled:
+            return None
+        if self.error and not self.open_ok:
+            return {"top": [], "n_events": 0,
+                    "total_device_time_s": 0.0, "error": self.error}
+        path = self.trace_file()
+        if path is None:
+            return {"top": [], "n_events": 0, "total_device_time_s": 0.0,
+                    "error": "no trace file produced"}
+        try:
+            trace = parse_trace_file(path)
+            sec = kernels_section(trace, span_records or [],
+                                  stage_cost=stage_cost, top_k=self.top_k)
+            sec["trace_file"] = path
+            return sec
+        except Exception as e:
+            return {"top": [], "n_events": 0, "total_device_time_s": 0.0,
+                    "error": f"trace parse failed: {e!r}"[:200]}
+
+
+# --------------------------------------------------------------------------
+# trace parsing
+# --------------------------------------------------------------------------
+
+def parse_trace_file(path: str) -> Dict[str, Any]:
+    """Load a profiler Chrome-trace JSON (gzipped or plain)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        return json.loads(f.read().decode("utf-8", errors="replace"))
+
+
+def device_op_events(trace: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """X-events that are device-op executions: they carry an ``hlo_op``
+    arg (every XLA executor stamps it). Pure ``call`` wrappers are
+    dropped — the ops *inside* the call re-appear as their own events,
+    and keeping both would double-count the fusion under its wrapper."""
+    out: List[Dict[str, Any]] = []
+    for e in trace.get("traceEvents") or []:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        op = args.get("hlo_op")
+        if not op or op == "call" or e.get("name") == "call":
+            continue
+        out.append({
+            "name": str(e.get("name")),
+            "hlo_module": str(args.get("hlo_module") or ""),
+            "ts_us": float(e.get("ts") or 0.0),
+            "dur_us": float(e.get("dur") or 0.0),
+        })
+    return out
+
+
+def annotation_windows(trace: Dict[str, Any], span_names) -> List[Dict]:
+    """X-events whose name matches a tracer span name — the
+    ``TraceAnnotation`` windows the tracer's ``annotate=True`` mode
+    emits, in the same µs timeline as the device ops."""
+    names = set(span_names)
+    out = []
+    for e in trace.get("traceEvents") or []:
+        if e.get("ph") == "X" and e.get("name") in names:
+            out.append({
+                "span": str(e["name"]),
+                "ts_us": float(e.get("ts") or 0.0),
+                "dur_us": float(e.get("dur") or 0.0),
+            })
+    return out
+
+
+def join_kernels_to_spans(kernels: List[Dict[str, Any]],
+                          windows: List[Dict[str, Any]],
+                          stage_names=()) -> None:
+    """Attribute each kernel event, in place, to the INNERMOST (shortest)
+    annotation window covering its start timestamp (``span`` key) and to
+    the innermost covering *stage*-named window (``stage`` key — the
+    perf-gate unit: a kernel inside a ``wilcox_bucket`` detail window
+    still bills to the ``wilcox_test`` stage). None when nothing covers
+    it — e.g. an async op that retired after its dispatching span
+    closed."""
+    wins = sorted(windows, key=lambda w: w["dur_us"])
+    stages = [w for w in wins if w["span"] in set(stage_names)]
+    for k in kernels:
+        t = k["ts_us"]
+        k["span"] = next(
+            (w["span"] for w in wins
+             if w["ts_us"] <= t <= w["ts_us"] + w["dur_us"]),
+            None,
+        )
+        k["stage"] = next(
+            (w["span"] for w in stages
+             if w["ts_us"] <= t <= w["ts_us"] + w["dur_us"]),
+            None,
+        )
+
+
+def kernels_section(trace: Dict[str, Any],
+                    span_records: List[Dict[str, Any]],
+                    stage_cost: Optional[Dict[str, Dict[str, Any]]] = None,
+                    top_k: int = DEFAULT_TOP_K) -> Dict[str, Any]:
+    """Build the ``kernels`` run-record section from a parsed trace.
+
+    ``span_records``: the tracer's span records (names feed the
+    annotation join). ``stage_cost``: obs.cost per-stage summary — when
+    given, stages gain ``achieved_gflops_device`` / ``achieved_gbps_device``
+    (cost-model totals over summed *device* time), the rate wall-based
+    attribution understates whenever the host is the bottleneck.
+    """
+    kernels = device_op_events(trace)
+    span_names = {s.get("name") for s in span_records
+                  if isinstance(s, dict) and s.get("name")}
+    stage_names = {s.get("name") for s in span_records
+                   if isinstance(s, dict) and s.get("kind") == "stage"}
+    windows = annotation_windows(trace, span_names)
+    join_kernels_to_spans(kernels, windows, stage_names=stage_names)
+
+    agg: Dict[Any, Dict[str, Any]] = {}
+    by_span: Dict[str, float] = {}
+    by_stage: Dict[str, float] = {}
+    total_us = 0.0
+    for k in kernels:
+        total_us += k["dur_us"]
+        key = (k["hlo_module"], k["name"])
+        a = agg.setdefault(key, {
+            "kernel": k["name"], "hlo_module": k["hlo_module"],
+            "device_time_us": 0.0, "count": 0,
+            "spans": {},
+        })
+        a["device_time_us"] += k["dur_us"]
+        a["count"] += 1
+        if k.get("span"):
+            a["spans"][k["span"]] = a["spans"].get(k["span"], 0.0) \
+                + k["dur_us"]
+            by_span[k["span"]] = by_span.get(k["span"], 0.0) + k["dur_us"]
+        if k.get("stage"):
+            by_stage[k["stage"]] = by_stage.get(k["stage"], 0.0) \
+                + k["dur_us"]
+    top = sorted(agg.values(), key=lambda a: -a["device_time_us"])[:top_k]
+    for a in top:
+        a["device_time_s"] = round(a["device_time_us"] / 1e6, 6)
+        a["pct"] = round(100.0 * a["device_time_us"] / total_us, 2) \
+            if total_us else 0.0
+        a["span"] = max(a["spans"], key=a["spans"].get) \
+            if a["spans"] else None
+        a.pop("spans")
+        a.pop("device_time_us")
+    sec: Dict[str, Any] = {
+        "n_events": len(kernels),
+        "n_kernels": len(agg),
+        "total_device_time_s": round(total_us / 1e6, 6),
+        "top": top,
+        "by_span_device_s": {
+            k: round(v / 1e6, 6) for k, v in sorted(
+                by_span.items(), key=lambda kv: -kv[1]
+            )
+        },
+    }
+    if stage_cost:
+        stages: Dict[str, Dict[str, Any]] = {}
+        for stage, cost in stage_cost.items():
+            dev_s = by_stage.get(stage, 0.0) / 1e6
+            row: Dict[str, Any] = {
+                "device_time_s": round(dev_s, 6),
+                "wall_s": cost.get("wall_s"),
+                "flops": cost.get("flops"),
+                "bytes_accessed": cost.get("bytes_accessed"),
+            }
+            if dev_s > 0:
+                if cost.get("flops"):
+                    row["achieved_gflops_device"] = round(
+                        cost["flops"] / dev_s / 1e9, 3
+                    )
+                if cost.get("bytes_accessed"):
+                    row["achieved_gbps_device"] = round(
+                        cost["bytes_accessed"] / dev_s / 1e9, 3
+                    )
+            stages[stage] = row
+        sec["vs_cost_model"] = stages
+    return sec
+
+
+# --------------------------------------------------------------------------
+# validation
+# --------------------------------------------------------------------------
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(f"kernels section: {msg}")
+
+
+def validate_kernels(sec: Dict[str, Any]) -> None:
+    """Structural validation of a record's ``kernels`` section (additive
+    scc-run-record v1 extension; ``export.validate_run_record`` calls
+    this)."""
+    _require(isinstance(sec, dict), "must be an object")
+    n = sec.get("n_events")
+    _require(isinstance(n, int) and n >= 0,
+             "n_events must be an int >= 0")
+    tot = sec.get("total_device_time_s")
+    _require(isinstance(tot, (int, float)) and tot >= 0,
+             "total_device_time_s must be a number >= 0")
+    top = sec.get("top")
+    _require(isinstance(top, list), "top must be a list")
+    for i, a in enumerate(top):
+        _require(isinstance(a, dict), f"top[{i}] is not an object")
+        _require(isinstance(a.get("kernel"), str) and a["kernel"],
+                 f"top[{i}].kernel must be a non-empty string")
+        dt = a.get("device_time_s")
+        _require(isinstance(dt, (int, float)) and dt >= 0,
+                 f"top[{i}].device_time_s must be a number >= 0")
+        c = a.get("count")
+        _require(isinstance(c, int) and c >= 1,
+                 f"top[{i}].count must be an int >= 1")
+    bs = sec.get("by_span_device_s")
+    if bs is not None:
+        _require(isinstance(bs, dict), "by_span_device_s must be an object")
+        for k, v in bs.items():
+            _require(isinstance(v, (int, float)) and v >= 0,
+                     f"by_span_device_s[{k!r}] must be a number >= 0")
+    vc = sec.get("vs_cost_model")
+    if vc is not None:
+        _require(isinstance(vc, dict), "vs_cost_model must be an object")
+        for stage, row in vc.items():
+            _require(isinstance(row, dict),
+                     f"vs_cost_model[{stage!r}] not an object")
+            dt = row.get("device_time_s")
+            _require(isinstance(dt, (int, float)) and dt >= 0,
+                     f"vs_cost_model[{stage!r}].device_time_s invalid")

@@ -375,6 +375,18 @@ class Ledger:
                 entry["stage_cost"] = cost
         except Exception:
             pass
+        try:
+            from scconsensus_tpu.obs.residency import stage_transfer_bytes
+
+            # per-stage transfer totals ride the index so the perf gate's
+            # transfer-byte baselines read the manifest, not N files —
+            # exactly like stage_walls. Absent when no audit ran (absence
+            # must never read as "zero bytes").
+            tb = stage_transfer_bytes(rec)
+            if tb:
+                entry["stage_transfer_bytes"] = tb
+        except Exception:
+            pass
         self._manifest["entries"] = [
             e for e in self._manifest["entries"] if e.get("file") != name
         ]

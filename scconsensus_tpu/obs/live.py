@@ -448,6 +448,18 @@ class LiveRecorder:
                 hb["quality"] = q
         except Exception:
             pass
+        try:
+            # residency panel: cumulative transfer counters of the active
+            # auditor — tail_run differences consecutive ticks into a live
+            # transfer-bytes rate (a host-round-trip storm is visible as
+            # MB/s while the run is still going, not post-mortem)
+            from scconsensus_tpu.obs import residency as obs_residency
+
+            tc = obs_residency.live_counters()
+            if tc:
+                hb["transfers"] = tc
+        except Exception:
+            pass
         mem = obs_device.memory_snapshot()
         if mem is not None:
             hb["hbm"] = mem

@@ -271,7 +271,12 @@ def de_funnel(result, config) -> Optional[Dict[str, Any]]:
     measures group-size skips only; on older stored results it degrades
     to a pct ∧ |logFC| recomputation (then the mean gate's rejections
     land in the tested drop)."""
-    with _timed():
+    from scconsensus_tpu.obs.residency import boundary
+
+    # declared residency crossing: the funnel fetches ONLY (P,)-sized
+    # count vectors (a test pins that it forces no (P, G) host
+    # materialization) — the allowlisted funnel_counts boundary
+    with _timed(), boundary("funnel_counts"):
         raw = lambda f: object.__getattribute__(result, f)  # noqa: E731
         tested = raw("tested")
         de_mask = raw("de_mask")

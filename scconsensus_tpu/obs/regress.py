@@ -37,8 +37,10 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 __all__ = [
     "ANCHOR_RUNS",
     "StageVerdict",
+    "TransferVerdict",
     "GateVerdict",
     "stage_baselines",
+    "stage_transfer_baselines",
     "diff_span_trees",
     "gate_record",
     "DRIFT_LEDGER_NAME",
@@ -57,11 +59,40 @@ __all__ = [
 ANCHOR_RUNS = 3          # median-of-3 (BASELINE.md measurement policy)
 REL_NOISE_FLOOR = 0.10   # band is never tighter than 10 % of baseline
 ABS_NOISE_FLOOR_S = 0.05  # ...or 50 ms (timer + drain jitter at tiny walls)
+# Transfer-bytes bands (BASELINE.md residency-gate policy): transfers are
+# near-deterministic per workload, but event-cap truncation and data-
+# dependent paths (overflow redo, exact-branch pair counts) wiggle a few
+# KiB — 64 KiB absolute floor, same 10 % relative floor as walls.
+ABS_NOISE_FLOOR_BYTES = 64 << 10
 
 
 # --------------------------------------------------------------------------
-# per-stage baselines
+# per-stage baselines (walls and transfer bytes share one banding policy)
 # --------------------------------------------------------------------------
+
+def _banded_baselines(series: Dict[str, List[float]], abs_floor: float
+                      ) -> Dict[str, Dict[str, float]]:
+    """Median-of-≤ANCHOR_RUNS with a noise band floored at
+    ``max(spread, 10% of baseline, abs_floor)`` — the BASELINE.md policy,
+    shared by stage walls and stage transfer bytes so the two gates can
+    never drift apart."""
+    out: Dict[str, Dict[str, float]] = {}
+    for stage, vs in series.items():
+        anchor = sorted(vs[-ANCHOR_RUNS:])
+        n = len(anchor)
+        baseline = anchor[n // 2] if n % 2 else (
+            0.5 * (anchor[n // 2 - 1] + anchor[n // 2])
+        )
+        spread = anchor[-1] - anchor[0]
+        band = max(spread, REL_NOISE_FLOOR * baseline, abs_floor)
+        out[stage] = {
+            "baseline": baseline,
+            "band": band,
+            "spread": spread,
+            "n": n,
+        }
+    return out
+
 
 def stage_baselines(history: Sequence[Dict[str, Any]]
                     ) -> Dict[str, Dict[str, float]]:
@@ -83,22 +114,46 @@ def stage_baselines(history: Sequence[Dict[str, Any]]
         for stage, w in (e.get("stage_walls") or {}).items():
             if isinstance(w, (int, float)) and w >= 0:
                 walls.setdefault(stage, []).append(float(w))
-    out: Dict[str, Dict[str, float]] = {}
-    for stage, ws in walls.items():
-        anchor = sorted(ws[-ANCHOR_RUNS:])
-        n = len(anchor)
-        baseline = anchor[n // 2] if n % 2 else (
-            0.5 * (anchor[n // 2 - 1] + anchor[n // 2])
-        )
-        spread = anchor[-1] - anchor[0]
-        band = max(spread, REL_NOISE_FLOOR * baseline, ABS_NOISE_FLOOR_S)
-        out[stage] = {
-            "baseline_s": round(baseline, 6),
-            "band_s": round(band, 6),
-            "spread_s": round(spread, 6),
-            "n": n,
+    return {
+        stage: {
+            "baseline_s": round(b["baseline"], 6),
+            "band_s": round(b["band"], 6),
+            "spread_s": round(b["spread"], 6),
+            "n": b["n"],
         }
-    return out
+        for stage, b in _banded_baselines(walls, ABS_NOISE_FLOOR_S).items()
+    }
+
+
+def stage_transfer_baselines(history: Sequence[Dict[str, Any]]
+                             ) -> Dict[str, Dict[str, float]]:
+    """Per-stage transfer-byte baselines from manifest entries' ledger-
+    stamped ``stage_transfer_bytes`` (total of both directions; stamped at
+    ingest from the record's residency section). Same median-of-≤3 +
+    noise-band machinery as :func:`stage_baselines`, partials excluded
+    for the same reason. Returns ``{stage: {baseline_bytes, band_bytes,
+    spread_bytes, n}}``; stages never audited simply have no entry —
+    absence of audit must not read as zero bytes."""
+    from scconsensus_tpu.obs.ledger import is_partial_entry
+
+    series: Dict[str, List[float]] = {}
+    for e in history:
+        if is_partial_entry(e):
+            continue
+        for stage, b in (e.get("stage_transfer_bytes") or {}).items():
+            if isinstance(b, (int, float)) and b >= 0:
+                series.setdefault(stage, []).append(float(b))
+    return {
+        stage: {
+            "baseline_bytes": round(b["baseline"]),
+            "band_bytes": round(b["band"]),
+            "spread_bytes": round(b["spread"]),
+            "n": b["n"],
+        }
+        for stage, b in _banded_baselines(
+            series, ABS_NOISE_FLOOR_BYTES
+        ).items()
+    }
 
 
 # --------------------------------------------------------------------------
@@ -173,6 +228,23 @@ class StageVerdict:
 
 
 @dataclasses.dataclass
+class TransferVerdict:
+    """Per-stage transfer-bytes verdict (residency section vs the key's
+    ledger-stamped baselines) — the same shape of claim as StageVerdict,
+    in bytes instead of seconds."""
+
+    stage: str
+    bytes: int
+    baseline_bytes: int
+    band_bytes: int
+    regressed: bool
+    excess_bytes: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
 class GateVerdict:
     ok: bool
     key: Dict[str, str]
@@ -184,10 +256,19 @@ class GateVerdict:
     # termination cause when it is itself a partial record
     n_partial_excluded: int = 0
     candidate_termination: Optional[str] = None
+    # per-stage transfer-bytes verdicts (empty when the candidate carried
+    # no residency audit or the key has no transfer history)
+    transfers: List[TransferVerdict] = dataclasses.field(
+        default_factory=list
+    )
 
     @property
     def regressions(self) -> List[StageVerdict]:
         return [s for s in self.stages if s.regressed]
+
+    @property
+    def transfer_regressions(self) -> List[TransferVerdict]:
+        return [t for t in self.transfers if t.regressed]
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -199,6 +280,10 @@ class GateVerdict:
             "candidate_termination": self.candidate_termination,
             "regressions": [s.to_dict() for s in self.regressions],
             "stages": [s.to_dict() for s in self.stages],
+            "transfers": [t.to_dict() for t in self.transfers],
+            "transfer_regressions": [
+                t.to_dict() for t in self.transfer_regressions
+            ],
         }
 
 
@@ -289,11 +374,40 @@ def gate_record(candidate: Dict[str, Any],
                 )
             sv.efficiency = _efficiency(cand_cost, baseline_cost, stage)
         stages.append(sv)
-    ok = not any(s.regressed for s in stages)
+    # transfer-bytes gate (obs.residency): per-stage bytes vs the key's
+    # ledger-stamped baselines, same noise-band policy as walls. Only
+    # stages BOTH sides audited compare — a candidate without an audit
+    # (or a history without one) silently gates walls only.
+    from scconsensus_tpu.obs.residency import (
+        stage_transfer_bytes as _cand_transfers,
+    )
+
+    transfers: List[TransferVerdict] = []
+    cand_bytes = _cand_transfers(candidate)
+    if cand_bytes:
+        tbase = stage_transfer_baselines(history)
+        for stage, nbytes in sorted(cand_bytes.items()):
+            tb = tbase.get(stage)
+            if tb is None:
+                continue
+            limit_b = tb["baseline_bytes"] + tb["band_bytes"]
+            tv = TransferVerdict(
+                stage=stage, bytes=int(nbytes),
+                baseline_bytes=int(tb["baseline_bytes"]),
+                band_bytes=int(tb["band_bytes"]),
+                regressed=nbytes > limit_b,
+            )
+            if tv.regressed:
+                tv.excess_bytes = int(nbytes - limit_b)
+            transfers.append(tv)
+    ok = not any(s.regressed for s in stages) and not any(
+        t.regressed for t in transfers
+    )
     return GateVerdict(ok=ok, key=key, n_history=len(history),
                        stages=stages, note=note,
                        n_partial_excluded=n_partial,
-                       candidate_termination=cand_term)
+                       candidate_termination=cand_term,
+                       transfers=transfers)
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,733 @@
+"""Span-attributed host↔device residency auditor.
+
+ROADMAP item 2 ("one device-resident execution graph") needs a tier-1
+test asserting zero host round-trips across consensus→embed — but
+``TransferWatch`` (obs.device) is best-effort by its own docstring:
+it wraps only the explicit ``jax.device_put``/``jax.device_get`` entry
+points, so an ``np.asarray`` on a device array or a ``from jax import
+device_get`` alias is invisible. This module is the measurement layer
+that claim gets verified against, in three modes via the registered
+``SCC_OBS_RESIDENCY`` flag:
+
+  * ``off`` — zero-overhead no-op (the auditor context degrades to a
+    passthrough).
+  * ``audit`` — every transfer the auditor can see is recorded with
+    direction, nbytes, the owning tracer span, the outermost open
+    *stage* span (the unit the perf gate baselines), the innermost
+    declared boundary (or None), and the first non-infrastructure
+    source line. Aggregates land on the run record's validated
+    ``residency`` section.
+  * ``enforce`` — a crossing that matches no declared boundary raises
+    :class:`ResidencyError` naming the offending span and source line.
+    ``jax.transfer_guard_device_to_host("disallow")`` additionally arms
+    XLA's own guard as the backstop for paths the Python patches cannot
+    see (active on real accelerators; the CPU backend's device→host
+    path is zero-copy and never fires it — which is exactly why the
+    patched entry points, not the guard, carry the CPU-testable
+    contract).
+
+**How transfers are seen.** On entry the auditor patches the module
+attributes hot-path code actually calls — ``numpy.asarray`` /
+``numpy.array`` (implicit device→host: the case TransferWatch misses),
+``jax.numpy.asarray`` / ``jax.numpy.array`` (implicit host→device
+staging), and ``jax.device_put`` / ``jax.device_get`` (explicit). These
+are the same four call forms the static residency lint
+(tests/test_residency_lint.py) ratchets in hot-path modules, so the
+dynamic auditor and the static gate cover one surface. C-level paths
+(buffer-protocol reads, jit argument staging of host arrays) bypass
+Python patches; the transfer guard covers those in enforce mode, and
+the count in audit mode is a documented lower bound on exotic paths —
+but every crossing the repo's own hot path performs goes through a
+patched form.
+
+**Enforcement policy.** Device→host is the round-trip direction item 2
+bans: ANY unallowlisted fetch raises, regardless of size. Host→device
+is the normal feed direction — index vectors and scalars stage
+constantly — so only a single transfer ≥ ``enforce_h2d_bytes``
+(default 1 MiB: the signature of re-uploading a matrix that should
+already be resident) outside a boundary raises; smaller staging is
+recorded, not fatal.
+
+**Boundaries.** :data:`BOUNDARIES` is the small declared allowlist of
+intentional crossings, each with its in-code justification; entries
+marked ``TODO(item-2)`` enumerate today's violations for the
+device-resident-graph refactor to burn down (landing the test ahead of
+the refactor is the point — the allowlist IS the work list). Code
+declares a crossing with ``with residency.boundary("name"):`` — unknown
+names raise immediately, so the allowlist cannot grow by typo.
+Transfers whose source resolves inside ``obs/`` (drain sentinels,
+sentinel-count fetches) auto-attribute to the ``obs_internal`` boundary
+when no explicit one is open: measurement overhead must be visible in
+audit mode but must not fail the enforcement the measurement exists to
+run.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from contextlib import ExitStack, contextmanager
+from typing import Any, Dict, List, Optional
+
+from scconsensus_tpu.config import env_flag
+
+__all__ = [
+    "MODES",
+    "BOUNDARIES",
+    "ResidencyError",
+    "ResidencyAuditor",
+    "mode",
+    "boundary",
+    "active_auditor",
+    "live_counters",
+    "stage_transfer_bytes",
+    "validate_residency",
+    "consumed_cpu_s",
+    "reset_cpu",
+]
+
+MODES = ("off", "audit", "enforce")
+
+# The declared allowlist: boundary name -> in-code justification. This dict
+# is the contract the enforce-mode tier-1 test runs against; a TODO(item-2)
+# marker means the crossing is a KNOWN violation of the device-resident
+# graph, enumerated here ahead of the refactor that removes it.
+BOUNDARIES: Dict[str, str] = {
+    "input_staging": (
+        "The one intended host→device upload of the expression matrix and "
+        "its index vectors (devcache.device_put_cached, engine setup). The "
+        "matrix crosses the link exactly once per run by design."
+    ),
+    "funnel_counts": (
+        "(P,)-sized per-pair count fetches for the DE gate funnel and "
+        "de_counts metrics (obs.quality.de_funnel, engine.de_counts) — "
+        "O(P) ints, never the (P, G) statistics."
+    ),
+    "label_fetch": (
+        "Pipeline-tail outputs: the final per-cell labels, the (N,) nodg "
+        "counts, and the report plot's gene-row gather — the result the "
+        "caller asked for has to reach the host once."
+    ),
+    "de_union_topk": (
+        "de_gene_union's device top-k fetch: (P, n_top) ints instead of "
+        "two (P, G) arrays through the slow link."
+    ),
+    "wilcox_ladder_plan": (
+        "O(G) nnz counts + a negativity scalar fetched to plan the window "
+        "ladder on host. TODO(item-2): fold ladder planning into the "
+        "device-resident graph."
+    ),
+    "overflow_redo": (
+        "Run-space overflow redo: one batched O(G) tied-run-count fetch "
+        "after all blocks dispatched (engine._redo_overflow_*). "
+        "TODO(item-2): keep the redo decision on device."
+    ),
+    "exact_small_pairs": (
+        "R's exact Wilcoxon branch runs on host for pairs with both "
+        "groups < 50 cells; only those pairs' rows are fetched. Host by "
+        "statistical design, not an accident."
+    ),
+    "embed_scores_fetch": (
+        "The (N, n_pcs) PCA embedding materializes to host because tree/"
+        "cuts/silhouette are host algorithms today. TODO(item-2): keep "
+        "the embedding device-resident through rSVD→linkage."
+    ),
+    "tree_pool_fetch": (
+        "Approximate-path pooling: the (m, d) k-means centroids + (N,) "
+        "assignment come to host for Ward linkage + cut propagation. "
+        "TODO(item-2): device-resident landmark tree."
+    ),
+    "silhouette_slab_fetch": (
+        "Exact-silhouette distance slabs / (N, K) cluster distance sums "
+        "copy to host (ops.distance, ops.pallas_kernels."
+        "distance_cluster_sums). TODO(item-2): device-resident "
+        "silhouette reduction."
+    ),
+    "de_result_fetch": (
+        "PairwiseDEResult lazy-field materialization (to_store, "
+        "fingerprinting, host consumers) — the documented single batched "
+        "fetch of the (P, G) statistics a host consumer asked for."
+    ),
+    "obs_internal": (
+        "Measurement infrastructure's own O(1) transfers: tracer drain "
+        "sentinels, sentinel-count fetches. Auto-attributed when the "
+        "source line resolves inside obs/."
+    ),
+}
+
+_EVENT_CAP = 256            # stored events; totals keep counting past it
+_ENFORCE_H2D_BYTES = 1 << 20
+
+_CPU = {"s": 0.0}
+_LOCK = threading.Lock()
+_ACTIVE: "Optional[ResidencyAuditor]" = None
+_TLS = threading.local()
+
+
+def consumed_cpu_s() -> float:
+    """Wall-clock spent inside auditor bookkeeping in this process (the
+    <2%-of-wall overhead guard reads this; the audited transfers
+    themselves are the workload's cost, not the auditor's)."""
+    return _CPU["s"]
+
+
+def reset_cpu() -> None:
+    _CPU["s"] = 0.0
+
+
+def mode() -> str:
+    """Resolved ``SCC_OBS_RESIDENCY`` mode; unknown values warn once via
+    ValueError at auditor construction (a typo'd 'enfrce' must not
+    silently run unguarded)."""
+    v = str(env_flag("SCC_OBS_RESIDENCY") or "off").strip().lower()
+    return v if v else "off"
+
+
+def active_auditor() -> "Optional[ResidencyAuditor]":
+    return _ACTIVE
+
+
+def live_counters() -> Optional[Dict[str, int]]:
+    """Cumulative transfer counters of the process's active auditor for
+    the flight recorder's heartbeat ticks (None when no audit is live).
+    tail_run.py differences consecutive ticks into a live byte rate."""
+    a = _ACTIVE
+    if a is None:
+        return None
+    return {
+        "to_host_bytes": a.to_host_bytes,
+        "to_device_bytes": a.to_device_bytes,
+        "events": a.n_events,
+    }
+
+
+class ResidencyError(RuntimeError):
+    """An enforce-mode crossing outside the declared allowlist."""
+
+
+def _boundary_stack() -> List[str]:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+@contextmanager
+def _delegating():
+    """Re-entrancy guard: ``jnp.asarray`` delegates to ``jax.device_put``
+    internally, so without this every staging call would double-count —
+    once at the outer patched form, once at the inner one."""
+    _TLS.depth = getattr(_TLS, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _TLS.depth -= 1
+
+
+def _nested() -> bool:
+    return getattr(_TLS, "depth", 0) > 0
+
+
+@contextmanager
+def boundary(name: str):
+    """Declare an intentional host↔device crossing scope. ``name`` must be
+    registered in :data:`BOUNDARIES` (KeyError otherwise — the allowlist
+    grows only by an explicit, justified entry). Inside the scope,
+    enforce mode's transfer guard flips to "allow" and every recorded
+    event carries the boundary name. No-op overhead when no auditor is
+    active."""
+    if name not in BOUNDARIES:
+        raise KeyError(
+            f"undeclared residency boundary {name!r}; register it with a "
+            "justification in obs.residency.BOUNDARIES"
+        )
+    auditor = _ACTIVE
+    stack = _boundary_stack()
+    stack.append(name)
+    try:
+        if auditor is not None and auditor.mode == "enforce":
+            import jax
+
+            with jax.transfer_guard("allow"):
+                yield
+        else:
+            yield
+    finally:
+        stack.pop()
+
+
+def _is_device_array(x: Any) -> bool:
+    """Concrete committed device buffers only — tracers (abstract values
+    inside jit) convert through entirely different machinery and must
+    never be billed as transfers."""
+    if "jax" not in sys.modules:
+        return False
+    try:
+        jax = sys.modules["jax"]
+        return isinstance(x, jax.Array) and not isinstance(
+            x, jax.core.Tracer
+        )
+    except Exception:
+        return False
+
+
+def _nbytes(x: Any) -> int:
+    try:
+        import jax
+
+        return sum(
+            int(getattr(leaf, "nbytes", 0) or 0)
+            for leaf in jax.tree_util.tree_leaves(x)
+        )
+    except Exception:
+        return int(getattr(x, "nbytes", 0) or 0)
+
+
+def _tree_has_device(x: Any) -> bool:
+    try:
+        import jax
+
+        return any(_is_device_array(l) for l in jax.tree_util.tree_leaves(x))
+    except Exception:
+        return _is_device_array(x)
+
+
+_OBS_DIR = os.path.dirname(os.path.abspath(__file__))
+_THIS_FILE = os.path.abspath(__file__)
+
+# filename -> "self" | "obs" | "infra" | basename; memoized because the
+# same few files dominate every walk and abspath/substring checks per
+# frame were the bulk of the auditor's <2%-of-wall budget
+_FILE_CLASS: Dict[str, str] = {}
+
+
+def _classify_file(fn: str) -> str:
+    c = _FILE_CLASS.get(fn)
+    if c is None:
+        ab = os.path.abspath(fn)
+        if ab == _THIS_FILE:
+            c = "self"
+        elif ab.startswith(_OBS_DIR + os.sep):
+            # os.sep-terminated: a sibling like obs_utils/ or
+            # observability.py must NOT inherit the obs_internal exemption
+            c = "obs"
+        elif (f"{os.sep}jax{os.sep}" in fn
+              or f"{os.sep}jax_plugins{os.sep}" in fn
+              or f"{os.sep}numpy{os.sep}" in fn):
+            c = "infra"
+        else:
+            c = os.path.basename(fn)
+        _FILE_CLASS[fn] = c
+    return c
+
+
+def _resolve_source() -> "tuple":
+    """``(where, from_obs)``: the first stack frame outside this module,
+    jax, and numpy — the source line that asked for the transfer — and
+    whether any obs/ frame (other than the auditor's own wrappers) sits
+    between it and the transfer, i.e. measurement infrastructure asked.
+    Bounded walk: cheap enough for audit mode's <2% budget, because
+    transfers are rare next to compute."""
+    f = sys._getframe(3)  # _resolve_source <- _record <- wrapper <- caller
+    from_obs = False
+    for _ in range(24):
+        if f is None:
+            break
+        c = _classify_file(f.f_code.co_filename)
+        if c == "obs":
+            from_obs = True
+        elif c not in ("self", "infra"):
+            return f"{c}:{f.f_lineno}", from_obs
+        f = f.f_back
+    return "<unknown>", from_obs
+
+
+class ResidencyAuditor:
+    """Scoped residency audit/enforcement (see module docstring).
+
+    Context manager; re-entrant use is rejected (one auditor owns the
+    process's patches at a time). ``mode`` defaults from the
+    ``SCC_OBS_RESIDENCY`` registry flag.
+    """
+
+    def __init__(self, mode: Optional[str] = None,
+                 enforce_h2d_bytes: int = _ENFORCE_H2D_BYTES,
+                 event_cap: int = _EVENT_CAP):
+        m = (mode if mode is not None else globals()["mode"]())
+        if m not in MODES:
+            raise ValueError(
+                f"SCC_OBS_RESIDENCY must be one of {MODES}, got {m!r}"
+            )
+        self.mode = m
+        self.enforce_h2d_bytes = int(enforce_h2d_bytes)
+        self.event_cap = int(event_cap)
+        self.to_device_bytes = 0
+        self.to_host_bytes = 0
+        self.to_device_calls = 0
+        self.to_host_calls = 0
+        self.n_events = 0
+        self.events_dropped = 0
+        self.events: List[Dict[str, Any]] = []
+        self.by_stage: Dict[str, Dict[str, int]] = {}
+        self.by_boundary: Dict[str, Dict[str, int]] = {}
+        self.violations: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._stack: Optional[ExitStack] = None
+        self._orig: Dict[str, Any] = {}
+
+    # -- span / stage attribution ------------------------------------------
+    @staticmethod
+    def _open_spans():
+        """(innermost span name, outermost open stage name) of the ambient
+        tracer — the event's owner and the perf gate's baseline unit."""
+        try:
+            from scconsensus_tpu.obs.trace import current_tracer, last_tracer
+
+            tr = current_tracer() or last_tracer()
+            if tr is None:
+                return None, None
+            with tr._lock:
+                stack = list(tr._stack)
+            span = stack[-1].name if stack else None
+            stage = next(
+                (s.name for s in stack if s.kind == "stage"), None
+            )
+            return span, stage
+        except Exception:
+            return None, None
+
+    # -- recording ----------------------------------------------------------
+    def _record(self, direction: str, nbytes: int, implicit: bool,
+                api: str) -> None:
+        t0 = time.perf_counter()
+        try:
+            bstack = _boundary_stack()
+            bound = bstack[-1] if bstack else None
+            where, from_obs = _resolve_source()
+            if bound is None and from_obs:
+                bound = "obs_internal"
+            span, stage = self._open_spans()
+            with self._lock:
+                if direction == "d2h":
+                    self.to_host_calls += 1
+                    self.to_host_bytes += nbytes
+                else:
+                    self.to_device_calls += 1
+                    self.to_device_bytes += nbytes
+                self.n_events += 1
+                key = "to_host_bytes" if direction == "d2h" \
+                    else "to_device_bytes"
+                if stage is not None and bound != "obs_internal":
+                    # measurement overhead (drain sentinels, diagnosis
+                    # fetches under SCC_WILCOX_PROBE) stays OUT of the
+                    # per-stage totals the perf gate baselines — a
+                    # probe-on run must not read as a workload transfer
+                    # regression. It remains visible in the directional
+                    # totals and by_boundary["obs_internal"].
+                    st = self.by_stage.setdefault(
+                        stage, {"to_host_bytes": 0, "to_device_bytes": 0,
+                                "calls": 0},
+                    )
+                    st[key] += nbytes
+                    st["calls"] += 1
+                if bound is not None:
+                    bd = self.by_boundary.setdefault(
+                        bound, {"to_host_bytes": 0, "to_device_bytes": 0,
+                                "calls": 0},
+                    )
+                    bd[key] += nbytes
+                    bd["calls"] += 1
+                if len(self.events) < self.event_cap:
+                    self.events.append({
+                        "direction": direction,
+                        "nbytes": int(nbytes),
+                        "implicit": bool(implicit),
+                        "api": api,
+                        "span": span,
+                        "stage": stage,
+                        "boundary": bound,
+                        "where": where,
+                    })
+                else:
+                    self.events_dropped += 1
+            if self.mode == "enforce" and bound is None:
+                bad = (direction == "d2h"
+                       or nbytes >= self.enforce_h2d_bytes)
+                if bad:
+                    v = {"direction": direction, "nbytes": int(nbytes),
+                         "api": api, "span": span, "stage": stage,
+                         "where": where}
+                    with self._lock:
+                        self.violations.append(v)
+                    raise ResidencyError(
+                        f"residency violation: {direction} transfer of "
+                        f"{nbytes} bytes via {api} in span "
+                        f"{span or '<no-span>'} (stage "
+                        f"{stage or '<none>'}) at {where} matches no "
+                        "declared boundary — wrap the crossing in "
+                        "obs.residency.boundary(<name>) with an in-code "
+                        "justification, or keep the data on device"
+                    )
+        finally:
+            _CPU["s"] += time.perf_counter() - t0
+
+    # -- patches ------------------------------------------------------------
+    def _patch(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        aud = self
+        orig = self._orig
+        orig["np_asarray"] = np.asarray
+        orig["np_array"] = np.array
+        orig["jnp_asarray"] = jnp.asarray
+        orig["jnp_array"] = jnp.array
+        orig["device_put"] = jax.device_put
+        orig["device_get"] = jax.device_get
+
+        # Recording happens AFTER the delegated call succeeds: a transfer
+        # that raised (device allocation failure, tracer conversion error)
+        # never moved its bytes, and billing it would double-count retry
+        # loops (devcache's alloc-failure retry re-uploads the same
+        # matrix). Enforce mode therefore raises just after the offending
+        # transfer completes — late by one call, but the violation still
+        # fails the run, and a failed transfer can never false-trip.
+
+        def np_asarray(a, *args, **kw):
+            rec = not _nested() and _is_device_array(a)
+            with _delegating():
+                out = orig["np_asarray"](a, *args, **kw)
+            if rec:
+                aud._record("d2h", _nbytes(a), True, "np.asarray")
+            return out
+
+        def np_array(a, *args, **kw):
+            rec = not _nested() and _is_device_array(a)
+            with _delegating():
+                out = orig["np_array"](a, *args, **kw)
+            if rec:
+                aud._record("d2h", _nbytes(a), True, "np.array")
+            return out
+
+        def jnp_asarray(a, *args, **kw):
+            # host ndarray staging only: device inputs are no-op views and
+            # scalars/lists stage O(bytes) constants the guard covers
+            rec = not _nested() and isinstance(a, np.ndarray)
+            with _delegating():
+                out = orig["jnp_asarray"](a, *args, **kw)
+            if rec:
+                aud._record("h2d", int(a.nbytes), True, "jnp.asarray")
+            return out
+
+        def jnp_array(a, *args, **kw):
+            rec = not _nested() and isinstance(a, np.ndarray)
+            with _delegating():
+                out = orig["jnp_array"](a, *args, **kw)
+            if rec:
+                aud._record("h2d", int(a.nbytes), True, "jnp.array")
+            return out
+
+        def device_put(x, *args, **kw):
+            rec = not _nested() and not _tree_has_device(x)
+            with _delegating():
+                out = orig["device_put"](x, *args, **kw)
+            if rec:
+                aud._record("h2d", _nbytes(x), False, "jax.device_put")
+            return out
+
+        def device_get(x, *args, **kw):
+            rec = not _nested() and _tree_has_device(x)
+            with _delegating():
+                out = orig["device_get"](x, *args, **kw)
+            if rec:
+                aud._record("d2h", _nbytes(x), False, "jax.device_get")
+            return out
+
+        np.asarray = np_asarray
+        np.array = np_array
+        jnp.asarray = jnp_asarray
+        jnp.array = jnp_array
+        jax.device_put = device_put
+        jax.device_get = device_get
+
+    def _unpatch(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        orig = self._orig
+        if not orig:
+            return
+        np.asarray = orig["np_asarray"]
+        np.array = orig["np_array"]
+        jnp.asarray = orig["jnp_asarray"]
+        jnp.array = orig["jnp_array"]
+        jax.device_put = orig["device_put"]
+        jax.device_get = orig["device_get"]
+        self._orig = {}
+
+    # -- context ------------------------------------------------------------
+    def __enter__(self) -> "ResidencyAuditor":
+        global _ACTIVE
+        if self.mode == "off":
+            return self
+        with _LOCK:
+            if _ACTIVE is not None:
+                raise RuntimeError(
+                    "a ResidencyAuditor is already active in this process"
+                )
+            _ACTIVE = self
+        self._stack = ExitStack()
+        try:
+            self._patch()
+            self._stack.callback(self._unpatch)
+            if self.mode == "enforce":
+                import jax
+
+                # the backstop for C-level paths the patches cannot see;
+                # CPU's zero-copy d2h never fires it, accelerators do
+                self._stack.enter_context(
+                    jax.transfer_guard_device_to_host("disallow")
+                )
+        except BaseException:
+            self._stack.close()
+            with _LOCK:
+                _ACTIVE = None
+            raise
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        if self.mode == "off":
+            return
+        try:
+            if self._stack is not None:
+                self._stack.close()
+        finally:
+            self._stack = None
+            with _LOCK:
+                if _ACTIVE is self:
+                    _ACTIVE = None
+
+    # -- the run-record section ---------------------------------------------
+    def report(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "mode": self.mode,
+                "to_device": {"calls": self.to_device_calls,
+                              "bytes": self.to_device_bytes},
+                "to_host": {"calls": self.to_host_calls,
+                            "bytes": self.to_host_bytes},
+                "by_stage": {k: dict(v) for k, v in self.by_stage.items()},
+                "by_boundary": {
+                    k: dict(v) for k, v in self.by_boundary.items()
+                },
+                "events": [dict(e) for e in self.events],
+                "events_dropped": self.events_dropped,
+                "violations": [dict(v) for v in self.violations],
+            }
+
+
+@contextmanager
+def audit_region(auditor: "Optional[ResidencyAuditor]"):
+    """Run a region under ``auditor`` (None = passthrough), converting a
+    backstop ``jax.transfer_guard`` error into a span-named
+    :class:`ResidencyError` — XLA's message has no idea what a tracer
+    span is, and the last finished span is the best attribution an
+    unwound stack still holds."""
+    if auditor is None:
+        yield None
+        return
+    try:
+        with auditor:
+            yield auditor
+    except ResidencyError:
+        raise
+    except Exception as e:
+        if "Disallowed" in str(e) and "transfer" in str(e):
+            last = None
+            try:
+                from scconsensus_tpu.obs.trace import last_tracer
+
+                tr = last_tracer()
+                if tr is not None and tr.spans:
+                    last = tr.spans[-1].name
+            except Exception:
+                pass
+            raise ResidencyError(
+                "residency violation caught by jax.transfer_guard "
+                f"(implicit transfer outside any declared boundary); "
+                f"last finished span: {last or '<unknown>'}; guard said: "
+                f"{str(e)[:300]}"
+            ) from e
+        raise
+
+
+# --------------------------------------------------------------------------
+# section helpers + validation
+# --------------------------------------------------------------------------
+
+def stage_transfer_bytes(rec: Dict[str, Any]) -> Dict[str, int]:
+    """Total (both directions) transfer bytes per stage from a record's
+    ``residency`` section — the quantity the perf gate baselines, mirror
+    of ``ledger.stage_walls``. Empty when no audit ran."""
+    res = rec.get("residency")
+    if not isinstance(res, dict):
+        return {}
+    out: Dict[str, int] = {}
+    for stage, d in (res.get("by_stage") or {}).items():
+        if isinstance(d, dict):
+            out[str(stage)] = int(d.get("to_host_bytes") or 0) + int(
+                d.get("to_device_bytes") or 0
+            )
+    return out
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(f"residency section: {msg}")
+
+
+def validate_residency(res: Dict[str, Any]) -> None:
+    """Structural validation of a record's ``residency`` section (additive
+    scc-run-record v1 extension; ``export.validate_run_record`` calls
+    this)."""
+    _require(isinstance(res, dict), "must be an object")
+    _require(res.get("mode") in ("audit", "enforce"),
+             f"mode must be audit|enforce, got {res.get('mode')!r}")
+    for side in ("to_device", "to_host"):
+        d = res.get(side)
+        _require(isinstance(d, dict), f"{side} must be an object")
+        for k in ("calls", "bytes"):
+            v = d.get(k)
+            _require(isinstance(v, int) and v >= 0,
+                     f"{side}.{k} must be an int >= 0")
+    for agg in ("by_stage", "by_boundary"):
+        d = res.get(agg, {})
+        _require(isinstance(d, dict), f"{agg} must be an object")
+        for name, sd in d.items():
+            _require(isinstance(sd, dict), f"{agg}[{name!r}] not an object")
+            for k in ("to_host_bytes", "to_device_bytes", "calls"):
+                v = sd.get(k, 0)
+                _require(isinstance(v, int) and v >= 0,
+                         f"{agg}[{name!r}].{k} must be an int >= 0")
+    for b in res.get("by_boundary", {}):
+        _require(b in BOUNDARIES,
+                 f"by_boundary names undeclared boundary {b!r}")
+    events = res.get("events", [])
+    _require(isinstance(events, list), "events must be a list")
+    for i, e in enumerate(events):
+        _require(isinstance(e, dict), f"events[{i}] is not an object")
+        _require(e.get("direction") in ("h2d", "d2h"),
+                 f"events[{i}].direction must be h2d|d2h")
+        nb = e.get("nbytes")
+        _require(isinstance(nb, int) and nb >= 0,
+                 f"events[{i}].nbytes must be an int >= 0")
+        bd = e.get("boundary")
+        _require(bd is None or bd in BOUNDARIES,
+                 f"events[{i}] names undeclared boundary {bd!r}")
+    _require(isinstance(res.get("violations", []), list),
+             "violations must be a list")

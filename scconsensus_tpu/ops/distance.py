@@ -63,10 +63,17 @@ def distance_row_blocks(
 ) -> Iterator[Tuple[int, int, np.ndarray]]:
     """Stream (start, stop, D[start:stop, :]) euclidean row-blocks of the
     distance matrix without materializing N×N on host at once."""
-    jx = jnp.asarray(x)
+    from scconsensus_tpu.obs.residency import boundary
+
+    with boundary("silhouette_slab_fetch"):
+        jx = jnp.asarray(x)
     n = x.shape[0]
     for s in range(0, n, block):
         e = min(s + block, n)
-        d = np.array(jnp.sqrt(_sq_dists(jx[s:e], jx)))  # writable host copy
+        with boundary("silhouette_slab_fetch"):
+            # declared crossing (TODO(item-2)): host consumers stream the
+            # slab today; the device-resident graph keeps the reduction on
+            # device
+            d = np.array(jnp.sqrt(_sq_dists(jx[s:e], jx)))
         d[np.arange(e - s), np.arange(s, e)] = 0.0  # exact zero self-distance
         yield s, e, d

@@ -158,7 +158,12 @@ def distance_cluster_sums(
             jnp.asarray(xp), jnp.asarray(ohp),
             interpret=(backend == "pallas_interpret"),
         )[:n, :k]
-        return out if device_out else np.asarray(out)
+        if device_out:
+            return out
+        from scconsensus_tpu.obs.residency import boundary
+
+        with boundary("silhouette_slab_fetch"):  # declared (N, K) fetch
+            return np.asarray(out)
 
     if backend == "xla":
         jx = jnp.asarray(x)
@@ -171,7 +176,12 @@ def distance_cluster_sums(
             for s in range(0, n, block)
         ]
         out = jnp.concatenate(parts, axis=0)
-        return out if device_out else np.asarray(out)
+        if device_out:
+            return out
+        from scconsensus_tpu.obs.residency import boundary
+
+        with boundary("silhouette_slab_fetch"):  # declared (N, K) fetch
+            return np.asarray(out)
 
     raise ValueError(f"unknown backend {backend!r}")
 

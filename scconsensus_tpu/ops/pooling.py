@@ -91,9 +91,13 @@ def kmeans_pool(
     m = min(n_centroids, n)
     rng = np.random.default_rng(seed)
     init = x[rng.choice(n, size=m, replace=False)]
-    cent, assign = _lloyd(jnp.asarray(x, jnp.float32), jnp.asarray(init, jnp.float32), n_iter=n_iter)
-    cent = np.asarray(cent, np.float64)
-    assign = np.asarray(assign)
+    from scconsensus_tpu.obs.residency import boundary
+
+    with boundary("tree_pool_fetch"):
+        cent, assign = _lloyd(jnp.asarray(x, jnp.float32),
+                              jnp.asarray(init, jnp.float32), n_iter=n_iter)
+        cent = np.asarray(cent, np.float64)
+        assign = np.asarray(assign)
     used = np.unique(assign)
     remap = -np.ones(m, np.int64)
     remap[used] = np.arange(used.size)

@@ -83,6 +83,8 @@ def device_put_cached(x: np.ndarray):
     if is_jax(x):
         return x  # already device-resident: nothing to upload or verify
 
+    from scconsensus_tpu.obs.residency import boundary
+
     key = id(x)
     sample = _sample_hash(x)
     ent = _cache.get(key)
@@ -99,12 +101,13 @@ def device_put_cached(x: np.ndarray):
             if same:
                 return ent.buf
         _cache.pop(key, None)  # freed id reuse or in-place mutation
-    try:
-        buf = jnp.asarray(x)
-    except Exception:
-        # device allocation failure: drop every pinned buffer, retry once
-        _cache.clear()
-        buf = jnp.asarray(x)
+    with boundary("input_staging"):  # THE intended matrix upload
+        try:
+            buf = jnp.asarray(x)
+        except Exception:
+            # device allocation failure: drop every pinned buffer, retry
+            _cache.clear()
+            buf = jnp.asarray(x)
     try:
         ref = weakref.ref(x, lambda _r, _k=key: _cache.pop(_k, None))
     except TypeError:

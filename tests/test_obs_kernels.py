@@ -1,0 +1,217 @@
+"""Device-kernel timeline (obs.kernels): trace parsing, span joins, the
+cost-model comparison, and a live capture smoke on the CPU backend."""
+
+import gzip
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from scconsensus_tpu.obs.kernels import (
+    KernelCapture,
+    annotation_windows,
+    device_op_events,
+    join_kernels_to_spans,
+    kernels_section,
+    validate_kernels,
+)
+
+# Synthetic profiler trace: two stages' annotation windows on the python
+# thread, three device-op events (one inside a detail window nested in a
+# stage window), one pure `call` wrapper that must be dropped, and python
+# noise events that must be ignored.
+FIXTURE_TRACE = {
+    "traceEvents": [
+        {"ph": "M", "pid": 7, "tid": 1, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "stage_a",
+         "ts": 1000.0, "dur": 5000.0},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "inner_detail",
+         "ts": 2000.0, "dur": 1000.0},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "stage_b",
+         "ts": 7000.0, "dur": 3000.0},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "$builtins isinstance",
+         "ts": 1100.0, "dur": 1.0},
+        # device ops (hlo_op-stamped)
+        {"ph": "X", "pid": 7, "tid": 9, "name": "dot.1", "ts": 1500.0,
+         "dur": 400.0, "args": {"hlo_module": "jit_mm", "hlo_op": "dot.1"}},
+        {"ph": "X", "pid": 7, "tid": 9, "name": "fusion.2", "ts": 2100.0,
+         "dur": 200.0,
+         "args": {"hlo_module": "jit_mm", "hlo_op": "fusion.2"}},
+        {"ph": "X", "pid": 7, "tid": 9, "name": "dot.1", "ts": 7500.0,
+         "dur": 100.0, "args": {"hlo_module": "jit_mm", "hlo_op": "dot.1"}},
+        # wrapper op: must NOT count (would double-bill fusion.2)
+        {"ph": "X", "pid": 7, "tid": 9, "name": "call", "ts": 2050.0,
+         "dur": 300.0, "args": {"hlo_module": "jit_mm", "hlo_op": "call"}},
+        # op outside every window: span/stage attribution must be None
+        {"ph": "X", "pid": 7, "tid": 9, "name": "copy.9", "ts": 50000.0,
+         "dur": 10.0, "args": {"hlo_module": "jit_x", "hlo_op": "copy.9"}},
+    ],
+}
+
+SPAN_RECORDS = [
+    {"name": "stage_a", "kind": "stage"},
+    {"name": "inner_detail", "kind": "detail"},
+    {"name": "stage_b", "kind": "stage"},
+]
+
+
+class TestTraceParsing:
+    def test_device_op_events_extracts_and_drops_wrappers(self):
+        evs = device_op_events(FIXTURE_TRACE)
+        names = sorted(e["name"] for e in evs)
+        assert names == ["copy.9", "dot.1", "dot.1", "fusion.2"]
+        assert all("call" != e["name"] for e in evs)
+
+    def test_annotation_windows_match_span_names_only(self):
+        wins = annotation_windows(
+            FIXTURE_TRACE, {"stage_a", "stage_b", "inner_detail"}
+        )
+        assert sorted(w["span"] for w in wins) == [
+            "inner_detail", "stage_a", "stage_b",
+        ]
+
+    def test_join_innermost_span_and_covering_stage(self):
+        evs = device_op_events(FIXTURE_TRACE)
+        wins = annotation_windows(
+            FIXTURE_TRACE, {s["name"] for s in SPAN_RECORDS}
+        )
+        join_kernels_to_spans(evs, wins,
+                              stage_names={"stage_a", "stage_b"})
+        by = {(e["name"], e["ts_us"]): e for e in evs}
+        # fusion.2 sits inside inner_detail (innermost) AND stage_a
+        assert by[("fusion.2", 2100.0)]["span"] == "inner_detail"
+        assert by[("fusion.2", 2100.0)]["stage"] == "stage_a"
+        assert by[("dot.1", 1500.0)]["span"] == "stage_a"
+        assert by[("dot.1", 7500.0)]["stage"] == "stage_b"
+        assert by[("copy.9", 50000.0)]["span"] is None
+        assert by[("copy.9", 50000.0)]["stage"] is None
+
+
+class TestKernelsSection:
+    def test_section_topk_and_spans(self):
+        sec = kernels_section(FIXTURE_TRACE, SPAN_RECORDS)
+        assert sec["n_events"] == 4
+        assert sec["n_kernels"] == 3
+        top = sec["top"]
+        assert top[0]["kernel"] == "dot.1"  # 500us total across 2 events
+        assert top[0]["count"] == 2
+        assert top[0]["device_time_s"] == pytest.approx(500e-6)
+        assert sec["by_span_device_s"]["inner_detail"] == pytest.approx(
+            200e-6
+        )
+        validate_kernels(sec)
+
+    def test_vs_cost_model_uses_stage_device_time(self):
+        # fusion.2 ran inside inner_detail but must bill to stage_a's
+        # device time for the cost comparison
+        sec = kernels_section(
+            FIXTURE_TRACE, SPAN_RECORDS,
+            stage_cost={"stage_a": {"flops": 6e6, "bytes_accessed": 1.2e6,
+                                    "wall_s": 2.0}},
+        )
+        row = sec["vs_cost_model"]["stage_a"]
+        assert row["device_time_s"] == pytest.approx(600e-6)  # 400+200 µs
+        assert row["achieved_gflops_device"] == pytest.approx(
+            6e6 / 600e-6 / 1e9, rel=1e-3
+        )
+        validate_kernels(sec)
+
+    def test_topk_truncates(self):
+        sec = kernels_section(FIXTURE_TRACE, SPAN_RECORDS, top_k=1)
+        assert len(sec["top"]) == 1
+        assert sec["n_kernels"] == 3  # totals still cover everything
+
+
+class TestValidation:
+    def test_empty_section_validates(self):
+        validate_kernels({"n_events": 0, "total_device_time_s": 0.0,
+                          "top": []})
+
+    def test_negative_time_rejected(self):
+        with pytest.raises(ValueError, match="total_device_time_s"):
+            validate_kernels({"n_events": 0,
+                              "total_device_time_s": -1.0, "top": []})
+
+    def test_bad_top_entry_rejected(self):
+        with pytest.raises(ValueError, match="kernel"):
+            validate_kernels({
+                "n_events": 1, "total_device_time_s": 0.1,
+                "top": [{"kernel": "", "device_time_s": 0.1, "count": 1}],
+            })
+
+
+class TestExplainRunRender:
+    def test_kernels_section_renders_in_report(self):
+        import pathlib
+        import subprocess
+        import sys
+
+        repo = pathlib.Path(__file__).resolve().parents[1]
+        fix = repo / "tests" / "fixtures" / "perf_gate"
+        proc = subprocess.run(
+            [sys.executable, str(repo / "tools" / "explain_run.py"),
+             str(fix / "candidate_clean.json"),
+             "--evidence", str(fix / "evidence")],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        out = proc.stdout
+        assert "## Device-kernel timeline" in out
+        assert "jit_ranksum_body" in out
+        assert "GFLOP/s (dev)" in out  # roofline-style vs-cost table
+
+
+class TestLiveCapture:
+    def test_capture_window_produces_section(self, tmp_path):
+        """End-to-end on the CPU backend: the profiler trace parses and
+        device ops appear with hlo_op stamps. Best-effort contract: a
+        backend writing no ops still yields a schema-valid section."""
+        from scconsensus_tpu.obs.trace import Tracer
+
+        tr = Tracer(sync="off", annotate=True)
+        with KernelCapture(str(tmp_path / "cap")) as cap:
+            with tr.span("cap_stage", kind="stage"):
+                x = jnp.ones((256, 256))
+                (x @ x).block_until_ready()
+        sec = cap.section(span_records=tr.span_records())
+        assert sec is not None
+        validate_kernels(sec)
+        assert sec.get("error") is None, sec
+        assert sec["n_events"] > 0
+        # the matmul's dot kernel is in the top list, joined to the span
+        assert any("dot" in a["kernel"] or "fusion" in a["kernel"]
+                   for a in sec["top"])
+        assert "cap_stage" in (sec.get("by_span_device_s") or {})
+
+    def test_disabled_capture_returns_none(self):
+        cap = KernelCapture(None)
+        with cap:
+            pass
+        assert cap.section() is None
+
+    def test_unwritable_capture_is_not_fatal(self, tmp_path, monkeypatch):
+        """A wedged/unavailable profiler records an error section, never
+        raises out of the workload."""
+        import jax.profiler as jp
+
+        def boom(*a, **kw):
+            raise RuntimeError("profiler busy")
+
+        monkeypatch.setattr(jp, "start_trace", boom)
+        with KernelCapture(str(tmp_path / "cap2")) as cap:
+            pass
+        sec = cap.section()
+        assert sec["n_events"] == 0
+        assert "start_trace failed" in sec["error"]
+        validate_kernels(sec)
+
+    def test_parse_gz_roundtrip(self, tmp_path):
+        from scconsensus_tpu.obs.kernels import parse_trace_file
+
+        p = tmp_path / "t.trace.json.gz"
+        with gzip.open(p, "wb") as f:
+            f.write(json.dumps(FIXTURE_TRACE).encode())
+        assert parse_trace_file(str(p))["traceEvents"]

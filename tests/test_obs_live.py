@@ -410,6 +410,37 @@ class TestTailRunRender:
         panel = tail_run.render([{"t": "header", "ts": 1.0, "metric": "x"}])
         assert "no heartbeat yet" in panel
 
+    def test_render_transfer_rate_from_consecutive_ticks(self):
+        """The residency live panel: cumulative counters on the hb lines
+        difference into a byte rate — (1_000_000 + 1_000_000) bytes over
+        10 s = 200000 B/s ≈ 195.3KiB/s."""
+        sys.path.insert(0, str(REPO / "tools"))
+        import tail_run
+
+        hb = {"t": "hb", "seq": 0, "ts": 100.0, "up_s": 10.0,
+              "open_spans": [], "spans_done": 1, "stalls": 0,
+              "transfers": {"to_device_bytes": 5_000_000,
+                            "to_host_bytes": 1_000_000, "events": 10}}
+        hb2 = dict(hb, seq=1, ts=110.0, up_s=20.0,
+                   transfers={"to_device_bytes": 6_000_000,
+                              "to_host_bytes": 2_000_000, "events": 14})
+        panel = tail_run.render(
+            [{"t": "header", "ts": 90.0, "metric": "x"}, hb, hb2]
+        )
+        assert "transfers:" in panel
+        assert "d2h 1.9MiB" in panel
+        assert "rate 195.3KiB/s" in panel
+
+    def test_render_fixture_stream_shows_transfers(self):
+        sys.path.insert(0, str(REPO / "tools"))
+        import tail_run
+
+        lines = tail_run.read_stream(
+            str(HB_FIXTURES / "sample_heartbeat.jsonl"))
+        panel = tail_run.render(lines)
+        assert "transfers: h2d 1.5GiB" in panel
+        assert "rate " in panel
+
 
 # --------------------------------------------------------------------------
 # profiler capture window (SIGUSR1's main-thread toggle)

@@ -68,6 +68,26 @@ class TestShardedPathsStayOnDevice:
         assert log_p.shape == (B, G)
         assert np.isfinite(log_p).any()
 
+    def test_implicit_np_asarray_is_the_documented_blind_spot(self):
+        """TransferWatch wraps only the explicit device_put/device_get
+        entry points — ``np.asarray`` on a device array bypasses both and
+        goes uncounted (its docstring says so). The residency auditor
+        (obs.residency) exists to close exactly this gap: same call, same
+        scope, recorded with direction, bytes, and source site."""
+        from scconsensus_tpu.obs.residency import ResidencyAuditor
+
+        x = jnp.arange(512.0)
+        with TransferWatch() as w:
+            np.asarray(x)
+        assert w.to_host_calls == 0 and w.to_host_bytes == 0
+        with ResidencyAuditor(mode="audit") as a:
+            np.asarray(x)
+        rep = a.report()
+        assert rep["to_host"] == {"calls": 1, "bytes": 512 * 4}
+        ev = rep["events"][0]
+        assert ev["implicit"] and ev["api"] == "np.asarray"
+        assert ev["where"].startswith("test_obs_transfers.py:")
+
     def test_refine_env_flag_reports_clean_transfers(self, monkeypatch):
         """SCC_OBS_TRANSFERS=1 end-to-end: the pipeline's transfer report
         rides the result metrics with zero oversized host fetches on a

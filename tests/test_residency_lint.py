@@ -1,0 +1,137 @@
+"""Static residency lint: new host round-trips in hot-path modules fail
+review before they ever run.
+
+The dynamic auditor (obs.residency) catches transfers at runtime on the
+paths a test happens to execute; this lint is the static half of the same
+contract. It greps ``scconsensus_tpu/{de,ops,models,parallel}`` for the
+four host-crossing call forms the auditor patches — ``np.asarray(``,
+``np.array(``, ``jax.device_get``, ``.block_until_ready(`` — and
+ratchets each (file, pattern) count against the frozen baseline below.
+
+The baseline is an APPROVED-SHIM list, not an aspiration: every counted
+site is either a declared residency boundary (obs.residency.BOUNDARIES,
+several marked TODO(item-2)) or host-side code operating on host arrays.
+Policy:
+
+  * count ABOVE baseline → this test fails: either keep the data on
+    device, or wrap an intentional crossing in
+    ``obs.residency.boundary(...)`` AND consciously bump the number here
+    (the diff is the review flag);
+  * count BELOW baseline → the device-resident-graph refactor removed a
+    crossing: ratchet the number DOWN here in the same commit so it
+    cannot creep back.
+"""
+
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+PKG = REPO / "scconsensus_tpu"
+
+HOT_SUBPACKAGES = ("de", "ops", "models", "parallel")
+
+PATTERNS = {
+    "np.asarray(": re.compile(r"np\.asarray\("),
+    "np.array(": re.compile(r"np\.array\("),
+    "jax.device_get": re.compile(
+        r"jax\.device_get|from jax import device_get"
+    ),
+    ".block_until_ready(": re.compile(r"\.block_until_ready\("),
+}
+
+# Frozen (file, pattern) -> count baseline. See module docstring for the
+# ratchet policy. Regenerate a candidate table with:
+#   python -c "import tests.test_residency_lint as t; t.print_counts()"
+APPROVED = {
+    "de/edger.py": {"np.asarray(": 41, "np.array(": 3},
+    "de/edger_direct.py": {"np.asarray(": 27},
+    "de/engine.py": {"np.asarray(": 49, "np.array(": 7,
+                     "jax.device_get": 9, ".block_until_ready(": 4},
+    "ops/colors.py": {"np.asarray(": 1},
+    "ops/distance.py": {"np.asarray(": 1, "np.array(": 1},
+    "ops/knn_linkage.py": {"np.asarray(": 1},
+    "ops/multipletests.py": {"np.asarray(": 1},
+    "ops/negbin.py": {"np.asarray(": 2},
+    "ops/pallas_kernels.py": {"np.asarray(": 6},
+    "ops/pooling.py": {"np.asarray(": 4},
+    "ops/silhouette.py": {"np.asarray(": 7},
+    "ops/treecut.py": {"np.asarray(": 2},
+    "ops/treecut_direct.py": {"np.asarray(": 3},
+    "ops/wilcoxon.py": {"np.asarray(": 1},
+    "models/pipeline.py": {"np.asarray(": 7, "np.array(": 1},
+    "parallel/mesh.py": {"np.asarray(": 3, ".block_until_ready(": 1},
+    "parallel/ring.py": {"np.asarray(": 11},
+    "parallel/sharded_de.py": {"np.asarray(": 8, "jax.device_get": 2},
+}
+
+
+def current_counts():
+    out = {}
+    for sub in HOT_SUBPACKAGES:
+        for p in sorted((PKG / sub).rglob("*.py")):
+            text = p.read_text()
+            counts = {
+                name: len(rx.findall(text))
+                for name, rx in PATTERNS.items()
+            }
+            counts = {k: v for k, v in counts.items() if v}
+            if counts:
+                out[p.relative_to(PKG).as_posix()] = counts
+    return out
+
+
+def print_counts():  # pragma: no cover - maintenance helper
+    import json
+
+    print(json.dumps(current_counts(), indent=1))
+
+
+class TestResidencyLint:
+    def test_no_new_host_roundtrip_call_sites(self):
+        """Increase-only ratchet: any (file, pattern) count above the
+        approved baseline is a new potential host round-trip in a
+        hot-path module."""
+        violations = []
+        for f, counts in current_counts().items():
+            approved = APPROVED.get(f, {})
+            for pattern, n in counts.items():
+                cap = approved.get(pattern, 0)
+                if n > cap:
+                    violations.append(
+                        f"{f}: {n}x `{pattern}` (approved {cap})"
+                    )
+        assert not violations, (
+            "new host-crossing call sites in hot-path modules — keep the "
+            "data on device, or wrap a justified crossing in "
+            "obs.residency.boundary(...) and bump APPROVED in "
+            "tests/test_residency_lint.py:\n  " + "\n  ".join(violations)
+        )
+
+    def test_baseline_has_no_ghost_entries(self):
+        """Every approved entry still corresponds to real code — a file
+        or pattern that disappeared must be ratcheted out, not left as
+        headroom new crossings could hide in."""
+        cur = current_counts()
+        stale = []
+        for f, counts in APPROVED.items():
+            actual = cur.get(f, {})
+            for pattern, cap in counts.items():
+                if actual.get(pattern, 0) < cap:
+                    stale.append(
+                        f"{f}: approved {cap}x `{pattern}`, found "
+                        f"{actual.get(pattern, 0)} — ratchet the baseline "
+                        "down"
+                    )
+        assert not stale, "\n".join(stale)
+
+    def test_lint_patterns_match_the_auditor_surface(self):
+        """The static patterns and the dynamic auditor must cover one
+        surface: every patched call form is linted."""
+        from scconsensus_tpu.obs import residency  # noqa: F401
+
+        source = (PKG / "obs" / "residency.py").read_text()
+        for api in ("np.asarray", "np.array", "jax.device_put",
+                    "jax.device_get", "jnp.asarray", "jnp.array"):
+            assert f'"{api}"' in source, (
+                f"auditor no longer records api {api!r}; realign the lint"
+            )

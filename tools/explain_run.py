@@ -7,9 +7,12 @@ The ledger answers "where did the time go"; the quality section answers
 reviewer reads instead of raw JSON: stage walls against the key's
 noise-banded baselines, the DE gate funnel (aggregate + worst pairs),
 rank-sum ladder occupancy, cluster structure (sizes, silhouette, ARI,
-churn), numeric-health sentinel trips, and the numeric fingerprint with
-its drift status (against NUMERIC_PINS.json when the dataset is pinned,
-else against the key's previous clean run).
+churn), the residency audit (per-stage/per-boundary transfer tables,
+worst individual transfers, enforce-mode violations), the device-kernel
+timeline (top-K kernels by device time + achieved device-time rates vs
+the cost model), numeric-health sentinel trips, and the numeric
+fingerprint with its drift status (against NUMERIC_PINS.json when the
+dataset is pinned, else against the key's previous clean run).
 
 Usage:
   python tools/explain_run.py RECORD.json                # one report
@@ -232,6 +235,109 @@ def cluster_table(quality: Dict[str, Any]) -> List[str]:
     return out
 
 
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "–"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return "–"
+
+
+def residency_table(rec: Dict[str, Any]) -> List[str]:
+    res = rec.get("residency")
+    if not res:
+        return []
+    out = ["## Residency (host↔device transfers)", "",
+           f"Audit mode: `{res.get('mode')}`"]
+    td, th = res.get("to_device") or {}, res.get("to_host") or {}
+    out.append(f"- host→device: {_fmt_bytes(td.get('bytes'))} over "
+               f"{td.get('calls', 0)} calls; device→host: "
+               f"{_fmt_bytes(th.get('bytes'))} over "
+               f"{th.get('calls', 0)} calls"
+               + (f" ({res.get('events_dropped')} events past the cap "
+                  "not itemized)" if res.get("events_dropped") else ""))
+    viols = res.get("violations") or []
+    if viols:
+        out += ["", f"**{len(viols)} enforce-mode violation(s):**"]
+        for v in viols:
+            out.append(f"- {v.get('direction')} "
+                       f"{_fmt_bytes(v.get('nbytes'))} via "
+                       f"`{v.get('api')}` in span `{v.get('span')}` "
+                       f"at `{v.get('where')}`")
+    by_stage = res.get("by_stage") or {}
+    if by_stage:
+        out += ["", "| stage | d2h | h2d | calls |",
+                "|---|---:|---:|---:|"]
+        ranked = sorted(
+            by_stage.items(),
+            key=lambda kv: -(kv[1].get("to_host_bytes", 0)
+                             + kv[1].get("to_device_bytes", 0)),
+        )
+        for stage, d in ranked:
+            out.append(f"| {stage} | {_fmt_bytes(d.get('to_host_bytes'))} "
+                       f"| {_fmt_bytes(d.get('to_device_bytes'))} "
+                       f"| {d.get('calls', 0)} |")
+    by_bound = res.get("by_boundary") or {}
+    if by_bound:
+        out += ["", "Declared boundary crossings:", "",
+                "| boundary | d2h | h2d | calls |", "|---|---:|---:|---:|"]
+        for name, d in sorted(by_bound.items()):
+            out.append(f"| {name} | {_fmt_bytes(d.get('to_host_bytes'))} "
+                       f"| {_fmt_bytes(d.get('to_device_bytes'))} "
+                       f"| {d.get('calls', 0)} |")
+    # worst individual transfers, span-attributed
+    events = sorted(res.get("events") or [],
+                    key=lambda e: -e.get("nbytes", 0))[:5]
+    if events:
+        out += ["", "Largest transfers:"]
+        for e in events:
+            out.append(f"- {e.get('direction')} "
+                       f"{_fmt_bytes(e.get('nbytes'))} via "
+                       f"`{e.get('api')}` in span `{e.get('span')}` "
+                       f"(boundary {e.get('boundary') or '—'}, "
+                       f"`{e.get('where')}`)")
+    return out
+
+
+def kernels_table(rec: Dict[str, Any]) -> List[str]:
+    sec = rec.get("kernels")
+    if not sec:
+        return []
+    out = ["## Device-kernel timeline", ""]
+    if sec.get("error"):
+        out.append(f"Capture attempted but degraded: `{sec['error']}`")
+        return out
+    out.append(f"{sec.get('n_events')} device-op events, "
+               f"{sec.get('n_kernels')} distinct kernels, "
+               f"{_fmt(sec.get('total_device_time_s'))}s total device "
+               "time")
+    top = sec.get("top") or []
+    if top:
+        out += ["", "| kernel | module | device s | count | % | span |",
+                "|---|---|---:|---:|---:|---|"]
+        for a in top:
+            out.append(f"| `{a.get('kernel')}` | {a.get('hlo_module')} "
+                       f"| {_fmt(a.get('device_time_s'), 4)} "
+                       f"| {a.get('count')} | {_fmt(a.get('pct'), 1)} "
+                       f"| {a.get('span') or '—'} |")
+    vc = sec.get("vs_cost_model") or {}
+    if vc:
+        out += ["", "Achieved rates over DEVICE time vs the cost model "
+                "(the roofline-style denominator; wall-based rates "
+                "understate whenever the host is the bottleneck):", "",
+                "| stage | device s | wall s | GFLOP/s (dev) | GB/s (dev) |",
+                "|---|---:|---:|---:|---:|"]
+        for stage, row in sorted(vc.items()):
+            out.append(f"| {stage} | {_fmt(row.get('device_time_s'), 4)} "
+                       f"| {_fmt(row.get('wall_s'))} "
+                       f"| {_fmt(row.get('achieved_gflops_device'))} "
+                       f"| {_fmt(row.get('achieved_gbps_device'))} |")
+    return out
+
+
 def health_section(quality: Dict[str, Any]) -> List[str]:
     nh = (quality or {}).get("numeric_health")
     if not nh:
@@ -371,6 +477,8 @@ def report(rec: Dict[str, Any], evidence_dir: str) -> str:
     parts.append(funnel_table(quality))
     parts.append(ladder_table(quality))
     parts.append(cluster_table(quality))
+    parts.append(residency_table(rec))
+    parts.append(kernels_table(rec))
     parts.append(health_section(quality))
     parts.append(fingerprint_section(rec, evidence_dir, history))
     if not quality:

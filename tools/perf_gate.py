@@ -6,10 +6,12 @@ Modes:
   perf_gate.py CANDIDATE.json [--evidence DIR] [--json]
       Gate one run record against its key's baselines (median-of-3 with a
       noise band, BASELINE.md policy). Exit 0 = within band, 1 = regressed
-      stage wall or unacknowledged numeric drift, 2 = usage/IO error. A
-      regression names the offending child span (span-tree diff vs the
-      baseline run) and, when XLA cost attribution ran on both sides, the
-      efficiency loss.
+      stage wall, regressed per-stage transfer bytes (residency-audited
+      candidates vs the key's ledger-stamped transfer baselines — same
+      banding machinery), or unacknowledged numeric drift; 2 = usage/IO
+      error. A wall regression names the offending child span (span-tree
+      diff vs the baseline run) and, when XLA cost attribution ran on
+      both sides, the efficiency loss.
 
   perf_gate.py --smoke
       Self-test against the committed fixture ledger
@@ -169,6 +171,14 @@ def _report(verdict: regress.GateVerdict, drifts: List[Dict[str, Any]],
                 line += (f"  efficiency loss "
                          f"{sv.efficiency['efficiency_loss']:.1%}")
             print(line)
+        for tv in verdict.transfers:
+            mark = "REGRESSED" if tv.regressed else "ok"
+            line = (f"  xfer  {tv.stage:<20} {tv.bytes:>12,}B  "
+                    f"baseline {tv.baseline_bytes:,}B "
+                    f"± {tv.band_bytes:,}B  {mark}")
+            if tv.regressed:
+                line += f"  (+{tv.excess_bytes:,}B past band)"
+            print(line)
         for d in drifts:
             state = "acknowledged" if d["acknowledged"] else "UNACKNOWLEDGED"
             src = d.get("pins_source")
@@ -224,6 +234,26 @@ def _smoke(fixtures: str, as_json: bool) -> int:
     except ValueError as e:
         bad_rejected = "funnel" in str(e)
     checks.append(("non-monotone quality funnel rejected", bad_rejected))
+
+    # transfer-bytes gate (obs.residency): the clean candidate's audited
+    # stage bytes sit within the key's transfer baselines; a candidate
+    # whose walls are fine but whose wilcox stage moved far more data
+    # must FAIL on the transfer verdict alone
+    checks.append((
+        "clean candidate's transfer bytes gated within band",
+        bool(verdict.transfers)
+        and not any(t.regressed for t in verdict.transfers),
+    ))
+    verdict_t, _ = run_gate(
+        os.path.join(fixtures, "candidate_transfer_regressed.json"),
+        evidence,
+    )
+    treg = verdict_t.transfer_regressions
+    checks.append((
+        "transfer-regressed candidate fails naming the stage",
+        (not verdict_t.ok) and any(t.stage == "wilcox_test" for t in treg)
+        and not any(s.regressed for s in verdict_t.stages),
+    ))
 
     for label, ok in checks:
         print(f"[smoke] {'ok  ' if ok else 'FAIL'} {label}")

@@ -87,6 +87,9 @@ def main() -> None:
     # workers): a NaN born 40 minutes into a 1M run must land span-
     # attributed on the artifact, not in the labels
     os.environ.setdefault("SCC_OBS_NUMERIC", "1")
+    # residency audit too: at 1M the transfer ledger IS the scale story
+    # (which stages still stream through the host link, and how much)
+    os.environ.setdefault("SCC_OBS_RESIDENCY", "audit")
 
     import jax
 
@@ -196,6 +199,8 @@ def main() -> None:
         vs_baseline=None,  # no reference number exists (BASELINE.md)
         spans=res.metrics.get("spans", []),
         quality=res.metrics.get("quality"),
+        residency=res.metrics.get("residency"),
+        kernels=res.metrics.get("kernels"),
         extra={
             "platform": jax.devices()[0].platform,
             "n_cells": n_cells, "n_genes": n_genes,

@@ -6,7 +6,10 @@ Reads the ``*_heartbeat.jsonl`` stream an obs.live recorder appends to
 status panel: last heartbeat age, uptime, host RSS / device HBM, compile
 stats, the open-span stack with elapsed walls, stall events, a quality
 panel (numeric-sentinel trips + the latest DE-funnel totals, so NaN
-storms and empty funnels are visible live), and — when
+storms and empty funnels are visible live), a transfer panel (cumulative
+host↔device bytes from the residency auditor plus a live byte rate
+differenced from consecutive ticks — a host-round-trip storm shows as
+MB/s mid-run), and — when
 the evidence ledger holds baseline history for the run's key — a
 per-stage ETA from the noise-banded baselines
 (``obs.regress.stage_baselines``). The sibling ``*_partial.json`` record
@@ -86,7 +89,8 @@ def _stream_state(lines: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold the stream into one render state: header ∪ annotations, the
     last heartbeat, the last stall event, and the end stamp if any."""
     st: Dict[str, Any] = {"header": None, "key": None, "hb": None,
-                          "stall": None, "end": None, "extra": {}}
+                          "hb_prev": None, "stall": None, "end": None,
+                          "extra": {}}
     for ln in lines:
         t = ln.get("t")
         if t == "header":
@@ -97,6 +101,7 @@ def _stream_state(lines: List[Dict[str, Any]]) -> Dict[str, Any]:
             st["extra"].update(ln.get("extra") or {})
             st["key"] = ln.get("key") or st["key"]
         elif t == "hb":
+            st["hb_prev"] = st["hb"]
             st["hb"] = ln
         elif t == "stall":
             st["stall"] = ln
@@ -205,6 +210,24 @@ def render(lines: List[Dict[str, Any]],
                 out.append(_span_line(sp, baselines))
         else:
             out.append("  open spans: (none)")
+        xf = hb.get("transfers") or {}
+        if xf:
+            bits = [f"h2d {_fmt_bytes(xf.get('to_device_bytes'))}",
+                    f"d2h {_fmt_bytes(xf.get('to_host_bytes'))}"]
+            prev = st["hb_prev"] or {}
+            pxf = prev.get("transfers") or {}
+            dt = float(hb.get("ts") or 0) - float(prev.get("ts") or 0)
+            if pxf and dt > 0:
+                # live byte rate from consecutive cumulative ticks — a
+                # host-round-trip storm shows as MB/s mid-run
+                rate = (
+                    (xf.get("to_device_bytes") or 0)
+                    + (xf.get("to_host_bytes") or 0)
+                    - (pxf.get("to_device_bytes") or 0)
+                    - (pxf.get("to_host_bytes") or 0)
+                ) / dt
+                bits.append(f"rate {_fmt_bytes(max(rate, 0.0))}/s")
+            out.append("  transfers: " + "   ".join(bits))
         q = hb.get("quality") or {}
         if q:
             bits = []

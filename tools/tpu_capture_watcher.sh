@@ -1,7 +1,9 @@
 #!/bin/bash
-# TPU capture watcher v2: probe the tunnel; when up, run the bench configs in
+# TPU capture watcher v3: probe the tunnel; when up, run the bench configs in
 # priority order (evidence files /root/repo/BENCH_TPU_<cfg>.json), then one
-# phase-profiled flagship run for stage diagnosis. Loops until all captured.
+# phase-profiled flagship run for stage diagnosis, then one residency-audit +
+# kernel-capture flagship run ingested into the evidence ledger (ROADMAP
+# item-3 standing capture). Loops until all captured.
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$REPO_ROOT"
 CFGS="flagship tm100k brain1m pbmc68k cite8k"
@@ -24,6 +26,7 @@ PY
 all_done() {
   for c in $CFGS; do captured "$c" || return 1; done
   [ -f /tmp/tpu_profile_flagship.done ] || return 1
+  [ -f /tmp/tpu_residency_flagship.done ] || return 1
   return 0
 }
 
@@ -71,6 +74,20 @@ except Exception:
       timeout 4000 python bench.py > /tmp/tpu_profile_flagship.out 2>&1 \
         && touch /tmp/tpu_profile_flagship.done
       echo "$(date +%H:%M:%S) DONE profile rc=$?" >> $LOG
+    fi
+    # standing residency + kernel-timeline capture (ROADMAP item-3): one
+    # flagship run on the first healthy probe with the transfer audit on
+    # and a jax.profiler window around the pipeline; SCC_BENCH_NO_FORK
+    # ingests the record (residency + kernels sections) straight into the
+    # evidence ledger, which stamps per-stage transfer bytes for the gate.
+    if captured flagship && [ ! -f /tmp/tpu_residency_flagship.done ]; then
+      echo "$(date +%H:%M:%S) RUN residency+kernels" >> $LOG
+      SCC_BENCH_CONFIG=flagship SCC_BENCH_NO_FORK=1 \
+      SCC_OBS_RESIDENCY=audit SCC_OBS_KERNELS=/tmp/tpu_kernel_capture \
+      SCC_BENCH_CKPT=/tmp/bench_residency_ckpt.json \
+      timeout 4000 python bench.py > /tmp/tpu_residency_flagship.out 2>&1 \
+        && touch /tmp/tpu_residency_flagship.done
+      echo "$(date +%H:%M:%S) DONE residency+kernels rc=$?" >> $LOG
     fi
   fi
   sleep 180

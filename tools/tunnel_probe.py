@@ -84,6 +84,7 @@ def probe_once(mb: float, hb_base: str = "", hang_s: float = 0.0) -> dict:
                 if tracer is not None else nullcontext())
 
     out = {"alive": False}
+    auditor = None
     t0 = time.perf_counter()
     try:
         with _sp("backend_init"):
@@ -96,6 +97,17 @@ def probe_once(mb: float, hb_base: str = "", hang_s: float = 0.0) -> dict:
             dev = jax.devices()[0]
         out["platform"] = dev.platform
         out["init_s"] = round(time.perf_counter() - t0, 2)
+
+        # residency audit over the transfer phases (obs.residency): the
+        # probe's byte accounting rides TUNNEL_LOG so the standing
+        # residency/kernel capture lane (tpu_capture_watcher.sh) can be
+        # sanity-checked against what the tunnel actually moved
+        try:
+            from scconsensus_tpu.obs.residency import ResidencyAuditor
+
+            auditor = ResidencyAuditor(mode="audit").__enter__()
+        except Exception:
+            auditor = None
 
         host = np.ones((int(mb * 1e6 / 4),), np.float32)
         with _sp("upload"):
@@ -120,6 +132,15 @@ def probe_once(mb: float, hb_base: str = "", hang_s: float = 0.0) -> dict:
     except Exception as e:  # fast failures; hangs are killed by the parent
         out["error"] = repr(e)[:300]
     finally:
+        if auditor is not None:
+            try:
+                auditor.__exit__(None, None, None)
+                out["transfers"] = {
+                    "to_device_bytes": auditor.to_device_bytes,
+                    "to_host_bytes": auditor.to_host_bytes,
+                }
+            except Exception:
+                pass
         if recorder is not None:
             recorder.stop("clean" if out["alive"] else "crash")
     return out
