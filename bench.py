@@ -483,12 +483,13 @@ def run_refine_config(n_cells, n_genes, n_clusters, n_way=2, method="wilcox",
 
 
 def run_brain1m(n_cells=1_000_000, n_pcs=15, n_clusters=24):
-    """1M-cell scale config: pooled Ward + cut + ring silhouette over a
-    synthetic embedding (the 'pod-sharded distance + approx hierarchical'
-    configuration; metric is cells/sec)."""
+    """1M-cell scale config: landmark recluster (r7 — sketch-fitted Lloyd,
+    Ward on k ≪ N landmarks, device nearest-landmark cut propagation) +
+    ring silhouette over a synthetic embedding (the 'pod-sharded distance
+    + approx hierarchical' configuration; metric is cells/sec)."""
     import numpy as np
 
-    from scconsensus_tpu.ops.pooling import pooled_ward_linkage
+    from scconsensus_tpu.ops.pooling import landmark_ward_linkage
     from scconsensus_tpu.ops.silhouette import mean_cluster_silhouette
     from scconsensus_tpu.ops.treecut import cutree_hybrid
 
@@ -517,19 +518,29 @@ def run_brain1m(n_cells=1_000_000, n_pcs=15, n_clusters=24):
 
         tracer = Tracer()
         t0 = time.perf_counter()
-        with tracer.span("pooled_ward", n_cells=n_cells):
-            tree, assign, cents = pooled_ward_linkage(
-                x, n_centroids=4096, seed=1
-            )
+        with tracer.span("landmark_ward", n_cells=n_cells):
+            tree, assign, cents, lm_info = landmark_ward_linkage(x, seed=1)
         with tracer.span("cut"):
-            cut = cutree_hybrid(tree, cents, deep_split=1, min_cluster_size=2)
+            w = np.bincount(assign, minlength=cents.shape[0]).astype(
+                np.float64
+            )
+            # cell-unit floor equivalent to the old "2 centroids" minimum
+            # at the old average occupancy (min_cluster_size=2 on 4096
+            # pools of N cells)
+            cut = cutree_hybrid(
+                tree, cents, deep_split=1,
+                min_cluster_size=max(2, round(2 * n_cells / 4096)),
+                weights=w,
+            )
             cells = cut[assign]
         with tracer.span("silhouette"):
             sub = rng.choice(n_cells, size=50_000, replace=False)  # sampled
             si, _ = mean_cluster_silhouette(x[sub], cells[sub])
         dt = time.perf_counter() - t0
         return dt, {"clusters": len(set(cells[cells > 0].tolist())),
-                    "silhouette": round(si, 3)}, tracer.span_records()
+                    "silhouette": round(si, 3),
+                    "landmark": lm_info,
+                    }, tracer.span_records()
 
     return once
 
@@ -872,13 +883,14 @@ def _worker_body() -> None:
         def _b1m_record(secs):
             # nominal target: 1M cells through the approx-hierarchical path
             # in 300 s (no published reference numbers exist, SURVEY.md §6).
-            # This is the clustering tail only (pooled distance+linkage+cut+
+            # This is the clustering tail only (r7: landmark recluster —
+            # sketch Lloyd+weighted Ward+device assignment — +cut+
             # silhouette on an embedding), NOT consensus+DE at 1M — the
             # metric string says exactly what ran (VERDICT r4 weak #5).
             reduced = extra.get("degraded") or extra.get("size_reduced")
             cold = b1m_state.get("phase") == "cold"
             return build_run_record(
-                metric=f"{bn // 1000}k-cell pooled distance+linkage+cut+"
+                metric=f"{bn // 1000}k-cell landmark recluster+cut+"
                        "silhouette throughput (clustering tail only)"
                        + (" COLD (incl. XLA compiles)" if cold else ""),
                 value=round(bn / secs) if secs else -1.0,

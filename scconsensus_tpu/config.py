@@ -101,6 +101,29 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "the offending span + array name + count into span "
                 "metrics and the run record's quality section. bench.py "
                 "workers and tools/run_sparse_1m.py default it on."),
+        # --- tree stage (landmark recluster, ROADMAP item 1) ---
+        EnvFlag("SCC_TREE_LANDMARK_THRESHOLD", int, 200_000,
+                "Cell count above which the pooled tree stage switches "
+                "from the full-data Lloyd to the landmark recluster path "
+                "(sketch-fitted k-means, Ward on k ≪ N landmarks, device "
+                "nearest-landmark cut propagation). Runs at or below the "
+                "threshold keep the pre-r7 byte-identical behavior. "
+                "ReclusterConfig.landmark_threshold overrides when set."),
+        EnvFlag("SCC_TREE_LANDMARK_K", int, None,
+                "Explicit landmark count for the landmark tree path "
+                "(unset = the N-scaled policy clamp(c·√N, k_min, k_max); "
+                "see SCC_TREE_LANDMARK_C and the BASELINE.md landmark "
+                "policy section)."),
+        EnvFlag("SCC_TREE_LANDMARK_C", float, None,
+                "Landmark k-policy scale factor c in "
+                "k = clamp(c·√N, k_min, k_max) when "
+                "ReclusterConfig.landmark_c is unset (config wins; "
+                "both unset = 2.0)."),
+        EnvFlag("SCC_TREE_EXACT", bool, False,
+                "Exact-fallback override: disable the landmark tree path "
+                "at any N and run the pre-r7 behavior (full-data pooled "
+                "Lloyd above approx_threshold, exact Ward below) — the "
+                "escape hatch if a landmark cut looks wrong."),
         # --- DE engine ---
         EnvFlag("SCC_WILCOX_PROBE", bool, False,
                 "Synced per-bucket occupancy DIAGNOSIS of the Wilcoxon "
@@ -260,6 +283,25 @@ class ReclusterConfig:
     approx_method: str = "pool"  # pool (centroid pre-pooling) | knn (ring-kNN graph Ward)
     n_pool_centroids: int = 4096
     knn_graph_k: int = 15  # neighbors per cell for approx_method="knn"
+    # --- landmark recluster (r7, ROADMAP item 1) ---
+    # Above max(approx_threshold, landmark_threshold) the "pool" tree path
+    # runs the landmark engine: k = clamp(landmark_c·√N, k_min, k_max)
+    # landmarks fitted by device Lloyd on a seeded sketch, occupancy-
+    # weighted Ward on the landmarks, one jitted nearest-landmark pass
+    # propagating every cut to cells. At or below the threshold the
+    # pre-r7 full-data Lloyd runs byte-identically. None fields defer to
+    # the registered landmark flags in config.ENV_FLAGS.
+    landmark_threshold: Optional[int] = None   # None → SCC_TREE_LANDMARK_THRESHOLD
+    landmark_k: Optional[int] = None           # None → SCC_TREE_LANDMARK_K / policy
+    landmark_c: Optional[float] = None         # None → SCC_TREE_LANDMARK_C / 2.0
+    landmark_k_min: int = 512
+    landmark_k_max: int = 4096
+    landmark_sketch: Optional[int] = None      # None → sketch policy (~32·k)
+    landmark_linkage: str = "exact"            # exact (native NN-chain) | knn (ring graph)
+    # Diagnostic/test mode: additionally run the exact tree + cuts and
+    # stamp per-deepSplit ARI(landmark, exact) into the tree telemetry —
+    # the tier-1 accuracy pin reads this. O(N²) — mid-size runs only.
+    landmark_verify: bool = False
     # Above approx_threshold the per-deepSplit silhouette switches to the
     # pooled O(N·m) estimator (ops.silhouette.pooled_multi_cut_silhouette,
     # reusing the tree stage's pool when one exists); below it the exact
@@ -291,6 +333,45 @@ class ReclusterConfig:
     def fast_path_preset(cls, **kw) -> "ReclusterConfig":
         """Reference fast-path defaults (qValThrs=0.1, logFCThrs=0.5, minPerCent=20)."""
         return cls(**kw)
+
+    def landmark_policy(self, n_cells: int) -> Optional[Dict[str, Any]]:
+        """Resolved landmark-path decision for a run over ``n_cells``.
+
+        Returns None when the landmark engine must NOT run (at/below the
+        threshold, or SCC_TREE_EXACT forces the pre-r7 behavior);
+        otherwise the resolved knobs: ``{threshold, k (None = policy at
+        fit time), c, k_min, k_max, sketch, linkage}``. Config fields win
+        over env flags; env flags fill unset fields; the registered
+        defaults fill the rest — one resolution order for the pipeline,
+        bench, and the 1M driver.
+        """
+        if env_flag("SCC_TREE_EXACT"):
+            return None
+        thr = self.landmark_threshold
+        if thr is None:
+            thr = env_flag("SCC_TREE_LANDMARK_THRESHOLD")
+        thr = int(thr)
+        if n_cells <= thr:
+            return None
+        k = self.landmark_k
+        if k is None:
+            k = env_flag("SCC_TREE_LANDMARK_K")
+        c = self.landmark_c
+        if c is None:
+            c = env_flag("SCC_TREE_LANDMARK_C")
+        if c is None:
+            c = 2.0
+        return {
+            "threshold": thr,
+            "k": int(k) if k else None,
+            "c": float(c),
+            "k_min": int(self.landmark_k_min),
+            "k_max": int(self.landmark_k_max),
+            "sketch": (int(self.landmark_sketch)
+                       if self.landmark_sketch else None),
+            "linkage": str(self.landmark_linkage),
+            "knn_k": int(self.knn_graph_k),
+        }
 
     def to_json(self) -> str:
         d = dataclasses.asdict(self)

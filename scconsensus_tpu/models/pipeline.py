@@ -284,6 +284,15 @@ def _refine_impl(
                 f"approx_method must be 'pool' or 'knn', got "
                 f"{config.approx_method!r}"
             )
+        # Landmark recluster policy (r7, ROADMAP item 1): above
+        # max(approx_threshold, landmark_threshold) the "pool" branch runs
+        # the sub-quadratic landmark engine (sketch-fitted Lloyd + Ward on
+        # k ≪ N landmarks + jitted nearest-landmark cut propagation); at
+        # or below it, the pre-r7 paths run byte-identically.
+        lm_policy = (
+            config.landmark_policy(N)
+            if approx and config.approx_method == "pool" else None
+        )
 
         def _tree():
             if approx and config.approx_method == "knn":
@@ -295,6 +304,30 @@ def _refine_impl(
                 t = knn_ward_linkage(embedding, k=config.knn_graph_k,
                                      mesh=mesh)
                 return {"merge": t.merge, "height": t.height, "order": t.order}
+            if lm_policy is not None:
+                from scconsensus_tpu.ops.pooling import landmark_ward_linkage
+
+                t, assign, cents, info = landmark_ward_linkage(
+                    embedding,
+                    n_landmarks=lm_policy["k"],
+                    sketch=lm_policy["sketch"],
+                    seed=config.random_seed,
+                    c=lm_policy["c"],
+                    k_min=lm_policy["k_min"],
+                    k_max=lm_policy["k_max"],
+                    linkage=lm_policy["linkage"],
+                    knn_k=lm_policy["knn_k"],
+                    mesh=mesh,
+                )
+                return {"merge": t.merge, "height": t.height, "order": t.order,
+                        "pool_assign": assign, "pool_centroids": cents,
+                        "landmark_k": np.asarray(info["k_used"]),
+                        "landmark_sketch": np.asarray(info["sketch"]),
+                        # linkage engine as an int code so a RESUMED
+                        # artifact stamps the tree it actually holds, not
+                        # whatever today's policy would have picked
+                        "landmark_knn_linkage": np.asarray(
+                            1 if info["linkage"] == "knn" else 0)}
             if approx:
                 from scconsensus_tpu.ops.pooling import pooled_ward_linkage
 
@@ -315,13 +348,46 @@ def _refine_impl(
         )
         pool_assign = tree_arrays.get("pool_assign")
         pool_centroids = tree_arrays.get("pool_centroids")
+        # Branch actually taken comes from the ARTIFACT (resume from a
+        # pre-landmark store must keep the legacy cut semantics), not from
+        # the policy alone.
+        landmark_used = "landmark_k" in tree_arrays
+        landmark_info: Optional[Dict] = None
+        if landmark_used:
+            landmark_info = {
+                "branch": "landmark",
+                "k": int(tree_arrays["landmark_k"]),
+                "sketch": int(tree_arrays["landmark_sketch"]),
+                # threshold describes the run's POLICY (None on a resume
+                # whose policy no longer selects landmark); linkage
+                # describes the stored TREE itself
+                "threshold": (lm_policy or {}).get("threshold"),
+                "linkage": ("knn" if int(tree_arrays.get(
+                    "landmark_knn_linkage", 0)) else "exact"),
+            }
+            rec["landmark"] = True
+            rec["landmark_k"] = landmark_info["k"]
+        elif lm_policy is not None:
+            # policy wanted landmark but the cached artifact predates it
+            rec["landmark"] = False
 
     dynamic_colors: Dict[str, np.ndarray] = {}
     dynamic_labels: Dict[str, np.ndarray] = {}
     deep_split_info: List[Dict] = []
     with timer.stage("cuts"):
+        cut_weights = None
         if pool_assign is None:
             cut_points, cut_min_size = embedding, config.min_cluster_size
+        elif landmark_used:
+            # Landmark path: the cut runs on centroids but in CELL units —
+            # per-landmark occupancy weights replace the legacy average-
+            # occupancy rescale of min_cluster_size, so the reference size
+            # floor holds exactly even when landmark occupancy is skewed.
+            cut_points = pool_centroids
+            cut_min_size = config.min_cluster_size
+            cut_weights = np.bincount(
+                pool_assign, minlength=pool_centroids.shape[0]
+            ).astype(np.float64)
         else:
             # treecut operates on centroids: scale the size floor by the
             # average pool occupancy (approximate-path semantics).
@@ -338,6 +404,7 @@ def _refine_impl(
                     deep_split=int(dsv),
                     min_cluster_size=cut_min_size,
                     pam_stage=config.pam_stage,
+                    weights=cut_weights,
                 )
                 if pool_assign is not None:
                     cut_labels = cut_labels[pool_assign]
@@ -353,6 +420,43 @@ def _refine_impl(
             info = {"deep_split": int(dsv),
                     "n_clusters": int(len(set(cut_labels[cut_labels > 0].tolist())))}
             deep_split_info.append(info)
+
+        if landmark_info is not None:
+            # per-cut landmark occupancy: how many of the k landmarks each
+            # cut actually uses (collapse telemetry for the quality section)
+            occ = {}
+            for dsv in config.deep_split_values:
+                lab = cut_arrays[f"ds{dsv}"]
+                occ[f"ds{dsv}"] = {
+                    "landmarks_assigned": int(
+                        np.unique(pool_assign[lab > 0]).size
+                    ),
+                    "n_landmarks": int(pool_centroids.shape[0]),
+                }
+            landmark_info["occupancy"] = occ
+            if config.landmark_verify:
+                # Diagnostic accuracy pin (tier-1 reads this stamp): run
+                # the EXACT tree + cuts too and score ARI per deepSplit.
+                # O(N²) by construction — mid-size verification runs only.
+                from scconsensus_tpu.obs.regress import adjusted_rand_index
+                from scconsensus_tpu.obs.trace import span as obs_span
+
+                with obs_span("landmark_verify", n_cells=N):
+                    exact_tree = ward_linkage(embedding)
+                    ari = {}
+                    for dsv in config.deep_split_values:
+                        ex = cutree_hybrid(
+                            exact_tree, embedding, deep_split=int(dsv),
+                            min_cluster_size=config.min_cluster_size,
+                            pam_stage=config.pam_stage,
+                        )
+                        lm = cut_arrays[f"ds{dsv}"]
+                        m = (lm > 0) & (ex > 0)
+                        ari[f"ds{dsv}"] = (
+                            round(adjusted_rand_index(lm[m], ex[m]), 6)
+                            if int(m.sum()) else None
+                        )
+                    landmark_info["ari_vs_exact"] = ari
 
     if config.compat.return_silhouette:
         with timer.stage("silhouette") as sil_rec:
@@ -384,6 +488,11 @@ def _refine_impl(
                     int(pool_centroids.shape[0]) if pool_centroids is not None
                     else config.silhouette_pool_centroids
                 )
+                # single-pooling contract: with a tree-stage pool (legacy
+                # or landmark) the estimator prices neighbors at THOSE
+                # centroids — zero extra k-means (span pool_builds
+                # counters assert this in tier-1)
+                sil_rec["pool_reused"] = pool_centroids is not None
                 for info, (si, _per) in zip(
                     deep_split_info,
                     pooled_multi_cut_silhouette(
@@ -438,6 +547,7 @@ def _refine_impl(
                 occupancy=obs_quality.occupancy_from_stage_records(
                     timer.records
                 ),
+                landmark=landmark_info,
                 tracer=timer.tracer,
             )
             for k, v in (quality_section.get("de_funnel") or {}).get(

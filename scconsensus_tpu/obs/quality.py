@@ -429,12 +429,16 @@ def cluster_structure(dynamic_labels: Dict[str, np.ndarray],
                       deep_split_info: Optional[List[Dict]] = None,
                       input_labels=None,
                       ref_labelings: Optional[Dict[str, Any]] = None,
+                      landmark: Optional[Dict[str, Any]] = None,
                       ) -> Dict[str, Any]:
     """Cluster-structure section: per-cut size histograms + silhouette,
     contingency entropy and ARI vs the input labeling(s), and label churn
     (ARI between consecutive deepSplit cuts). ``ref_labelings`` adds
     named extra references (e.g. a bench run's two raw input labelings)
-    scored against the FINAL cut."""
+    scored against the FINAL cut. ``landmark`` is the tree stage's
+    landmark-approximation telemetry (k, sketch, per-cut landmark
+    occupancy, ARI-vs-exact when a verify run computed it) — stamped
+    verbatim so a landmark run record names its approximation."""
     from scconsensus_tpu.obs.regress import adjusted_rand_index
 
     with _timed():
@@ -486,6 +490,8 @@ def cluster_structure(dynamic_labels: Dict[str, np.ndarray],
                     "ari": round(adjusted_rand_index(la, lb), 6),
                 })
         out: Dict[str, Any] = {"cuts": cuts, "churn": churn}
+        if landmark:
+            out["landmark"] = dict(landmark)
         if ari_vs_input:
             out["ari_vs_input"] = ari_vs_input
         if inp is not None:
@@ -506,7 +512,8 @@ def cluster_structure(dynamic_labels: Dict[str, np.ndarray],
 def build_quality_section(de_result=None, config=None,
                           dynamic_labels=None, deep_split_info=None,
                           input_labels=None, ref_labelings=None,
-                          occupancy=None, tracer=None) -> Dict[str, Any]:
+                          occupancy=None, landmark=None,
+                          tracer=None) -> Dict[str, Any]:
     """One ``quality`` section from whatever the run computed — every
     sub-section optional, numeric health always present."""
     q: Dict[str, Any] = {}
@@ -521,6 +528,7 @@ def build_quality_section(de_result=None, config=None,
     if dynamic_labels:
         q["cluster_structure"] = cluster_structure(
             dynamic_labels, deep_split_info, input_labels, ref_labelings,
+            landmark=landmark,
         )
     q["numeric_health"] = numeric_health(tracer)
     return q
@@ -605,6 +613,45 @@ def validate_quality(q: Dict[str, Any]) -> None:
                     _require(isinstance(v, (int, float))
                              and -1.0 - 1e-9 <= v <= 1.0 + 1e-9,
                              f"{key}[{k!r}] must be an ARI in [-1, 1]")
+        lm = cs.get("landmark")
+        if lm is not None:
+            _require(isinstance(lm, dict), "landmark must be an object")
+            _require(isinstance(lm.get("k"), int) and lm["k"] >= 2,
+                     "landmark.k must be an int >= 2")
+            _require(isinstance(lm.get("branch"), str) and lm["branch"],
+                     "landmark.branch must be a non-empty string")
+            # A landmark run is an APPROXIMATION — its record must score
+            # the cut against the input labeling or it carries no evidence
+            # the approximation held (the r7 accuracy-pin contract; the
+            # perf gate rejects records that skip it).
+            ari = cs.get("ari_vs_input")
+            _require(isinstance(ari, dict) and bool(ari),
+                     "landmark run must carry cluster_structure."
+                     "ari_vs_input (the approximation's accuracy "
+                     "evidence)")
+            ave = lm.get("ari_vs_exact")
+            if ave is not None:
+                _require(isinstance(ave, dict), "landmark.ari_vs_exact "
+                         "must be an object")
+                for k, v in ave.items():
+                    if v is not None:
+                        _require(isinstance(v, (int, float))
+                                 and -1.0 - 1e-9 <= v <= 1.0 + 1e-9,
+                                 f"landmark.ari_vs_exact[{k!r}] must be "
+                                 "an ARI in [-1, 1]")
+            occ = lm.get("occupancy")
+            if occ is not None:
+                _require(isinstance(occ, dict), "landmark.occupancy must "
+                         "be an object")
+                for k, v in occ.items():
+                    _require(
+                        isinstance(v, dict)
+                        and isinstance(v.get("landmarks_assigned"), int)
+                        and isinstance(v.get("n_landmarks"), int)
+                        and 0 <= v["landmarks_assigned"] <= v["n_landmarks"],
+                        f"landmark.occupancy[{k!r}] needs "
+                        "landmarks_assigned <= n_landmarks",
+                    )
     nh = q.get("numeric_health")
     if nh is not None:
         _require(isinstance(nh, dict), "numeric_health must be an object")

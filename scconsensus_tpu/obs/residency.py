@@ -135,15 +135,28 @@ BOUNDARIES: Dict[str, str] = {
         "the embedding device-resident through rSVD→linkage."
     ),
     "tree_pool_fetch": (
-        "Approximate-path pooling: the (m, d) k-means centroids + (N,) "
-        "assignment come to host for Ward linkage + cut propagation. "
-        "TODO(item-2): device-resident landmark tree."
+        "LEGACY sub-threshold pooled path only (r7 shrank this from the "
+        "former any-N scope): the full-data Lloyd's (m, d) centroids + "
+        "(N,) assignment come to host for Ward linkage. Above "
+        "SCC_TREE_LANDMARK_THRESHOLD the landmark path crosses at "
+        "landmark_assign_fetch instead. TODO(item-2): device-resident "
+        "tree for the legacy path too."
+    ),
+    "landmark_assign_fetch": (
+        "Landmark recluster path (r7): one h2d staging of the embedding "
+        "blocks into the jitted sketch-Lloyd/nearest-landmark kernels, "
+        "then exactly two intended d2h crossings — the (k, d) landmark "
+        "centroids for host Ward + treecut and the (N,) int32 "
+        "assignment that propagates cut labels to cells. The (N, k) "
+        "distance tiles never leave the device."
     ),
     "silhouette_slab_fetch": (
-        "Exact-silhouette distance slabs / (N, K) cluster distance sums "
-        "copy to host (ops.distance, ops.pallas_kernels."
-        "distance_cluster_sums). TODO(item-2): device-resident "
-        "silhouette reduction."
+        "EXACT-silhouette path only (below approx_threshold; r7 shrank "
+        "this — the landmark/pooled estimator reuses the tree stage's "
+        "pool on host and performs no slab fetch): distance slabs / "
+        "(N, K) cluster distance sums copy to host (ops.distance, "
+        "ops.pallas_kernels.distance_cluster_sums). TODO(item-2): "
+        "device-resident silhouette reduction."
     ),
     "de_result_fetch": (
         "PairwiseDEResult lazy-field materialization (to_store, "

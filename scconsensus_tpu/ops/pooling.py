@@ -6,20 +6,63 @@ approximate path pools cells onto m ≪ N centroids with device k-means
 (matmul-dominated Lloyd iterations — MXU work), runs exact Ward.D2 on the
 centroids, and broadcasts cut labels back through the pool assignment —
 the Secuer-style anchor strategy (PAPERS.md) realized on TPU.
+
+Two pooling engines live here:
+
+* :func:`kmeans_pool` / :func:`pooled_ward_linkage` — the r4 full-data
+  Lloyd: every iteration sweeps ALL N points and accumulates the centroid
+  update through an explicit (block, m) one-hot matmul. Numerically frozen
+  (the sub-threshold approximate path is pinned byte-identical across
+  rounds); at 1M cells its 11 full sweeps were 396 s of the 676 s pipe —
+  the r7 bottleneck.
+
+* :func:`landmark_pool` / :func:`landmark_ward_linkage` — the r7 landmark
+  recluster engine (ROADMAP item 1, Secuer's anchor argument taken
+  seriously): fit k = clamp(c·√N, k_min, k_max) landmarks by device Lloyd
+  over a seeded SKETCH of the data (k-means centroids need a sample, not
+  the population), then ONE blocked device pass assigns every cell to its
+  nearest landmark — argmin + ``segment_sum``, no (block, k) one-hot ever
+  materializes. Host traffic is the (k, d) centroids and the (N,)
+  assignment; Ward runs on the k weighted landmarks. 1M×15 on 2 CPU
+  cores: 396 s → ~22 s.
 """
 
 from __future__ import annotations
 
+import math
 from functools import partial
-from typing import Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from scconsensus_tpu.ops.distance import _sq_dists_raw
 from scconsensus_tpu.ops.linkage import HClustTree, ward_linkage
 
-__all__ = ["kmeans_pool", "pooled_ward_linkage"]
+__all__ = [
+    "kmeans_pool",
+    "pooled_ward_linkage",
+    "landmark_k_policy",
+    "landmark_sketch_policy",
+    "landmark_pool",
+    "landmark_ward_linkage",
+]
+
+
+def _note_pool_build() -> None:
+    """Bump the ambient span's ``pool_builds`` counter: every Lloyd fit
+    (legacy or landmark) registers here, so the single-pooling contract —
+    a landmark-path pipeline run fits exactly ONE pool, which silhouette
+    then reuses — is assertable from span metrics alone."""
+    from scconsensus_tpu.obs import trace as obs_trace
+
+    span = obs_trace.current_span()
+    if span is not None:
+        try:
+            span.metrics.counter("pool_builds").add(1)
+        except Exception:  # metrics must never cost the fit
+            pass
 
 
 # Point-block width for the assignment sweep: bounds the live (block, m)
@@ -93,6 +136,7 @@ def kmeans_pool(
     init = x[rng.choice(n, size=m, replace=False)]
     from scconsensus_tpu.obs.residency import boundary
 
+    _note_pool_build()
     with boundary("tree_pool_fetch"):
         cent, assign = _lloyd(jnp.asarray(x, jnp.float32),
                               jnp.asarray(init, jnp.float32), n_iter=n_iter)
@@ -114,3 +158,206 @@ def pooled_ward_linkage(
     counts = np.bincount(assign, minlength=cent.shape[0]).astype(np.float64)
     tree = ward_linkage(cent, weights=counts)
     return tree, assign, cent
+
+
+# --------------------------------------------------------------------------
+# landmark recluster engine (r7, ROADMAP item 1)
+# --------------------------------------------------------------------------
+
+def landmark_k_policy(
+    n: int, c: float = 2.0, k_min: int = 512, k_max: int = 4096
+) -> int:
+    """N-scaled landmark count: ``clamp(c·√N, k_min, k_max)`` rounded up to
+    a multiple of 128 (the MXU lane width — the (block, k) distance tile is
+    a matmul and full lanes are free). The caps win over the rounding:
+    never exceeds k_max or N."""
+    k = int(math.ceil(c * math.sqrt(max(n, 1))))
+    k = min(max(k, int(k_min), 2), int(k_max))
+    if k > 128:
+        k = min(((k + 127) // 128) * 128, int(k_max))
+    return min(k, n)
+
+
+def landmark_sketch_policy(n: int, k: int) -> int:
+    """Sketch size the landmark Lloyd fits on: enough points per landmark
+    for stable centroids (~32·k), floored for tiny k, capped so the fit
+    never re-approaches a full sweep. Always ≥ k and ≤ N."""
+    return int(min(n, max(32 * k, 16_384, k), 131_072))
+
+
+@partial(jax.jit, static_argnames=("n_iter",))
+def _lloyd_sketch(pb, vb, cent, n_iter: int = 10):
+    """Blocked Lloyd over a sketch, centroid update via ``segment_sum``.
+
+    Unlike the legacy ``_lloyd`` the per-block (block, k) one-hot never
+    materializes: the distance tile feeds an argmin and the update is two
+    segment reductions — half the FLOPs and none of the one-hot memory
+    traffic (the r6 1M profile showed the one-hot stream dominating).
+    Pad rows carry segment id k and fall off the ``[:k]`` slice.
+    """
+    m = cent.shape[0]
+
+    def assign_block(c, block, vmask):
+        d2 = _sq_dists_raw(block, c)
+        a = jnp.argmin(d2, axis=1)
+        return jnp.where(vmask > 0, a, m)
+
+    def step(c, _):
+        def fold(carry, inp):
+            counts, sums = carry
+            block, vmask = inp
+            a = assign_block(c, block, vmask)
+            counts = counts + jax.ops.segment_sum(
+                vmask, a, num_segments=m + 1
+            )[:m]
+            sums = sums + jax.ops.segment_sum(
+                block * vmask[:, None], a, num_segments=m + 1
+            )[:m]
+            return (counts, sums), None
+
+        (counts, sums), _ = jax.lax.scan(
+            fold,
+            (jnp.zeros((m,), pb.dtype), jnp.zeros((m, pb.shape[-1]),
+                                                  pb.dtype)),
+            (pb, vb),
+        )
+        new = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], c
+        )
+        return new, None
+
+    cent, _ = jax.lax.scan(step, cent, None, length=n_iter)
+    return cent
+
+
+@jax.jit
+def _assign_blocks(pb, cent):
+    """One nearest-landmark pass over blocked points: the jitted device
+    form of cut propagation (1-NN over landmarks — the degenerate kNN the
+    ring engine generalizes). Only the (nb, block) int32 argmins leave the
+    scan; the (block, k) distance tile lives and dies on device."""
+    def fold(carry, block):
+        d2 = _sq_dists_raw(block, cent)
+        return carry, jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+    _, a = jax.lax.scan(fold, None, pb)
+    return a
+
+
+def landmark_pool(
+    x: np.ndarray,
+    n_landmarks: Optional[int] = None,
+    sketch: Optional[int] = None,
+    n_iter: int = 10,
+    seed: int = 0,
+    c: float = 2.0,
+    k_min: int = 512,
+    k_max: int = 4096,
+) -> Tuple[np.ndarray, np.ndarray, Dict]:
+    """Pool rows of x (N, d) onto k ≪ N landmarks: sketch-fitted device
+    Lloyd + one full blocked assignment pass.
+
+    Returns (centroids (k', d), assignment (N,), info) with empty landmarks
+    dropped (k' ≤ k) and ``info`` carrying the policy telemetry the quality
+    section stamps (k requested/used, sketch size, iterations).
+
+    A device-resident input stays resident: padding/reshaping and the
+    sketch/init gathers are jnp ops, so the only crossings are the one h2d
+    staging of a HOST input and the (k, d) + (N,) results coming back.
+    """
+    n, d = x.shape
+    k = int(n_landmarks) if n_landmarks else landmark_k_policy(
+        n, c=c, k_min=k_min, k_max=k_max
+    )
+    k = min(k, n)
+    s = int(sketch) if sketch else landmark_sketch_policy(n, k)
+    s = min(max(s, k), n)
+    rng = np.random.default_rng(seed)
+    sk_idx = rng.choice(n, size=s, replace=False) if s < n else np.arange(n)
+    init_idx = rng.choice(s, size=k, replace=False)
+
+    from scconsensus_tpu.obs.residency import boundary
+    from scconsensus_tpu.obs.trace import span as obs_span
+
+    _note_pool_build()
+    nb = (n + _LLOYD_BLOCK - 1) // _LLOYD_BLOCK
+    pad = nb * _LLOYD_BLOCK - n
+    snb = (s + _LLOYD_BLOCK - 1) // _LLOYD_BLOCK
+    spad = snb * _LLOYD_BLOCK - s
+    with boundary("landmark_assign_fetch"):
+        # one h2d staging of a host input (no-op for device input), then
+        # the two intended d2h crossings: (k, d) centroids, (N,) assignment
+        with obs_span("landmark_fit", sync=True, k=k, sketch=s):
+            xd = jnp.asarray(x, jnp.float32)
+            sk = xd[jnp.asarray(sk_idx)] if s < n else xd
+            init = sk[jnp.asarray(init_idx)]
+            spb = jnp.pad(sk, ((0, spad), (0, 0))).reshape(
+                snb, _LLOYD_BLOCK, d
+            )
+            svb = jnp.pad(jnp.ones((s,), jnp.float32), (0, spad)).reshape(
+                snb, _LLOYD_BLOCK
+            )
+            cent_d = _lloyd_sketch(spb, svb, init, n_iter=n_iter)
+        with obs_span("landmark_assign", sync=True, n_cells=n):
+            pb = jnp.pad(xd, ((0, pad), (0, 0))).reshape(
+                nb, _LLOYD_BLOCK, d
+            )
+            assign = np.asarray(_assign_blocks(pb, cent_d)).reshape(-1)[:n]
+            cent = np.asarray(cent_d, np.float64)
+    used = np.unique(assign)
+    remap = -np.ones(k, np.int64)
+    remap[used] = np.arange(used.size)
+    info = {
+        "k_requested": int(k),
+        "k_used": int(used.size),
+        "sketch": int(s),
+        "n_iter": int(n_iter),
+    }
+    return cent[used], remap[assign], info
+
+
+def landmark_ward_linkage(
+    x: np.ndarray,
+    n_landmarks: Optional[int] = None,
+    sketch: Optional[int] = None,
+    n_iter: int = 10,
+    seed: int = 0,
+    c: float = 2.0,
+    k_min: int = 512,
+    k_max: int = 4096,
+    linkage: str = "exact",
+    knn_k: int = 15,
+    mesh=None,
+) -> Tuple[HClustTree, np.ndarray, np.ndarray, Dict]:
+    """Landmark recluster tree: occupancy-weighted Ward.D2 over the
+    landmark centroids of :func:`landmark_pool`.
+
+    ``linkage="exact"`` runs the native NN-chain on the k centroids (k ≤
+    4096 keeps it sub-second); ``"knn"`` routes through
+    ``ops.knn_linkage.knn_ward_linkage`` (ring-kNN candidate graph on
+    device with ``knn_k`` neighbors per landmark, ``parallel.ring``) for
+    configurations that push k far past that. Returns (tree, assignment
+    (N,), centroids, info); cut labels on the tree propagate to cells via
+    ``labels[assign]``.
+    """
+    from scconsensus_tpu.obs.trace import span as obs_span
+
+    if linkage not in ("exact", "knn"):
+        raise ValueError(
+            f"landmark linkage must be 'exact' or 'knn', got {linkage!r}"
+        )
+    cent, assign, info = landmark_pool(
+        x, n_landmarks=n_landmarks, sketch=sketch, n_iter=n_iter,
+        seed=seed, c=c, k_min=k_min, k_max=k_max,
+    )
+    counts = np.bincount(assign, minlength=cent.shape[0]).astype(np.float64)
+    with obs_span("landmark_linkage", k=int(cent.shape[0])):
+        if linkage == "knn":
+            from scconsensus_tpu.ops.knn_linkage import knn_ward_linkage
+
+            tree = knn_ward_linkage(cent, k=knn_k, mesh=mesh,
+                                    weights=counts)
+        else:
+            tree = ward_linkage(cent, weights=counts)
+    info["linkage"] = linkage
+    return tree, assign, cent, info

@@ -127,15 +127,34 @@ def _qualifies(
     min_cluster_size: int,
     max_abs_core_scatter: float,
     min_abs_gap: float,
+    weights: Optional[np.ndarray] = None,
 ) -> bool:
-    size = len(branch.singletons)
-    if size < min_cluster_size:
-        return False
-    cs = core_size(size, min_cluster_size)
-    scatter = _core_scatter(embedding, branch.singletons[:cs])
+    if weights is None:
+        size = len(branch.singletons)
+        if size < min_cluster_size:
+            return False
+        n_core = core_size(size, min_cluster_size)
+    else:
+        # Centroid-weighted semantics (the landmark recluster path): each
+        # leaf stands for weights[leaf] cells, so the size criterion and
+        # the core-size formula run in CELL units — minClusterSize keeps
+        # its reference meaning at any pooling ratio — and the core is
+        # the earliest-joining leaves whose cumulative weight reaches the
+        # cell-unit core size.
+        w = weights[np.asarray(branch.singletons)]
+        size = float(w.sum())
+        if size < min_cluster_size:
+            return False
+        cum = np.cumsum(w)
+        n_core = int(
+            np.searchsorted(cum, core_size(size, min_cluster_size),
+                            side="left")
+        ) + 1
+        n_core = min(n_core, len(branch.singletons))
+    scatter = _core_scatter(embedding, branch.singletons[:n_core])
     if scatter > max_abs_core_scatter:
         return False
-    gap = death_height - branch.heights[cs - 1]
+    gap = death_height - branch.heights[n_core - 1]
     return gap >= min_abs_gap
 
 
@@ -147,6 +166,7 @@ def cutree_hybrid(
     cut_height: Optional[float] = None,
     pam_stage: bool = False,
     max_pam_dist: Optional[float] = None,
+    weights: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Hybrid dynamic cut of an hclust tree.
 
@@ -155,11 +175,22 @@ def cutree_hybrid(
       embedding: (N, d) points the tree was built on (distance source).
       deep_split: 0 (conservative) .. 4 (aggressive splitting).
       pam_stage: assign unlabeled objects to nearest cluster afterwards.
+      weights: optional (N,) per-leaf observation counts (landmark/pooled
+        trees: leaves are centroids standing for ``weights[i]`` cells).
+        Branch sizes, ``min_cluster_size``, and core sizes then run in
+        cell units; cluster numbering orders by total cell weight.
 
     Returns (N,) int labels: 1..K by decreasing cluster size, 0 = unassigned.
     """
     if not 0 <= int(deep_split) <= 4:
         raise ValueError(f"deep_split must be in 0..4, got {deep_split}")
+    if weights is not None:
+        weights = np.ascontiguousarray(weights, np.float64)
+        if weights.shape != (tree.n_leaves,):
+            raise ValueError(
+                f"weights shape {weights.shape} != (n_leaves,) "
+                f"({tree.n_leaves},)"
+            )
     n = tree.n_leaves
     heights = tree.height
     n_merge = n - 1
@@ -198,16 +229,16 @@ def cutree_hybrid(
             for other in (ba, bb):
                 if not other.composite and _qualifies(
                     other, h, embedding, min_cluster_size,
-                    max_abs_core_scatter, min_abs_gap,
+                    max_abs_core_scatter, min_abs_gap, weights,
                 ):
                     clusters.append(list(other.singletons))
             branch_of_row[row] = _Branch([], [], composite=True)
             continue
         if len(ba.singletons) > 1 and len(bb.singletons) > 1:
             qa = _qualifies(ba, h, embedding, min_cluster_size,
-                            max_abs_core_scatter, min_abs_gap)
+                            max_abs_core_scatter, min_abs_gap, weights)
             qb = _qualifies(bb, h, embedding, min_cluster_size,
-                            max_abs_core_scatter, min_abs_gap)
+                            max_abs_core_scatter, min_abs_gap, weights)
             if qa and qb:
                 clusters.append(list(ba.singletons))
                 clusters.append(list(bb.singletons))
@@ -220,30 +251,42 @@ def cutree_hybrid(
         if branch.composite:
             continue
         if _qualifies(branch, cut_height, embedding, min_cluster_size,
-                      max_abs_core_scatter, min_abs_gap):
+                      max_abs_core_scatter, min_abs_gap, weights):
             clusters.append(list(branch.singletons))
 
     labels = np.zeros(n, np.int64)
-    clusters.sort(key=len, reverse=True)
+    if weights is None:
+        clusters.sort(key=len, reverse=True)
+    else:
+        clusters.sort(key=lambda m: float(weights[np.asarray(m)].sum()),
+                      reverse=True)
     for cid, members in enumerate(clusters, start=1):
         labels[np.asarray(members)] = cid
 
     if pam_stage and clusters:
         labels = _pam_assign(embedding, labels,
-                             max_pam_dist if max_pam_dist is not None else cut_height)
+                             max_pam_dist if max_pam_dist is not None else cut_height,
+                             weights=weights)
     return labels
 
 
-def _pam_assign(embedding: np.ndarray, labels: np.ndarray, max_dist: float) -> np.ndarray:
+def _pam_assign(embedding: np.ndarray, labels: np.ndarray, max_dist: float,
+                weights: Optional[np.ndarray] = None) -> np.ndarray:
     """Assign unlabeled objects to the cluster with smallest mean distance,
-    when that distance is within ``max_dist``."""
+    when that distance is within ``max_dist``. With ``weights`` (landmark
+    trees) the mean is occupancy-weighted — each candidate cluster's
+    distance is the mean over its CELLS, each priced at its landmark, so
+    the cell-unit cut semantics extend through the PAM stage."""
     un = np.nonzero(labels == 0)[0]
     if un.size == 0:
         return labels
     k = labels.max()
     onehot = np.zeros((embedding.shape[0], k), np.float64)
+    w = (np.ones(embedding.shape[0], np.float64)
+         if weights is None else weights)
     for c in range(1, k + 1):
-        onehot[labels == c, c - 1] = 1.0
+        m = labels == c
+        onehot[m, c - 1] = w[m]
     counts = onehot.sum(axis=0)
     pts = embedding[un]
     sq = np.sum(pts * pts, axis=1)[:, None]

@@ -1,0 +1,336 @@
+"""Landmark recluster path (r7 tentpole, ROADMAP item 1): accuracy pin,
+determinism, threshold crossover, resume identity, single pooling,
+residency.
+
+The pin mirrors the r6 pooled-silhouette pattern: the approximation's
+error vs the exact algorithm is asserted at test-affordable N (here,
+ARI of landmark-cut labels vs the exact Ward tree's labels across the
+deepSplit ladder on mid-size fixtures), and every landmark run stamps
+that evidence onto its quality section.
+"""
+
+import numpy as np
+import pytest
+
+from scconsensus_tpu.config import ReclusterConfig
+from scconsensus_tpu.obs.regress import adjusted_rand_index
+from scconsensus_tpu.ops.linkage import ward_linkage
+from scconsensus_tpu.ops.pooling import (
+    landmark_k_policy,
+    landmark_pool,
+    landmark_sketch_policy,
+    landmark_ward_linkage,
+)
+from scconsensus_tpu.ops.treecut import cutree_hybrid
+
+
+def _blobs(rng, n, k=5, d=10, scale=8.0):
+    centers = rng.normal(scale=scale, size=(k, d))
+    lab = rng.integers(0, k, n)
+    x = (centers[lab] + rng.normal(size=(n, d))).astype(np.float32)
+    return x, lab.astype(np.int64)
+
+
+def _refine_case(n_cells, n_clusters=4, seed=4, strong=False):
+    from scconsensus_tpu.utils.synthetic import synthetic_scrna
+
+    kw = dict(n_genes=200, n_markers_per_cluster=12)
+    if strong:
+        # Strongly separated structure: the accuracy pin compares cuts
+        # where splitting is structure-driven — at weak separation the
+        # aggressive deepSplits partition NOISE, and two different trees
+        # legitimately partition noise differently (BASELINE.md
+        # "Landmark recluster policy").
+        kw = dict(n_genes=400, n_markers_per_cluster=25,
+                  marker_log_fc=3.0, nb_dispersion=0.2)
+    data, truth, _ = synthetic_scrna(
+        n_cells=n_cells, n_clusters=n_clusters, seed=seed, **kw,
+    )
+    return data, np.array([f"c{v}" for v in truth]), truth
+
+
+class TestKPolicy:
+    def test_scaling_and_clamps(self):
+        # c·√N inside the clamps, MXU-lane (128) aligned when > 128
+        assert landmark_k_policy(1_000_000, c=2.0) == 2048
+        assert landmark_k_policy(10_000) == 512          # k_min clamp
+        assert landmark_k_policy(10**9, k_max=4096) == 4096  # k_max clamp
+        assert landmark_k_policy(100_000) % 128 == 0
+        assert landmark_k_policy(100, k_min=512) == 100  # never exceeds N
+        # the cap wins over MXU rounding: a non-multiple-of-128 k_max is
+        # honored, not silently exceeded
+        assert landmark_k_policy(1_000_000, k_max=1000) == 1000
+
+    def test_sketch_policy_bounds(self):
+        n, k = 1_000_000, 2048
+        s = landmark_sketch_policy(n, k)
+        assert k <= s <= n
+        assert s <= 131_072  # never re-approaches a full sweep
+        assert landmark_sketch_policy(5000, 512) == 5000  # small N: all rows
+
+
+class TestLandmarkAccuracy:
+    """The tier-1 ARI pin: landmark-cut labels vs the exact Ward tree's
+    labels ≥ 0.9 across the deepSplit ladder, on 5–20k-cell fixtures."""
+
+    @pytest.mark.parametrize("n_cells,seed", [(5_000, 0), (12_000, 1)])
+    def test_ari_vs_exact_across_ladder(self, rng, n_cells, seed):
+        r = np.random.default_rng(seed)
+        x, _ = _blobs(r, n_cells)
+        tree, assign, cents, info = landmark_ward_linkage(x, seed=seed)
+        w = np.bincount(assign, minlength=cents.shape[0]).astype(np.float64)
+        exact_tree = ward_linkage(x)
+        for ds in (1, 2, 3, 4):
+            lm = cutree_hybrid(tree, cents, deep_split=ds,
+                               min_cluster_size=10, weights=w)[assign]
+            ex = cutree_hybrid(exact_tree, x, deep_split=ds,
+                               min_cluster_size=10)
+            m = (lm > 0) & (ex > 0)
+            assert m.sum() > 0.9 * n_cells
+            ari = adjusted_rand_index(lm[m], ex[m])
+            assert ari >= 0.9, f"deepSplit={ds}: ARI {ari:.3f} < 0.9"
+
+    def test_pipeline_stamps_ari_pin(self):
+        """landmark_verify runs exact+landmark in ONE pipeline and stamps
+        the per-deepSplit ARI onto quality.cluster_structure.landmark —
+        the record-level form of the pin above."""
+        data, labels, _ = _refine_case(6_000, n_clusters=8, strong=True)
+        from scconsensus_tpu import recluster_de_consensus_fast
+
+        res = recluster_de_consensus_fast(
+            data, labels, deep_split_values=(1, 2, 3, 4),
+            approx_threshold=1000, landmark_threshold=1000,
+            landmark_verify=True, mesh=None,
+        )
+        lm = res.metrics["quality"]["cluster_structure"]["landmark"]
+        assert lm["branch"] == "landmark"
+        assert lm["k"] >= 2
+        ari = lm["ari_vs_exact"]
+        assert set(ari) == {"ds1", "ds2", "ds3", "ds4"}
+        for ds, v in ari.items():
+            assert v is not None and v >= 0.9, f"{ds}: {v}"
+        # per-cut landmark occupancy present and sane
+        for ds, occ in lm["occupancy"].items():
+            assert 0 < occ["landmarks_assigned"] <= occ["n_landmarks"]
+            assert occ["n_landmarks"] == lm["k"]
+
+
+class TestWeightedPam:
+    def test_pam_mean_distance_is_occupancy_weighted(self):
+        """Cell-unit semantics extend through the PAM stage: an unassigned
+        landmark joins the cluster nearest by CELL-weighted mean distance.
+        Orphan at 0; cluster 1 = landmarks at 1 (w=1) and 9 (w=100),
+        cluster 2 = landmark at 6 (w=1). Unweighted means: 5 vs 6 →
+        cluster 1; weighted: (1 + 900)/101 ≈ 8.9 vs 6 → cluster 2."""
+        from scconsensus_tpu.ops.treecut import _pam_assign
+
+        emb = np.array([[0.0], [1.0], [9.0], [6.0]])
+        labels = np.array([0, 1, 1, 2])
+        w = np.array([1.0, 1.0, 100.0, 1.0])
+        assert _pam_assign(emb, labels, max_dist=100.0)[0] == 1
+        assert _pam_assign(emb, labels, max_dist=100.0, weights=w)[0] == 2
+
+
+class TestDeterminism:
+    def test_fixed_seed_identical(self, rng):
+        x, _ = _blobs(rng, 6_000)
+        a = landmark_ward_linkage(x, seed=7)
+        b = landmark_ward_linkage(x, seed=7)
+        np.testing.assert_array_equal(a[1], b[1])          # assignment
+        np.testing.assert_array_equal(a[2], b[2])          # centroids
+        np.testing.assert_array_equal(a[0].merge, b[0].merge)
+        np.testing.assert_allclose(a[0].height, b[0].height)
+
+    def test_different_seed_differs(self, rng):
+        x, _ = _blobs(rng, 6_000)
+        a = landmark_ward_linkage(x, seed=7)
+        b = landmark_ward_linkage(x, seed=8)
+        assert not np.array_equal(a[2], b[2])
+
+
+class TestThresholdCrossover:
+    """Exact below the landmark threshold, landmark above — identical API
+    and artifact shapes on both sides, and SCC_TREE_EXACT forces the
+    pre-r7 behavior at any N."""
+
+    def test_crossover_and_shapes(self):
+        from scconsensus_tpu import recluster_de_consensus_fast
+
+        data, labels, truth = _refine_case(3_000, n_clusters=3)
+        common = dict(deep_split_values=(1, 2), mesh=None)
+
+        below = recluster_de_consensus_fast(data, labels, **common)
+        tr = next(r for r in below.metrics["stages"] if r["stage"] == "tree")
+        assert tr["approx"] is False        # 3k < default approx threshold
+        assert "landmark" not in below.metrics["quality"][
+            "cluster_structure"]
+
+        above = recluster_de_consensus_fast(
+            data, labels, approx_threshold=1000, landmark_threshold=1000,
+            **common,
+        )
+        tr = next(r for r in above.metrics["stages"] if r["stage"] == "tree")
+        assert tr["approx"] is True and tr["landmark"] is True
+        assert above.metrics["quality"]["cluster_structure"][
+            "landmark"]["branch"] == "landmark"
+
+        # identical API/artifact shapes on both sides of the threshold
+        for res in (below, above):
+            assert set(res.dynamic_labels) == {"deepsplit: 1",
+                                               "deepsplit: 2"}
+            for lab in res.dynamic_labels.values():
+                assert lab.shape == (3_000,)
+            assert res.cell_tree.merge.shape[1] == 2
+            assert len(res.deep_split_info) == 2
+        # both recover the planted structure
+        for res in (below, above):
+            lab = res.dynamic_labels["deepsplit: 1"]
+            m = lab > 0
+            assert adjusted_rand_index(lab[m], truth[m]) > 0.9
+
+    def test_exact_override_wins(self, monkeypatch):
+        """SCC_TREE_EXACT=1 is the escape hatch: same config that would
+        take the landmark branch runs the legacy pooled path instead."""
+        from scconsensus_tpu import recluster_de_consensus_fast
+
+        data, labels, _ = _refine_case(3_000, n_clusters=3)
+        monkeypatch.setenv("SCC_TREE_EXACT", "1")
+        res = recluster_de_consensus_fast(
+            data, labels, deep_split_values=(1,), approx_threshold=1000,
+            landmark_threshold=1000, n_pool_centroids=256, mesh=None,
+        )
+        tr = next(r for r in res.metrics["stages"] if r["stage"] == "tree")
+        assert tr["approx"] is True
+        assert not tr.get("landmark")
+        assert "landmark" not in res.metrics["quality"]["cluster_structure"]
+
+    def test_policy_resolution_order(self, monkeypatch):
+        cfg = ReclusterConfig(landmark_threshold=500, landmark_k=777)
+        pol = cfg.landmark_policy(1_000)
+        assert pol["threshold"] == 500 and pol["k"] == 777
+        assert cfg.landmark_policy(500) is None  # at threshold: exact
+        # env fills unset fields
+        monkeypatch.setenv("SCC_TREE_LANDMARK_THRESHOLD", "100")
+        monkeypatch.setenv("SCC_TREE_LANDMARK_K", "333")
+        monkeypatch.setenv("SCC_TREE_LANDMARK_C", "3.5")
+        pol = ReclusterConfig().landmark_policy(200)
+        assert pol["threshold"] == 100 and pol["k"] == 333
+        assert pol["c"] == 3.5
+        # config wins over env when both set
+        pol = cfg.landmark_policy(1_000)
+        assert pol["threshold"] == 500 and pol["k"] == 777
+        monkeypatch.setenv("SCC_TREE_EXACT", "1")
+        assert cfg.landmark_policy(10**9) is None
+
+
+class TestResume:
+    def test_resume_identical_to_uninterrupted(self, tmp_path):
+        """Landmark-path artifacts resume: killing after the tree stage
+        and re-running must reproduce the uninterrupted labels exactly."""
+        from scconsensus_tpu.models.pipeline import refine
+
+        data, labels, _ = _refine_case(3_000, n_clusters=3)
+        kw = dict(deep_split_values=(1, 2), approx_threshold=1000,
+                  landmark_threshold=1000)
+
+        ref = refine(data, labels, ReclusterConfig(**kw), mesh=None)
+
+        import scconsensus_tpu.models.pipeline as pl
+
+        config = ReclusterConfig(artifact_dir=str(tmp_path / "store"), **kw)
+        real_cutree = pl.cutree_hybrid
+
+        def dying_cutree(*a, **kws):
+            raise KeyboardInterrupt("simulated ctrl-C after tree")
+
+        pl.cutree_hybrid = dying_cutree
+        try:
+            with pytest.raises(KeyboardInterrupt):
+                refine(data, labels, config, mesh=None)
+        finally:
+            pl.cutree_hybrid = real_cutree
+
+        res = refine(data, labels, config, mesh=None)
+        for key in ref.dynamic_labels:
+            np.testing.assert_array_equal(
+                res.dynamic_labels[key], ref.dynamic_labels[key]
+            )
+        tr = next(r for r in res.metrics["stages"] if r["stage"] == "tree")
+        assert tr["landmark"] is True  # branch survived the resume
+
+    def test_pre_landmark_artifacts_keep_legacy_cuts(
+        self, tmp_path, monkeypatch
+    ):
+        """A store whose tree artifact carries no landmark keys (written
+        by pre-r7 code) resumes with legacy cut semantics even when the
+        policy would take the landmark branch — the ARTIFACT, not the
+        policy, names the branch. Simulated by suppressing the policy
+        for the writing run only (the store's config fingerprint guard
+        forbids literally changing the config between runs)."""
+        from scconsensus_tpu.models.pipeline import refine
+
+        data, labels, _ = _refine_case(3_000, n_clusters=3)
+        store = str(tmp_path / "store")
+        config = ReclusterConfig(
+            artifact_dir=store, deep_split_values=(1,),
+            approx_threshold=1000, landmark_threshold=1000,
+            n_pool_centroids=256,
+        )
+        monkeypatch.setattr(ReclusterConfig, "landmark_policy",
+                            lambda self, n: None)
+        legacy = refine(data, labels, config, mesh=None)
+        tr = next(r for r in legacy.metrics["stages"]
+                  if r["stage"] == "tree")
+        assert "landmark" not in tr  # the writing run took the old path
+        monkeypatch.undo()
+
+        res = refine(data, labels, config, mesh=None)
+        tr = next(r for r in res.metrics["stages"] if r["stage"] == "tree")
+        assert tr.get("landmark") is False  # policy wanted it; artifact won
+        np.testing.assert_array_equal(
+            res.dynamic_labels["deepsplit: 1"],
+            legacy.dynamic_labels["deepsplit: 1"],
+        )
+
+
+class TestSinglePooling:
+    def test_one_pool_build_per_landmark_run(self):
+        """Satellite 4: a landmark run fits exactly ONE pool — silhouette
+        reuses the landmark centroids/assignment instead of re-pooling —
+        asserted from the span pool_builds counters."""
+        from scconsensus_tpu import recluster_de_consensus_fast
+
+        data, labels, _ = _refine_case(3_000, n_clusters=3)
+        res = recluster_de_consensus_fast(
+            data, labels, deep_split_values=(1, 2), approx_threshold=1000,
+            landmark_threshold=1000, mesh=None,
+        )
+        sil = next(r for r in res.metrics["stages"]
+                   if r["stage"] == "silhouette")
+        assert sil["method"] == "pooled-estimator"
+        assert sil["pool_reused"] is True
+        builds = sum(
+            ((s.get("metrics") or {}).get("pool_builds") or {})
+            .get("value", 0)
+            for s in res.metrics.get("spans") or []
+        )
+        assert builds == 1.0
+
+
+class TestResidency:
+    def test_enforce_green_and_boundary_named(self, monkeypatch):
+        """The tier-1 enforce contract extends to the landmark path: zero
+        violations, and the landmark crossing is boundary-named."""
+        from scconsensus_tpu import recluster_de_consensus_fast
+
+        monkeypatch.setenv("SCC_OBS_RESIDENCY", "enforce")
+        data, labels, _ = _refine_case(3_000, n_clusters=3)
+        res = recluster_de_consensus_fast(
+            data, labels, deep_split_values=(1,), approx_threshold=1000,
+            landmark_threshold=1000, mesh=None,
+        )
+        rep = res.metrics["residency"]
+        assert rep["violations"] == []
+        assert "landmark_assign_fetch" in rep["by_boundary"]
+        tr = next(r for r in res.metrics["stages"] if r["stage"] == "tree")
+        assert tr["landmark"] is True

@@ -53,12 +53,19 @@ APPROVED = {
     "ops/multipletests.py": {"np.asarray(": 1},
     "ops/negbin.py": {"np.asarray(": 2},
     "ops/pallas_kernels.py": {"np.asarray(": 6},
-    "ops/pooling.py": {"np.asarray(": 4},
+    # r7 landmark engine: +5 inside the landmark_assign_fetch boundary —
+    # jnp staging of the embedding/sketch/init gathers (3) and the two
+    # intended d2h fetches ((k, d) centroids + (N,) assignment)
+    "ops/pooling.py": {"np.asarray(": 9},
     "ops/silhouette.py": {"np.asarray(": 7},
-    "ops/treecut.py": {"np.asarray(": 2},
+    # r7 weighted cuts: +2 host-only conversions of the per-leaf weight
+    # vector (treecut is a host algorithm; no device arrays in scope)
+    "ops/treecut.py": {"np.asarray(": 4},
     "ops/treecut_direct.py": {"np.asarray(": 3},
     "ops/wilcoxon.py": {"np.asarray(": 1},
-    "models/pipeline.py": {"np.asarray(": 7, "np.array(": 1},
+    # r7: +3 host scalar wraps of the landmark telemetry (k, sketch,
+    # linkage code) for the artifact store — no device arrays involved
+    "models/pipeline.py": {"np.asarray(": 10, "np.array(": 1},
     "parallel/mesh.py": {"np.asarray(": 3, ".block_until_ready(": 1},
     "parallel/ring.py": {"np.asarray(": 11},
     "parallel/sharded_de.py": {"np.asarray(": 8, "jax.device_get": 2},

@@ -232,6 +232,32 @@ def cluster_table(quality: Dict[str, Any]) -> List[str]:
     if cs.get("input_entropy") is not None:
         out += ["", f"Input labeling: {cs.get('n_input_clusters')} "
                 f"clusters, entropy {_fmt(cs['input_entropy'])}"]
+    lm = cs.get("landmark")
+    if lm:
+        out += ["", "### Landmark recluster", "",
+                f"Branch taken: `{lm.get('branch')}` — k={lm.get('k')} "
+                f"landmarks (sketch {lm.get('sketch')}, "
+                f"{lm.get('linkage')} linkage"
+                + (f", threshold {lm.get('threshold'):,} cells"
+                   if lm.get("threshold") else "") + ")"]
+        ave = lm.get("ari_vs_exact")
+        if ave:
+            vals = [v for v in ave.values() if v is not None]
+            out += ["",
+                    "ARI vs the exact tree (verify run): "
+                    + ", ".join(f"{k}={_fmt(v)}" for k, v in ave.items())
+                    + (f" — min {_fmt(min(vals))}" if vals else "")]
+        else:
+            out += ["", "_No ARI-vs-exact stamp (production run — the "
+                    "pin is asserted on mid-size verify runs in tier-1; "
+                    "accuracy evidence here is ari_vs_input above)._"]
+        occ = lm.get("occupancy")
+        if occ:
+            out += ["", "Per-cut landmark occupancy: "
+                    + ", ".join(
+                        f"{k}={v.get('landmarks_assigned')}/"
+                        f"{v.get('n_landmarks')}"
+                        for k, v in occ.items())]
     return out
 
 

@@ -255,6 +255,42 @@ def _smoke(fixtures: str, as_json: bool) -> int:
         and not any(s.regressed for s in verdict_t.stages),
     ))
 
+    # landmark recluster gate (r7): a landmark run whose tree wall blows
+    # past the key's baseline must FAIL on the tree stage alone, with the
+    # offending landmark child span named
+    verdict_l, drifts_l = run_gate(
+        os.path.join(fixtures, "candidate_landmark_tree_regressed.json"),
+        evidence,
+    )
+    lreg = [s for s in verdict_l.regressions if s.stage == "tree"]
+    checks.append((
+        "landmark candidate with regressed tree wall fails on tree",
+        (not verdict_l.ok) and bool(lreg)
+        and not any(s.regressed for s in verdict_l.stages
+                    if s.stage != "tree"),
+    ))
+    checks.append((
+        "tree regression names the landmark child span",
+        bool(lreg) and bool(lreg[0].offender)
+        and "landmark" in str(lreg[0].offender.get("span", "")),
+    ))
+
+    # a landmark record that skips the ARI-vs-input accuracy evidence is
+    # a SCHEMA violation (the approximation must carry its own pin), not
+    # a gateable run
+    try:
+        run_gate(
+            os.path.join(fixtures, "candidate_landmark_missing_ari.json"),
+            evidence,
+        )
+        lm_rejected = False
+    except ValueError as e:
+        lm_rejected = "ari_vs_input" in str(e)
+    checks.append((
+        "landmark record missing ari_vs_input rejected by validation",
+        lm_rejected,
+    ))
+
     for label, ok in checks:
         print(f"[smoke] {'ok  ' if ok else 'FAIL'} {label}")
     ok_all = all(ok for _, ok in checks)

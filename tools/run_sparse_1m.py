@@ -1,25 +1,27 @@
-"""1M-cell sparse-in FULL-pipeline proof (VERDICT r4 #5; r6 refresh).
+"""1M-cell sparse-in FULL-pipeline proof (VERDICT r4 #5; r7 refresh).
 
-The brain1m bench config times the clustering tail only (pooled
-distance+linkage+cut+silhouette on an embedding). This runner exercises the
+The brain1m bench config times the clustering tail only (landmark
+recluster+cut+silhouette on an embedding). This runner exercises the
 never-densify contract (SURVEY.md §2b N12) at its design scale through the
 WHOLE product pipeline: sparse CSR 1M×G expression matrix → consensus →
 all-pairs DE (CSR-compacted window ladder, r6) → union → PCA embed →
-pooled Ward → dynamic cuts → pooled silhouette estimator → NODG — the
-path the reference densifies at R/reclusterDEConsensus.R:32 and must
-never be densified here. r6 changes vs the r5 artifact: the rank-sum
-ladder sorts ~nnz-wide CSR-compacted windows instead of full-N rows, and
-silhouette is REPORTED (pooled estimator) instead of skipped.
+landmark recluster (r7: sketch-fitted Lloyd, Ward on k ≪ N landmarks,
+device nearest-landmark cut propagation) → dynamic cuts → pooled
+silhouette estimator reusing the landmark pool → NODG — the path the
+reference densifies at R/reclusterDEConsensus.R:32 and must never be
+densified here. r7 change vs the r6 artifact: the tree stage's 11
+full-data Lloyd sweeps (396 s of the 676 s pipe) are replaced by the
+landmark engine above SCC_TREE_LANDMARK_THRESHOLD.
 
 The matrix is generated DIRECTLY in CSR form (per-gene nonzero draws;
-no dense intermediate at any point). Evidence artifact:
-SCALE_r06_cpu_<cells//1000>k_fullpipe_sparse.json with the stage dict,
-peak RSS, and the dense-equivalent size it never allocated. With
+no dense intermediate at any point). Evidence artifact: ingested into
+the ledger as SCALE_r07_cpu_<cells//1000>k_fullpipe_sparse.json with
+the stage dict, peak RSS, the dense-equivalent size it never allocated,
+and the numeric fingerprint (drift-gated via NUMERIC_PINS.json). With
 SCC_WILCOX_PROBE=1 the run is a synced occupancy DIAGNOSIS (per-bucket
-walls serialize dispatch) and additionally writes
-PROFILE_r06_wilcox_1m.json with the full window-ladder occupancy record.
+walls serialize dispatch) and instead writes a PROFILE_r07 record.
 
-Run:  python tools/run_sparse_1m.py           (CPU, ~30-60 min)
+Run:  python tools/run_sparse_1m.py           (CPU, ~6-10 min at 1M)
 Env:  SCC_1M_CELLS / SCC_1M_GENES override the shape (testing).
 """
 
@@ -107,19 +109,25 @@ def main() -> None:
 
     probed = bool(env_flag("SCC_WILCOX_PROBE"))
     base = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    from scconsensus_tpu.obs.ledger import default_evidence_dir
+
+    evidence = default_evidence_dir(base)
+    os.makedirs(evidence, exist_ok=True)
     if probed:
         # a probed wall is a diagnosis, not a benchmark: route the full
         # occupancy record to the PROFILE artifact and leave the SCALE
         # artifact to an unprobed run
-        out = os.path.join(
-            base, f"PROFILE_r06_wilcox_{n_cells//1000 // 1000}m.json"
+        name = (
+            f"PROFILE_r07_wilcox_{n_cells//1000 // 1000}m.json"
             if n_cells >= 1_000_000
-            else f"PROFILE_r06_wilcox_{n_cells//1000}k.json"
+            else f"PROFILE_r07_wilcox_{n_cells//1000}k.json"
         )
     else:
-        out = os.path.join(
-            base, f"SCALE_r06_cpu_{n_cells//1000}k_fullpipe_sparse.json"
-        )
+        name = f"SCALE_r07_cpu_{n_cells//1000}k_fullpipe_sparse.json"
+    # records land INSIDE the ledger now (r7): no root stray + relocate
+    # cycle, and the manifest entry carries the fingerprint/transfer
+    # stamps the perf gate compares future runs against
+    out = os.path.join(evidence, name)
 
     # Flight recorder: this driver runs 30-60 min and used to leave NOTHING
     # when killed. Heartbeats default ON here (SCC_OBS_HEARTBEAT still
@@ -158,7 +166,10 @@ def main() -> None:
           f"{consensus_s:.1f}s", flush=True)
 
     # r6: silhouette runs (pooled estimator reusing the tree stage's pool;
-    # the exact O(N²) path is only taken below approx_threshold)
+    # the exact O(N²) path is only taken below approx_threshold). r7: above
+    # SCC_TREE_LANDMARK_THRESHOLD (default 200k) the pooled tree path runs
+    # the landmark engine; between 50k and the landmark threshold the
+    # legacy full-data Lloyd runs byte-identically to r6.
     t0 = time.perf_counter()
     res = recluster_de_consensus_fast(
         mat, consensus,
@@ -188,9 +199,42 @@ def main() -> None:
     ]
     from scconsensus_tpu.obs.export import build_run_record, write_json_atomic
 
+    extra = {
+        "platform": jax.devices()[0].platform,
+        # dataset key for the ledger (run_key): probed runs key apart so
+        # their dispatch-serialized walls can never anchor baselines
+        "dataset": "sparse-fullpipe" + ("-probed" if probed else ""),
+        "n_cells": n_cells, "n_genes": n_genes,
+        "nnz_frac": round(nnz_frac, 4),
+        "gen_s": round(gen_s, 1),
+        "consensus_s": round(consensus_s, 1),
+        "stages": stages,
+        "union_size": int(res.de_gene_union_idx.size),
+        "deep_split_info": res.deep_split_info,
+        "peak_rss_gb": round(peak_rss_gb, 2),
+        "dense_equivalent_gb": round(dense_gb, 1),
+        "never_densified": bool(peak_rss_gb < dense_gb),
+        "silhouette": sil,
+        "total_wall_s": round(time.perf_counter() - t_all, 1),
+    }
+    try:
+        # numeric fingerprint (obs.regress): DE log-p quantiles + final-
+        # label ARI vs the input consensus — drift on future captures
+        # gates against the NUMERIC_PINS entry / previous clean run
+        from scconsensus_tpu.obs.regress import drift_fingerprint
+
+        fp = drift_fingerprint(log_p=res.de.log_p)
+        q = (res.metrics or {}).get("quality") or {}
+        ari = (q.get("cluster_structure") or {}).get("ari_vs_input") or {}
+        if ari:
+            fp["label_ari_vs_input"] = list(ari.values())[-1]
+        extra["numeric_fingerprint"] = fp
+    except Exception as e:
+        print(f"[1m] fingerprint failed: {e!r}", flush=True)
+
     record = build_run_record(
         metric=f"{n_cells//1000}k-cell sparse-in FULL pipeline "
-               "(consensus+DE+union+embed+pooled recluster"
+               "(consensus+DE+union+embed+landmark recluster"
                "+pooled silhouette+nodg) wall-clock"
                + (" PROBED (per-bucket syncs serialize dispatch)"
                   if probed else ""),
@@ -201,21 +245,7 @@ def main() -> None:
         quality=res.metrics.get("quality"),
         residency=res.metrics.get("residency"),
         kernels=res.metrics.get("kernels"),
-        extra={
-            "platform": jax.devices()[0].platform,
-            "n_cells": n_cells, "n_genes": n_genes,
-            "nnz_frac": round(nnz_frac, 4),
-            "gen_s": round(gen_s, 1),
-            "consensus_s": round(consensus_s, 1),
-            "stages": stages,
-            "union_size": int(res.de_gene_union_idx.size),
-            "deep_split_info": res.deep_split_info,
-            "peak_rss_gb": round(peak_rss_gb, 2),
-            "dense_equivalent_gb": round(dense_gb, 1),
-            "never_densified": bool(peak_rss_gb < dense_gb),
-            "silhouette": sil,
-            "total_wall_s": round(time.perf_counter() - t_all, 1),
-        },
+        extra=extra,
     )
     if probed:
         record["extra"]["occupancy"] = occupancy
@@ -225,7 +255,31 @@ def main() -> None:
         record["extra"]["occupancy_meta"] = {
             k: v for k, v in occupancy.items() if k != "buckets"
         }
-    write_json_atomic(out, record)
+    if probed:
+        # diagnosis artifact: written but never manifest-indexed — probed
+        # walls must not become baselines
+        write_json_atomic(out, record)
+    else:
+        from scconsensus_tpu.obs.ledger import Ledger
+
+        # first capture claims the round-stamped name; repeats take the
+        # ledger's timestamped default so ingest's same-name dedup can't
+        # eat the prior entry — per-key history must ACCUMULATE (that is
+        # what the gate's median-of-≤3 baselines and history_pins read)
+        try:
+            entry = Ledger(evidence).ingest(
+                record, name=None if os.path.exists(out) else name
+            )
+            out = os.path.join(evidence, entry["file"])
+        except ValueError as e:
+            # a record that fails schema/quality validation is EXACTLY
+            # the anomalous-run evidence worth keeping: write it un-
+            # indexed (never a baseline) instead of losing a 10-min run
+            print(f"[1m] record failed validation ({e}); writing "
+                  "un-indexed", file=sys.stderr, flush=True)
+            record["validation_error"] = str(e)
+            out = out.replace(".json", "_INVALID.json")
+            write_json_atomic(out, record)
     # Perfetto-openable sibling: the same spans as Chrome trace events
     from scconsensus_tpu.obs.export import write_chrome_trace
 
