@@ -146,6 +146,20 @@ def _trim_line(parsed: dict) -> str:
             ex["device_time_s"] = kern["total_device_time_s"]
         ex["truncated"] = True
         line = json.dumps(parsed)
+    # robustness section: the tail keeps the survival facts a driver must
+    # see (retry/fault counts + whether the run recovered); the full
+    # trail lives in the checkpoint + ledger record
+    if len(line) > 1500 and parsed.get("robustness"):
+        rb = parsed.pop("robustness")
+        ex = parsed.setdefault("extra", {})
+        for k in ("retries", "degradations", "faults_injected",
+                  "resume_points"):
+            if rb.get(k):
+                ex[f"robust_{k}"] = len(rb[k])
+        if rb.get("recovered"):
+            ex["robust_recovered"] = True
+        ex["truncated"] = True
+        line = json.dumps(parsed)
     # quality section next (funnel per-pair lists scale with K²): it
     # lives whole in the checkpoint + ledger record; the tail keeps only
     # the sentinel-trip count, the one quality fact a driver must see
@@ -302,6 +316,75 @@ def _emit_partial(record: dict) -> None:
         print(_trim_line(record), flush=True)
     except Exception as e:  # pragma: no cover - defensive
         log(f"[bench] partial emit failed: {e!r}")
+
+
+def _robust_section() -> "dict | None":
+    """The worker's in-process robustness trail (robust.record) for the
+    run record — None on healthy unfaulted runs, so the section's very
+    presence means something happened."""
+    try:
+        from scconsensus_tpu.robust import record as robust_record
+
+        return robust_record.section()
+    except Exception:
+        return None
+
+
+def _adapt_from_failure(failure: dict | None) -> "tuple[dict, str] | None":
+    """Cause-aware attempt adaptation (robust round): read the dead
+    attempt's termination cause + stderr signature and shape the NEXT
+    attempt — stall -> retry with a profiler capture armed (the r9 stall
+    watchdog then leaves a trace, not just a stack dump); resource
+    exhaustion -> retry degraded rather than re-OOM at full size.
+    Returns (env updates, reason) or None."""
+    if not failure:
+        return None
+    if failure.get("outcome") == "stall":
+        return ({"SCC_OBS_STALL_TRACE": "/tmp/scc_stall_capture"},
+                "stall -> retry with stall-capture armed")
+    try:
+        from scconsensus_tpu.robust.retry import classify_text
+
+        cls = classify_text(failure.get("stderr_tail"))
+    except Exception:
+        cls = None
+    if cls == "resource":
+        return ({"SCC_BENCH_DEGRADED": "1"},
+                "resource exhaustion -> retry degraded")
+    return None
+
+
+def _poison_path() -> str:
+    name = env_flag("SCC_BENCH_CONFIG")
+    return os.path.join(_evidence_dir(), f"POISON_{name}.json")
+
+
+def _poison_config(failures: list) -> dict:
+    """Repeated-crash poisoning: two crash-class attempt failures mean
+    the config itself is broken (not the box, not the tunnel) — record a
+    named reason in evidence/ so operators and the next orchestrator see
+    WHY the ladder stopped early, instead of burning every window
+    re-crashing."""
+    reason = {
+        "config": env_flag("SCC_BENCH_CONFIG"),
+        "reason": "repeated crash: "
+                  + "; ".join(
+                      f"{f.get('attempt')}: rc={f.get('rc')}"
+                      for f in failures if f.get("outcome") == "error"
+                  ),
+        "failures": failures[-_MAX_FAILURES:],
+        "poisoned_unix": round(time.time(), 1),
+    }
+    try:
+        os.makedirs(_evidence_dir(), exist_ok=True)
+        from scconsensus_tpu.obs.export import write_json_atomic
+
+        write_json_atomic(_poison_path(), reason)
+        log(f"[bench] config POISONED: {reason['reason']} "
+            f"({_poison_path()})")
+    except Exception as e:
+        log(f"[bench] poison write failed: {e!r}")
+    return reason
 
 
 def _record_value(record: dict | None) -> float:
@@ -899,6 +982,7 @@ def _worker_body() -> None:
                              if secs and not reduced else None),
                 extra=extra,
                 spans=b1m_state.get("spans") or [],
+                robustness=_robust_section(),
             )
 
         b1m_state = {"secs": None, "phase": "cold", "spans": None}
@@ -952,7 +1036,8 @@ def _worker_body() -> None:
         n_cells = cfg["n_cells"]
         size = f"{n_cells // 1000}k" if n_cells >= 1000 else str(n_cells)
         state = {"edger": None, "wilcox": None, "spans": None,
-                 "quality": None, "residency": None, "kernels": None}
+                 "quality": None, "residency": None, "kernels": None,
+                 "robustness": None}
 
         def _record():
             """Cumulative flagship record from whatever has finished."""
@@ -993,6 +1078,10 @@ def _worker_body() -> None:
                 quality=state.get("quality"),
                 residency=state.get("residency"),
                 kernels=state.get("kernels"),
+                # completed run's trail, else the LIVE trail (a SIGTERM
+                # partial must carry the faults/retries of the run it
+                # interrupted, not of the previous one)
+                robustness=state.get("robustness") or _robust_section(),
             )
 
         def _ckpt():
@@ -1018,9 +1107,12 @@ def _worker_body() -> None:
             extra["edger_cold_s"] = round(cold_s, 3)
             # cold spans so a COLD record (or a SIGTERM before steady-state
             # lands) still carries a span tree; steady overwrites below.
-            # Keep only the spans — the full cold result must not stay
-            # resident through the measured steady run
+            # Keep only the spans + robustness trail — the full cold
+            # result must not stay resident through the measured steady
+            # run. Cold-run recovery evidence matters: a one-shot fault
+            # plan usually fires (and is survived) in the cold run only.
             state["spans"] = cold_res.metrics.get("spans")
+            state["robustness"] = cold_res.metrics.get("robustness")
             del cold_res
             if env_flag("SCC_BENCH_COLD"):
                 return cold_s
@@ -1038,6 +1130,10 @@ def _worker_body() -> None:
             state["quality"] = result.metrics.get("quality")
             state["residency"] = result.metrics.get("residency")
             state["kernels"] = result.metrics.get("kernels")
+            # a healthy steady run (None) must not erase the cold run's
+            # recovery evidence
+            state["robustness"] = (result.metrics.get("robustness")
+                                   or state["robustness"])
             return elapsed
 
         state["edger"] = _section(extra, "edger", _edger)
@@ -1046,8 +1142,12 @@ def _worker_body() -> None:
         # secondary: fast-path wilcox at the same scale
         def _wilcox():
             once_fast = run_refine_config(**cfg, method="wilcox", **refine_kw)
-            fast_cold, _ = once_fast()
+            fast_cold, cold_fast_res = once_fast()
             extra["wilcox_cold_s"] = round(fast_cold, 3)
+            if not state["robustness"]:
+                state["robustness"] = cold_fast_res.metrics.get(
+                    "robustness")
+            del cold_fast_res
             _ckpt()
             fast_s, fast_res = once_fast()
             log(f"[bench] wilcox fast-path steady-state: {fast_s:.2f}s")
@@ -1069,6 +1169,8 @@ def _worker_body() -> None:
                 state["residency"] = fast_res.metrics.get("residency")
             if not state["kernels"]:
                 state["kernels"] = fast_res.metrics.get("kernels")
+            if not state["robustness"]:
+                state["robustness"] = fast_res.metrics.get("robustness")
             return fast_s
 
         state["wilcox"] = _section(extra, "wilcox", _wilcox)
@@ -1109,10 +1211,13 @@ def _worker_body() -> None:
             quality=refine_state.get("quality"),
             residency=refine_state.get("residency"),
             kernels=refine_state.get("kernels"),
+            robustness=(refine_state.get("robustness")
+                        or _robust_section()),
         )
 
     refine_state = {"secs": None, "phase": "cold", "spans": None,
-                    "quality": None, "residency": None, "kernels": None}
+                    "quality": None, "residency": None, "kernels": None,
+                    "robustness": None}
     _install_term_handler(lambda: _refine_record(refine_state["secs"]))
     if _LIVE is not None:
         _LIVE.record_fn = lambda: _refine_record(refine_state["secs"])
@@ -1125,6 +1230,7 @@ def _worker_body() -> None:
     # steady run
     refine_state["spans"] = cold_res.metrics.get("spans")
     refine_state["quality"] = cold_res.metrics.get("quality")
+    refine_state["robustness"] = cold_res.metrics.get("robustness")
     del cold_res
     if env_flag("SCC_BENCH_COLD"):
         elapsed = cold_s
@@ -1138,6 +1244,10 @@ def _worker_body() -> None:
         refine_state["quality"] = result.metrics.get("quality")
         refine_state["residency"] = result.metrics.get("residency")
         refine_state["kernels"] = result.metrics.get("kernels")
+        # a healthy steady run (None) must not erase the cold run's
+        # recovery evidence (one-shot fault plans fire in the cold run)
+        refine_state["robustness"] = (result.metrics.get("robustness")
+                                      or refine_state["robustness"])
         refine_state["phase"] = "steady"
         log(f"[bench] steady-state run: {elapsed:.2f}s; union="
             f"{result.de_gene_union_idx.size} genes; "
@@ -1619,7 +1729,13 @@ def main() -> None:
                                       "SCC_BENCH_DEGRADED": "1"}, 2400)]
 
     failures = []
+    adaptations: list = []
+    adapt_env: dict = {}
+    poison = None
     for label, env_over, timeout_s in plan:
+        # cause-aware ladder (robust round): adaptations earned by earlier
+        # failures ride every later attempt (stall capture, degraded size)
+        env_over = {**env_over, **adapt_env}
         accel_attempt = not _is_cpu_attempt(env_over)
         if (failures and accel_attempt
                 and failures[-1].get("outcome") == "stall"):
@@ -1664,21 +1780,59 @@ def main() -> None:
                                    **parsed.get("extra", {})}
                 if not parsed.get("spans") and disk.get("spans"):
                     parsed["spans"] = disk["spans"]
+                for sec in ("robustness",):
+                    if not parsed.get(sec) and disk.get(sec):
+                        parsed[sec] = disk[sec]
+            if failures or adaptations:
+                # the attempt ladder's own recovery story rides the
+                # validated robustness section (orchestration sub-object)
+                rb = parsed.get("robustness") or {}
+                rb["orchestration"] = {
+                    "attempts": [
+                        {"attempt": f.get("attempt"),
+                         "outcome": f.get("outcome")} for f in failures
+                    ] + [{"attempt": label, "outcome": "ok"}],
+                    "adaptations": adaptations,
+                }
+                parsed["robustness"] = rb
             _write_ckpt(parsed)
             print(_trim_line(parsed))
             _ingest_evidence(parsed)
             return
         failures.append(failure)
         log(f"[bench] attempt '{label}' failed: {failure['outcome']}")
+        if len([f for f in failures if f.get("outcome") == "error"]) >= 2:
+            # two crash-class failures: the config is broken, not the
+            # box — poison it with a named reason instead of burning the
+            # remaining windows re-crashing
+            poison = _poison_config(failures)
+            break
+        adapt = _adapt_from_failure(failure)
+        if adapt is not None:
+            adapt_env.update(adapt[0])
+            adaptations.append({"after": label, "reason": adapt[1],
+                                "env": adapt[0]})
+            log(f"[bench] cause-aware adaptation: {adapt[1]}")
 
     # Every attempt failed. If any attempt left a value<=0 partial, surface
     # the freshest checkpoint's extras (platform, cold numbers) in the
     # failure record; then emit a structured line, never a traceback.
     rec = build_run_record(
-        metric="bench failed on every attempt (see extra.failures)",
+        metric=("bench config poisoned after repeated crashes "
+                "(see extra.poisoned)" if poison is not None
+                else "bench failed on every attempt (see extra.failures)"),
         value=-1,
         extra={"failures": failures[-_MAX_FAILURES:]},
     )
+    if poison is not None:
+        rec["extra"]["poisoned"] = {"config": poison["config"],
+                                    "reason": poison["reason"]}
+    if failures or adaptations:
+        rec["robustness"] = {"orchestration": {
+            "attempts": [{"attempt": f.get("attempt"),
+                          "outcome": f.get("outcome")} for f in failures],
+            "adaptations": adaptations,
+        }}
     if probe is not None:
         rec["extra"]["backend_probe"] = probe
     best = _read_ckpt(t_start)

@@ -124,6 +124,38 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "at any N and run the pre-r7 behavior (full-data pooled "
                 "Lloyd above approx_threshold, exact Ward below) — the "
                 "escape hatch if a landmark cut looks wrong."),
+        # --- robustness (robust/) ---
+        EnvFlag("SCC_FAULT_PLAN", str, None,
+                "Path to a JSON fault-injection plan (robust.faults): "
+                "deterministic, seeded injection of named fault classes "
+                "(oom|transient|kill|stall|corrupt) at named sites — "
+                "pipeline stage boundaries, wilcox ladder buckets, "
+                "artifact writes. Unset = no injection (and near-zero "
+                "overhead at every fault point)."),
+        EnvFlag("SCC_ROBUST_BUDGET", int, 16,
+                "Per-run retry budget shared by every robust.retry call "
+                "site: once this many retries have been consumed, further "
+                "transient/resource failures re-raise instead of "
+                "retrying (a retry storm becomes a clean failure)."),
+        EnvFlag("SCC_ROBUST_BACKOFF_S", float, 0.05,
+                "Base backoff for robust.retry's exponential ladder "
+                "(attempt n sleeps base*2^(n-1), capped, with "
+                "deterministic +0-50% jitter). Tests shrink it; real "
+                "device recovery may want 0.5-2 s."),
+        EnvFlag("SCC_ROBUST_CHECKSUM", bool, True,
+                "Content checksums on ArtifactStore artifacts: every "
+                "save stamps a sha256 into the stage sidecar and every "
+                "load verifies it — corrupt/truncated entries are "
+                "QUARANTINED (renamed *.quarantined) and recomputed "
+                "instead of crashing or silently loading garbage. Set 0 "
+                "to skip verification (trusted store, max throughput)."),
+        EnvFlag("SCC_ROBUST_DE_CKPT", bool, True,
+                "Mid-stage wilcox checkpointing: with an artifact store "
+                "active, each completed window-ladder bucket persists "
+                "its (log_p, u, ties) block so a kill mid-stage resumes "
+                "from completed buckets instead of recomputing the whole "
+                "DE stage. Set 0 to disable (store-less runs are always "
+                "unaffected)."),
         # --- DE engine ---
         EnvFlag("SCC_WILCOX_PROBE", bool, False,
                 "Synced per-bucket occupancy DIAGNOSIS of the Wilcoxon "

@@ -408,6 +408,171 @@ def _redo_overflow_dense(outs, overflow, data, gc, jdata, jcid, jn, jpi,
         ))
 
 
+class _WilcoxCkpt:
+    """Mid-stage checkpoint handle for the wilcox window ladder.
+
+    Each completed ladder bucket persists its (log_p, u, ties[, n_runs])
+    block into the pipeline's ArtifactStore under a content-addressed
+    stage name (``de_wilcox_<sha>``: gene ids + window + kernel variant),
+    so a SIGKILL mid-stage resumes from completed buckets instead of
+    recomputing the whole DE stage — at 1M cells the stage is 59 % of
+    the remaining wall and was all-or-nothing. The blocks are deleted by
+    the pipeline once the covering ``de`` artifact lands; content
+    addressing means a degraded re-entry (different block decomposition)
+    can never resume the wrong genes. Gated by ``SCC_ROBUST_DE_CKPT``
+    and only ever active when the run has an artifact store.
+    """
+
+    PREFIX = "de_wilcox_"
+
+    def __init__(self, store):
+        self.store = store
+        self.resumed = 0
+
+    def key(self, ids: np.ndarray, window: int, variant: str) -> str:
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(ids, np.int64).tobytes())
+        h.update(f":{window}:{variant}".encode())
+        return f"{self.PREFIX}{h.hexdigest()[:16]}"
+
+    def load(self, key: str):
+        """(lp, u, ts, nr|None) as device arrays, or None (absent or
+        quarantined-corrupt — recompute either way)."""
+        from scconsensus_tpu.utils.artifacts import ArtifactCorrupt
+
+        if not self.store.has(key):
+            return None
+        try:
+            arrays, _ = self.store.load(key)
+        except ArtifactCorrupt:
+            return None
+        if not all(k in arrays for k in ("lp", "u", "ts")):
+            return None
+        out = (jnp.asarray(arrays["lp"]), jnp.asarray(arrays["u"]),
+               jnp.asarray(arrays["ts"]))
+        nr = (jnp.asarray(arrays["nr"]) if "nr" in arrays else None)
+        self.resumed += 1
+        return out, nr
+
+    def save(self, key: str, ids_n: int, out, nr) -> None:
+        """Persist one completed bucket (trimmed to the real gene rows).
+        The (Gb, P) fetch is a declared residency crossing — the cost of
+        mid-stage durability, paid only when a store is active."""
+        from scconsensus_tpu.obs.residency import boundary as _rbound
+
+        arrays = {}
+        with _rbound("de_ckpt_fetch"):
+            lp, u, ts = jax.device_get(
+                (out[0][:ids_n], out[1][:ids_n], out[2][:ids_n])
+            )
+            arrays = {"lp": np.asarray(lp), "u": np.asarray(u),
+                      "ts": np.asarray(ts)}
+            if nr is not None:
+                arrays["nr"] = np.asarray(jax.device_get(nr[:ids_n]))
+        self.store.save(key, arrays)
+
+
+class _LadderRecovery:
+    """Loop-level typed recovery for the wilcox window ladder.
+
+    Used as ``with recover, obs_trace.span("wilcox_bucket", ...):`` — on
+    an Exception escaping the bucket it classifies (robust.retry), and
+    when admissible suppresses the exception, sets ``retry`` (the loop
+    re-enters at the same g0 — i.e. from the last completed bucket), and
+    for resource-class failures doubles ``budget_div``, adaptively
+    halving every later block's element budget. Fatal errors, exhausted
+    per-bucket attempts, and an exhausted per-run budget re-raise.
+    KeyboardInterrupt/SystemExit pass through untouched.
+    """
+
+    MAX_BUCKET_ATTEMPTS = 4
+    MAX_BUDGET_DIV = 64
+
+    def __init__(self, site: str = "wilcox_bucket"):
+        from scconsensus_tpu.robust import retry as robust_retry
+
+        self.site = site
+        self.budget_div = 1
+        self.attempt = 0          # retries consumed by the current bucket
+        self.backoff_total = 0.0
+        self.retry = False
+        self.err_class: Optional[str] = None
+        self._policy = robust_retry.default_policy()
+
+    def bucket_done(self) -> None:
+        """Called when the current bucket lands: close out its retry
+        bookkeeping (a recovered bucket records one aggregated entry)."""
+        from scconsensus_tpu.robust import record as robust_record
+
+        if self.attempt:
+            robust_record.note_retry(
+                self.site, self.err_class or "transient", self.attempt + 1,
+                recovered=True, backoff_s=self.backoff_total,
+            )
+        self.attempt = 0
+        self.backoff_total = 0.0
+
+    def __enter__(self):
+        self.retry = False
+        return self
+
+    def __exit__(self, et, ev, tb) -> bool:
+        import time as _time
+
+        from scconsensus_tpu.obs import trace as obs_trace
+        from scconsensus_tpu.robust import record as robust_record
+        from scconsensus_tpu.robust import retry as robust_retry
+
+        if et is None or not issubclass(et, Exception):
+            return False
+        err_class = robust_retry.classify_exception(ev)
+        run = robust_record.current_run()
+        if (err_class == "fatal"
+                or self.attempt >= self.MAX_BUCKET_ATTEMPTS
+                or not run.budget_take()):
+            if err_class != "fatal":
+                robust_record.note_retry(
+                    self.site, err_class, self.attempt + 1,
+                    recovered=False, backoff_s=self.backoff_total,
+                )
+            return False
+        self.attempt += 1
+        self.err_class = err_class
+        if (err_class == "resource"
+                and self.budget_div < self.MAX_BUDGET_DIV):
+            self.budget_div *= 2
+            robust_record.note_degradation(
+                self.site, "halve-chunk-budget",
+                f"element budget /{self.budget_div} after "
+                f"{et.__name__}; re-entering from the last completed "
+                "bucket",
+            )
+        backoff = self._policy.backoff_s(self.site, self.attempt)
+        self.backoff_total += backoff
+        sp = obs_trace.current_span()
+        if sp is not None:
+            sp.metrics.counter("robust_retries").add(1)
+        with obs_trace.span(
+            "robust_retry", site=self.site, error_class=err_class,
+            attempt=self.attempt, backoff_s=round(backoff, 4),
+        ):
+            _time.sleep(backoff)
+        self.retry = True
+        return True
+
+
+def _wilcox_ckpt_for(config_store) -> Optional[_WilcoxCkpt]:
+    """The ladder's checkpoint handle: store present + flag on."""
+    from scconsensus_tpu.config import env_flag
+
+    if (config_store is not None and getattr(config_store, "enabled", False)
+            and env_flag("SCC_ROBUST_DE_CKPT")):
+        return _WilcoxCkpt(config_store)
+    return None
+
+
 def _window_floor(n_cells: int) -> int:
     """Window-ladder floor: 1024 bounds the distinct compiled shapes (cold
     compiles cross the remote-compile tunnel) and scans below 1k lanes are
@@ -427,6 +592,7 @@ def _run_wilcox_device(
     mesh=None,
     jdata=None,
     probe_out: Optional[Dict] = None,
+    ckpt: Optional[_WilcoxCkpt] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Rank-sum for every (pair, gene) via the all-pairs sorted-cumsum
     engine (ops.ranksum_allpairs — one sort per gene, zero per-pair
@@ -462,6 +628,13 @@ def _run_wilcox_device(
     (serializes dispatch — diagnosis runs only), and tied-run counts + a
     separate sort-only timing are fetched per bucket so sort cost is
     split out of the contraction attribution.
+
+    ``ckpt``: optional :class:`_WilcoxCkpt` — each completed ladder
+    bucket persists its output block so a killed run resumes from
+    completed buckets (robust round: mid-stage checkpoint/resume). The
+    ladder additionally runs under :class:`_LadderRecovery`: transient
+    failures retry a bucket in place, RESOURCE_EXHAUSTED halves the
+    element budget and re-enters from the last completed bucket.
     """
     import time
 
@@ -567,11 +740,23 @@ def _run_wilcox_device(
                 rows = jnp.pad(rows, ((0, pad_to - ids_bad.size), (0, 0)))
             return rows, jcid, window
 
+        # Typed recovery for the ladder (robust.retry policy semantics,
+        # as a loop-level context manager because recovery here means
+        # RE-ENTERING the loop at the last completed bucket): a
+        # resource-class failure halves the element budget (-> smaller
+        # gene blocks, smaller sort buffers) and retries from g0; a
+        # transient failure retries the bucket unchanged; fatal
+        # re-raises. Every retry burns the per-run budget and is
+        # recorded as a span event + counter.
+        recover = _LadderRecovery()
         parts = []  # (gene_ids, (log_p, u, ties)) in sorted-gene order
         overflow = []  # (part idx, ids, window, device n_runs)
         t_ladder = time.perf_counter()
         g0 = 0
         while g0 < G:
+            elem_budget = max(
+                _ALLPAIRS_ELEM_BUDGET // recover.budget_div, 1 << 12
+            )
             w = int(min(_next_pow2(max(int(nnz_sorted[g0]), floor)),
                         _next_pow2(N)))
             # the width every (Gc, K, ·) scan/contraction tensor runs at:
@@ -584,8 +769,8 @@ def _run_wilcox_device(
             # kernel tensors and the (gcb, sort_w) sort buffers — w·K alone
             # ignores the sort and could pad a small-K run to a >10 GB sort.
             gcb = max(8, min(
-                _ALLPAIRS_ELEM_BUDGET // max(scan_w * K, 1),
-                (_ALLPAIRS_ELEM_BUDGET // 2) // max(sort_w, 1),
+                elem_budget // max(scan_w * K, 1),
+                (elem_budget // 2) // max(sort_w, 1),
             ))
             gcb = 1 << (int(gcb).bit_length() - 1)
             gcb = min(gcb, _next_pow2(G))
@@ -602,10 +787,38 @@ def _run_wilcox_device(
             # compile crosses the remote-compile tunnel (cf. the window
             # floor above)
             gcb_eff = min(gcb, _next_pow2(max(int(ids.size), 256)))
+            # Mid-stage resume: a bucket persisted by a prior (killed)
+            # run short-circuits here. Content-addressed keys (gene ids
+            # + window + kernel variant), so a degraded re-entry with
+            # different block boundaries can only hit blocks holding
+            # exactly these genes at this window.
+            weff_pre = w if compact else (w if w < N else 0)
+            ck_key = None
+            if ckpt is not None:
+                ck_key = ckpt.key(
+                    ids, weff_pre,
+                    "mesh" if mesh is not None
+                    else "runspace" if use_runspace else "scan",
+                )
+                cached_part = ckpt.load(ck_key)
+                if cached_part is not None:
+                    out, nr_cached = cached_part
+                    parts.append((ids, out))
+                    if use_runspace and nr_cached is not None:
+                        overflow.append(
+                            (len(parts) - 1, ids, weff_pre, nr_cached)
+                        )
+                    g0 = g1
+                    recover.bucket_done()
+                    continue
+            nr_b = None
             t_bucket = time.perf_counter()
-            with obs_trace.span(
+            with recover, obs_trace.span(
                 "wilcox_bucket", window=int(w), n_genes=int(ids.size),
             ) as bspan:
+                from scconsensus_tpu.robust.faults import fault_point
+
+                fault_point("wilcox_bucket")
                 if compact:
                     vals, wcid = csr_window_rows(
                         data, ids, w, cid, pad_rows=gcb_eff
@@ -642,7 +855,10 @@ def _run_wilcox_device(
                         rows, kcid, jn, jpi, jpj, K, window=weff,
                     )
                     out = (lp_b, u_b, ts_b)
-                    overflow.append((len(parts), ids, weff, nr_b))
+                    # overflow entry appended AFTER the recovery check
+                    # below: a retried bucket must not leave a stale
+                    # (idx, ids, nr) that the redo would splice into the
+                    # re-entered (possibly smaller) block
                 else:
                     attach_cost(bspan, allpairs_ranksum_chunk,
                                 rows, kcid, jn, jpi, jpj, K, window=weff)
@@ -709,8 +925,40 @@ def _run_wilcox_device(
                                 brec["tied_runs_p50"] = int(np.median(nr))
                                 brec["tied_runs_max"] = int(nr.max())
                     probe["buckets"].append(brec)
+            if recover.retry:
+                # recovered failure: re-enter at the same g0 (the last
+                # completed bucket) with the possibly-halved budget
+                continue
+            if ckpt is not None:
+                try:
+                    ckpt.save(ck_key, int(ids.size), out,
+                              nr_b if use_runspace else None)
+                except Exception as e:
+                    # the durability feature must never become a new
+                    # fatal failure mode: a full disk / unwritable store
+                    # skips THIS block's checkpoint and the ladder keeps
+                    # computing (resume just recomputes the block)
+                    from scconsensus_tpu.robust import (
+                        record as robust_record,
+                    )
+
+                    robust_record.note_degradation(
+                        "wilcox_bucket", "ckpt-skip",
+                        f"bucket checkpoint write failed ({e!r}); "
+                        "continuing without mid-stage durability for "
+                        "this block",
+                    )
+            if use_runspace and nr_b is not None:
+                overflow.append((len(parts), ids, weff, nr_b))
             parts.append((ids, out))
             g0 = g1
+            recover.bucket_done()
+        if ckpt is not None and ckpt.resumed:
+            from scconsensus_tpu.robust import record as robust_record
+
+            robust_record.note_resume_point(
+                "wilcox_test", "bucket", ckpt.resumed, len(parts)
+            )
         if use_runspace and overflow:
             _redo_overflow_genes(
                 parts, overflow, refetch, jn, jpi, jpj, K, RUN_CAP,
@@ -845,6 +1093,7 @@ def pairwise_de(
     config: ReclusterConfig,
     timer=None,
     mesh=None,
+    store=None,
 ) -> PairwiseDEResult:
     """Run the configured all-pairs DE test.
 
@@ -852,6 +1101,11 @@ def pairwise_de(
     ``mesh``: optional jax.sharding.Mesh — the rank-sum gene chunks shard
     across it (the product pipeline's dp analog of the reference's
     doParallel fan-out, R/reclusterDEConsensusFast.R:61-65).
+    ``store``: optional ArtifactStore — with one active (and
+    SCC_ROBUST_DE_CKPT on), the wilcox window ladder persists each
+    completed bucket so a kill mid-stage resumes from completed buckets;
+    the pipeline discards the blocks once the covering ``de`` artifact
+    lands.
     """
     from scconsensus_tpu.io.sparsemat import as_csr, is_jax, is_sparse, mean_expm1
     from scconsensus_tpu.utils.logging import StageTimer
@@ -1024,6 +1278,7 @@ def pairwise_de(
                 log_p, u_dev = _run_wilcox_device(
                     data, cell_idx_of, pair_i, pair_j,
                     mesh=mesh, jdata=jdata, probe_out=srec,
+                    ckpt=_wilcox_ckpt_for(store),
                 )
             if method == "roc":
                 # The reference's roc branch never produces a p-value usable

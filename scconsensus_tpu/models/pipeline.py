@@ -22,6 +22,8 @@ from scconsensus_tpu.config import CompatFlags, ReclusterConfig
 from scconsensus_tpu.de import de_gene_union, pairwise_de
 from scconsensus_tpu.obs import quality as obs_quality
 from scconsensus_tpu.obs import residency
+from scconsensus_tpu.robust import record as robust_record
+from scconsensus_tpu.robust import retry as robust_retry
 from scconsensus_tpu.de.engine import PairwiseDEResult
 from scconsensus_tpu.ops.colors import labels_to_colors
 from scconsensus_tpu.ops.linkage import HClustTree, ward_linkage
@@ -98,6 +100,10 @@ def refine(
     from scconsensus_tpu.obs import residency as obs_residency
     from scconsensus_tpu.obs.kernels import KernelCapture
 
+    # fresh robustness trail for this run (robust.record): stage-boundary
+    # retries, ladder degradations, mid-stage resume points, and any
+    # SCC_FAULT_PLAN injections all land on result.metrics["robustness"]
+    robust_record.begin_run()
     capture = KernelCapture()
     if timer is None:
         # the kernel join needs TraceAnnotation windows in the profiler
@@ -125,6 +131,10 @@ def refine(
         result.metrics["transfers"] = watch.report()
     if auditor is not None:
         result.metrics["residency"] = auditor.report()
+    rb_section = robust_record.section()
+    if rb_section is not None:
+        # absent on healthy unfaulted runs — absence IS the healthy signal
+        result.metrics["robustness"] = rb_section
     if capture.enabled:
         try:
             from scconsensus_tpu.obs.cost import stage_cost_summary
@@ -214,21 +224,43 @@ def _refine_impl(
         from scconsensus_tpu.utils.artifacts import input_fingerprint
 
         store.check_config(config.to_json(), inputs=input_fingerprint(data, labels))
+    # Stage-boundary recovery (robust.retry): each stage's compute runs
+    # under the typed policy — transient/resource faults (injected or
+    # real) retry with backoff instead of killing the run; ValueError &
+    # co. stay fatal and propagate exactly as before. The fault plan's
+    # ``stage:<name>`` sites fire at each attempt's entry.
+    _guard = robust_retry.call
+
     de_res = None
     if store.has("de"):
         try:
+            # ArtifactCorrupt (checksum mismatch / truncated zip) is a
+            # ValueError: the store has already quarantined the files,
+            # and the stage recomputes below
             de_res = PairwiseDEResult.from_store(*store.load("de"))
             logger.info("stage de: resumed from artifact store")
         except ValueError as e:
             logger.warning("stage de: artifact unusable (%s); recomputing", e)
     if de_res is None:
-        de_res = pairwise_de(data, labels, config, timer=timer, mesh=mesh)
+        de_res = _guard(
+            lambda: pairwise_de(data, labels, config, timer=timer,
+                                mesh=mesh, store=store),
+            site="stage:de",
+        )
         if store.enabled:  # to_store() materializes every lazy device field
             store.save("de", *de_res.to_store())
+            # the covering artifact landed: the ladder's mid-stage
+            # checkpoint blocks have served their purpose
+            store.discard_prefix("de_wilcox_")
 
     with timer.stage("union") as rec:
-        union = store.cached(
-            "union", lambda: {"idx": de_gene_union(de_res, config.n_top_de_genes)}
+        union = _guard(
+            lambda: store.cached(
+                "union",
+                lambda: {"idx": de_gene_union(de_res,
+                                              config.n_top_de_genes)},
+            ),
+            site="stage:union",
         )["idx"]
         rec["union_size"] = int(union.size)
         rec["per_pair_de_counts"] = de_res.de_counts().tolist()
@@ -270,7 +302,22 @@ def _refine_impl(
             with residency.boundary("embed_scores_fetch"):
                 return {"scores": np.asarray(scores)}
 
-        embedding = store.cached("embed", _embed)["scores"]
+        def _embed_degrade(_attempt):
+            # RESOURCE_EXHAUSTED in embed: free the pinned upload cache
+            # before the retry — the (N, |U|) gather + PCA scratch is
+            # usually what tipped HBM over
+            from scconsensus_tpu.utils.devcache import clear_cache
+
+            clear_cache()
+            robust_record.note_degradation(
+                "stage:embed", "evict-devcache",
+                "dropped pinned device buffers before PCA retry",
+            )
+
+        embedding = _guard(
+            lambda: store.cached("embed", _embed),
+            site="stage:embed", degrade=_embed_degrade,
+        )["scores"]
         if obs_quality.enabled():
             # a NaN/Inf PCA score silently corrupts every downstream
             # distance/tree/cut — trip here, span-attributed
@@ -340,7 +387,8 @@ def _refine_impl(
             t = ward_linkage(embedding)
             return {"merge": t.merge, "height": t.height, "order": t.order}
 
-        tree_arrays = store.cached("tree", _tree)
+        tree_arrays = _guard(lambda: store.cached("tree", _tree),
+                             site="stage:tree")
         tree = HClustTree(
             merge=tree_arrays["merge"],
             height=tree_arrays["height"],
@@ -411,7 +459,8 @@ def _refine_impl(
                 out[f"ds{dsv}"] = cut_labels
             return out
 
-        cut_arrays = store.cached("cuts", _cuts)
+        cut_arrays = _guard(lambda: store.cached("cuts", _cuts),
+                            site="stage:cuts")
         for dsv in config.deep_split_values:
             cut_labels = cut_arrays[f"ds{dsv}"]
             key = f"deepsplit: {dsv}"
@@ -467,53 +516,65 @@ def _refine_impl(
                          dynamic_labels[f"deepsplit: {dsv}"], -1)
                 for dsv in config.deep_split_values
             ]
-            if mesh is not None:
-                for info, lab in zip(deep_split_info, labs):
-                    si, _per = mean_cluster_silhouette(
-                        embedding, lab, mesh=mesh
+            # recovery wrapper: the branch ladder runs as _silhouette()
+            # under the typed retry policy — idempotent (it only assigns
+            # per-cut info keys), so a transient-fault retry recomputes
+            # cleanly
+            def _silhouette():
+                if mesh is not None:
+                    for info, lab in zip(deep_split_info, labs):
+                        si, _per = mean_cluster_silhouette(
+                            embedding, lab, mesh=mesh
+                        )
+                        info["silhouette"] = si
+                elif approx_si:
+                    # Past the approx threshold the exact O(N²) pass is
+                    # the pipeline's scale tail (154 s at 100k; outright
+                    # skipped at 1M in r5) — the pooled O(N·m) estimator
+                    # reuses the tree stage's pool when one exists, so
+                    # the 1M artifact reports a quality number for the
+                    # cost of an (N, m) matmul stream.
+                    from scconsensus_tpu.ops.silhouette import (
+                        pooled_multi_cut_silhouette,
                     )
-                    info["silhouette"] = si
-            elif approx_si:
-                # Past the approx threshold the exact O(N²) pass is the
-                # pipeline's scale tail (154 s at 100k; outright skipped at
-                # 1M in r5) — the pooled O(N·m) estimator reuses the tree
-                # stage's pool when one exists, so the 1M artifact reports
-                # a quality number for the cost of an (N, m) matmul stream.
-                from scconsensus_tpu.ops.silhouette import (
-                    pooled_multi_cut_silhouette,
-                )
 
-                sil_rec["method"] = "pooled-estimator"
-                sil_rec["n_centroids"] = (
-                    int(pool_centroids.shape[0]) if pool_centroids is not None
-                    else config.silhouette_pool_centroids
-                )
-                # single-pooling contract: with a tree-stage pool (legacy
-                # or landmark) the estimator prices neighbors at THOSE
-                # centroids — zero extra k-means (span pool_builds
-                # counters assert this in tier-1)
-                sil_rec["pool_reused"] = pool_centroids is not None
-                for info, (si, _per) in zip(
-                    deep_split_info,
-                    pooled_multi_cut_silhouette(
-                        embedding, labs,
-                        n_centroids=config.silhouette_pool_centroids,
-                        seed=config.random_seed,
-                        centroids=pool_centroids,
-                        assign=pool_assign,
-                        sample=config.silhouette_sample,
-                    ),
-                ):
-                    info["silhouette"] = si
-                    info["silhouette_method"] = "pooled-estimator"
-            else:
-                # all cuts share one N² distance pass (multi_cut_silhouette)
-                from scconsensus_tpu.ops.silhouette import multi_cut_silhouette
+                    sil_rec["method"] = "pooled-estimator"
+                    sil_rec["n_centroids"] = (
+                        int(pool_centroids.shape[0])
+                        if pool_centroids is not None
+                        else config.silhouette_pool_centroids
+                    )
+                    # single-pooling contract: with a tree-stage pool
+                    # (legacy or landmark) the estimator prices neighbors
+                    # at THOSE centroids — zero extra k-means (span
+                    # pool_builds counters assert this in tier-1)
+                    sil_rec["pool_reused"] = pool_centroids is not None
+                    for info, (si, _per) in zip(
+                        deep_split_info,
+                        pooled_multi_cut_silhouette(
+                            embedding, labs,
+                            n_centroids=config.silhouette_pool_centroids,
+                            seed=config.random_seed,
+                            centroids=pool_centroids,
+                            assign=pool_assign,
+                            sample=config.silhouette_sample,
+                        ),
+                    ):
+                        info["silhouette"] = si
+                        info["silhouette_method"] = "pooled-estimator"
+                else:
+                    # all cuts share one N² distance pass
+                    from scconsensus_tpu.ops.silhouette import (
+                        multi_cut_silhouette,
+                    )
 
-                for info, (si, _per) in zip(
-                    deep_split_info, multi_cut_silhouette(embedding, labs)
-                ):
-                    info["silhouette"] = si
+                    for info, (si, _per) in zip(
+                        deep_split_info, multi_cut_silhouette(embedding,
+                                                              labs)
+                    ):
+                        info["silhouette"] = si
+
+            _guard(_silhouette, site="stage:silhouette")
 
     with timer.stage("nodg"):
         # per-cell number of detected genes; the reference's O(N·G)
@@ -521,7 +582,7 @@ def _refine_impl(
         # reduction. Declared crossing: the (N,) counts are a pipeline
         # output and must reach the host once.
         with residency.boundary("label_fetch"):
-            nodg = sparse_nodg(data)
+            nodg = _guard(lambda: sparse_nodg(data), site="stage:nodg")
 
     # Quality telemetry (obs.quality): the DE gate funnel, window-ladder
     # occupancy, cluster structure vs the input labeling, and any

@@ -49,6 +49,15 @@ schema ``scc-run-record`` version 1 — top-level keys:
                     joined to tracer spans and the obs.cost FLOPs/bytes
                     model (achieved device-time rates). Validated by
                     obs.kernels.validate_kernels.
+  robustness        OPTIONAL (still schema version 1 — additive): the
+                    survivable-pipeline trail (robust.record) — faults
+                    injected (SCC_FAULT_PLAN), typed retries with error
+                    classes, degradations, mid-stage resume points, the
+                    per-run retry budget, and bench orchestration
+                    adaptations. Validated by
+                    robust.record.validate_robustness — a section
+                    claiming recovery without retry/resume evidence is
+                    rejected. Absent on healthy unfaulted runs.
 
 The Chrome trace export (:func:`chrome_trace`) converts the span tree to
 ``traceEvents`` complete ("X") events — open the file in Perfetto
@@ -118,6 +127,7 @@ def build_run_record(
     quality: Optional[Dict[str, Any]] = None,
     residency: Optional[Dict[str, Any]] = None,
     kernels: Optional[Dict[str, Any]] = None,
+    robustness: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One schema-v1 run record. Pass ``tracer`` to take spans + compile
     stats from it; or pre-built ``spans`` (e.g. a resumed pipeline's
@@ -126,7 +136,8 @@ def build_run_record(
     the obs.quality section — funnels, cluster structure, sentinel
     trips; ``residency`` / ``kernels`` (optional) attach the
     obs.residency transfer audit and the obs.kernels device-op
-    timeline."""
+    timeline; ``robustness`` (optional) attaches the robust.record
+    fault/retry/resume trail."""
     if spans is None:
         spans = tracer.span_records() if tracer is not None else []
     extra = dict(extra or {})
@@ -160,6 +171,8 @@ def build_run_record(
         rec["residency"] = residency
     if kernels is not None:
         rec["kernels"] = kernels
+    if robustness is not None:
+        rec["robustness"] = robustness
     return rec
 
 
@@ -254,6 +267,12 @@ def validate_run_record(rec: Dict[str, Any]) -> None:
         from scconsensus_tpu.obs.kernels import validate_kernels
 
         validate_kernels(kern)
+    rb = rec.get("robustness")
+    if rb is not None:
+        # jax-free import (robust.record is stdlib-only by contract)
+        from scconsensus_tpu.robust.record import validate_robustness
+
+        validate_robustness(rb)
 
 
 # --------------------------------------------------------------------------
@@ -311,13 +330,19 @@ def chrome_trace(spans: List[Dict[str, Any]],
 ATOMIC_TMP_PREFIX = ".scc-tmp-"
 
 
-def atomic_write(path: str, write_fn) -> None:
+def atomic_write(path: str, write_fn, inspect_fn=None) -> None:
     """The one atomic-write primitive every artifact writer shares:
     ``write_fn(tmp_path)`` produces the full content at a unique temp path
     in the destination dir (same filesystem, so ``os.replace`` is atomic),
     the temp file is fsynced, then renamed over the destination. An
     interrupted writer can leave a stale ``.scc-tmp-*`` file but never a
-    truncated artifact under a real name."""
+    truncated artifact under a real name.
+
+    ``inspect_fn(tmp_path)``, when given, runs between the write and the
+    replace — for work that must see the final bytes BEFORE they land
+    under the real name (the artifact store checksums the arrays file
+    here and writes its sidecar, preserving meta-before-arrays ordering).
+    A raising inspect_fn aborts the write and cleans up the temp."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(prefix=ATOMIC_TMP_PREFIX, dir=d)
     os.close(fd)
@@ -328,6 +353,8 @@ def atomic_write(path: str, write_fn) -> None:
         os.umask(umask)
         os.chmod(tmp, 0o666 & ~umask)
         write_fn(tmp)
+        if inspect_fn is not None:
+            inspect_fn(tmp)
         with open(tmp, "rb") as f:
             os.fsync(f.fileno())
         os.replace(tmp, path)

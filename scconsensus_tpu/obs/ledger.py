@@ -357,6 +357,18 @@ class Ledger:
             # baseline computation (regress.stage_baselines) reads only
             # the manifest and must skip partials without loading files
             entry["termination"] = cause
+        rb = rec.get("robustness")
+        if isinstance(rb, dict) and rb:
+            # survival summary on the index: a gate/report scanning the
+            # manifest can see WHICH runs recovered (and how hard they
+            # had to work) without loading every record
+            entry["robustness"] = {
+                "retries": len(rb.get("retries") or []),
+                "degradations": len(rb.get("degradations") or []),
+                "faults_injected": len(rb.get("faults_injected") or []),
+                "resume_points": len(rb.get("resume_points") or []),
+                "recovered": bool(rb.get("recovered")),
+            }
         fp = (rec.get("extra") or {}).get("numeric_fingerprint")
         if isinstance(fp, dict) and fp:
             # every ingested run is fingerprint-stamped on its manifest

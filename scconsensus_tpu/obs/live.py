@@ -460,6 +460,18 @@ class LiveRecorder:
                 hb["transfers"] = tc
         except Exception:
             pass
+        try:
+            # robustness panel: live fault/retry/degradation counters
+            # (robust.record) — a run fighting for its life shows it on
+            # the stream, and a SIGKILLed run's LAST heartbeat says what
+            # it had already survived
+            from scconsensus_tpu.robust import record as robust_record
+
+            rs = robust_record.live_summary()
+            if rs:
+                hb["robust"] = rs
+        except Exception:
+            pass
         mem = obs_device.memory_snapshot()
         if mem is not None:
             hb["hbm"] = mem

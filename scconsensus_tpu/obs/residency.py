@@ -163,6 +163,14 @@ BOUNDARIES: Dict[str, str] = {
         "fingerprinting, host consumers) — the documented single batched "
         "fetch of the (P, G) statistics a host consumer asked for."
     ),
+    "de_ckpt_fetch": (
+        "Mid-stage wilcox checkpointing (robust round): each completed "
+        "ladder bucket's (Gb, P) block fetches to host for the "
+        "ArtifactStore so a kill mid-stage resumes from completed "
+        "buckets. Only active with an artifact store + "
+        "SCC_ROBUST_DE_CKPT — durability bought with a declared, "
+        "store-gated crossing, never a silent one."
+    ),
     "obs_internal": (
         "Measurement infrastructure's own O(1) transfers: tracer drain "
         "sentinels, sentinel-count fetches. Auto-attributed when the "

@@ -1,0 +1,51 @@
+"""Survivable-pipeline layer: fault injection + typed recovery (round 13).
+
+Three pieces, one contract:
+
+* :mod:`~scconsensus_tpu.robust.faults` — deterministic, plan-driven
+  injection of named fault classes (device OOM, transient backend error,
+  worker SIGKILL, artifact corruption, stall) at named sites, so every
+  recovery path is tier-1-testable without a flaky box
+  (``SCC_FAULT_PLAN`` points at a JSON plan).
+* :mod:`~scconsensus_tpu.robust.retry` — the ONE retry/degradation
+  policy engine: error-class classification (transient / resource /
+  fatal), exponential backoff with deterministic jitter, a per-run retry
+  budget, every attempt recorded as a span event + counter.
+  ``utils.devcache``'s old ad-hoc evict-and-retry now rides this policy.
+* :mod:`~scconsensus_tpu.robust.record` — the per-run robustness log and
+  the validated ``robustness`` run-record section (faults injected,
+  retries, degradations, resume points) that flows through the ledger,
+  ``tools/explain_run.py`` and ``tools/tail_run.py``. A record claiming
+  recovery without retry/resume evidence is REJECTED by
+  ``validate_run_record``.
+
+The recovery *surfaces* live where the work lives: the wilcox ladder
+persists per-bucket completion into the ``ArtifactStore`` (mid-stage
+resume), ``utils.artifacts`` checksums every artifact and quarantines
+corruption, and ``bench.py``'s orchestrator adapts its attempt ladder to
+the observed termination cause (stall -> capture armed, oom -> degraded,
+repeated crash -> poisoned config).
+"""
+
+from scconsensus_tpu.robust.faults import (  # noqa: F401
+    FAULT_CLASSES,
+    InjectedFault,
+    InjectedResourceExhausted,
+    InjectedTransientError,
+    corrupt_artifact,
+    fault_point,
+)
+from scconsensus_tpu.robust.record import (  # noqa: F401
+    begin_run,
+    current_run,
+    live_summary,
+    note_resume_point,
+    validate_robustness,
+)
+from scconsensus_tpu.robust.retry import (  # noqa: F401
+    ERROR_CLASSES,
+    RetryPolicy,
+    call,
+    classify_exception,
+    classify_text,
+)

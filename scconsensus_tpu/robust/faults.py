@@ -1,0 +1,235 @@
+"""Deterministic fault injection at named sites (``SCC_FAULT_PLAN``).
+
+A fault plan is a JSON file::
+
+    {"seed": 1,
+     "faults": [
+       {"site": "stage:embed", "class": "oom",       "times": 1},
+       {"site": "wilcox_bucket", "class": "transient", "after": 2},
+       {"site": "stage:cuts",  "class": "kill"},
+       {"site": "artifact:tree", "class": "corrupt", "mode": "truncate"},
+       {"site": "stage:de",    "class": "stall", "stall_s": 0.5}
+     ]}
+
+Each rule fires on hits ``after <= n < after + times`` of its site
+(0-based, ``after`` defaults 0, ``times`` defaults 1) — fully
+deterministic, so the fault-matrix test can assert exact recovery
+behavior. Sites are the pipeline's stage boundaries (``stage:<name>``),
+the DE ladder's buckets (``wilcox_bucket``), the devcache upload
+(``input_staging``), and artifact writes (``artifact:<stage>``, consumed
+by :func:`corrupt_artifact` rather than :func:`fault_point`).
+
+Fault classes and what they do at a compute site:
+
+  oom        raise :class:`InjectedResourceExhausted` (message carries
+             ``RESOURCE_EXHAUSTED`` so the classifier sees exactly what a
+             real XLA allocation failure looks like)
+  transient  raise :class:`InjectedTransientError` (``UNAVAILABLE``)
+  kill       SIGKILL the process — no handler runs, the artifact store's
+             atomicity and the mid-stage checkpoints are what survive
+  stall      sleep ``stall_s`` (default 1.0) without raising — exercises
+             the r9 stall watchdog path
+  corrupt    no-op at compute sites; at ``artifact:<stage>`` sites the
+             store calls :func:`corrupt_artifact` after a successful
+             write, which truncates or bit-flips the file on disk — the
+             checksum/quarantine path's test vector
+
+With ``SCC_FAULT_PLAN`` unset every entry point is a single registry
+lookup returning immediately — the zero-fault overhead contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from scconsensus_tpu.config import env_flag
+
+__all__ = [
+    "FAULT_CLASSES",
+    "InjectedFault",
+    "InjectedResourceExhausted",
+    "InjectedTransientError",
+    "fault_point",
+    "corrupt_artifact",
+    "active",
+    "reset",
+]
+
+FAULT_CLASSES = ("oom", "transient", "kill", "stall", "corrupt")
+
+
+class InjectedFault(Exception):
+    """Base of every plan-injected exception (so tests can catch the
+    family while the classifier sees only the message text, exactly as
+    it would for the real error)."""
+
+
+class InjectedResourceExhausted(InjectedFault):
+    """Mimics an XLA device allocation failure."""
+
+
+class InjectedTransientError(InjectedFault):
+    """Mimics a transient backend/RPC error."""
+
+
+# plan cache: (path, mtime) -> parsed plan; hit counters reset on reload
+_LOADED: Optional[Dict[str, Any]] = None
+_LOADED_KEY: Optional[tuple] = None
+_HITS: Dict[int, int] = {}
+
+
+def reset() -> None:
+    """Drop the cached plan + hit counters (tests switch plans in-process)."""
+    global _LOADED, _LOADED_KEY
+    _LOADED = None
+    _LOADED_KEY = None
+    _HITS.clear()
+
+
+def _plan() -> Optional[Dict[str, Any]]:
+    global _LOADED, _LOADED_KEY
+    path = env_flag("SCC_FAULT_PLAN")
+    if not path:
+        if _LOADED is not None:
+            reset()
+        return None
+    try:
+        key = (path, os.path.getmtime(path))
+    except OSError:
+        return None
+    if key != _LOADED_KEY:
+        try:
+            with open(path) as f:
+                plan = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            # a malformed plan must be loud: silently running WITHOUT the
+            # requested faults would let a chaos run pass vacuously
+            raise ValueError(f"SCC_FAULT_PLAN {path!r} unreadable: {e}")
+        faults = plan.get("faults")
+        if not isinstance(faults, list):
+            raise ValueError(
+                f"SCC_FAULT_PLAN {path!r}: 'faults' must be a list"
+            )
+        for i, r in enumerate(faults):
+            if r.get("class") not in FAULT_CLASSES:
+                raise ValueError(
+                    f"SCC_FAULT_PLAN {path!r}: faults[{i}].class must be "
+                    f"one of {FAULT_CLASSES}, got {r.get('class')!r}"
+                )
+            if not r.get("site"):
+                raise ValueError(
+                    f"SCC_FAULT_PLAN {path!r}: faults[{i}] missing site"
+                )
+        _LOADED = plan
+        _LOADED_KEY = key
+        _HITS.clear()
+    return _LOADED
+
+
+def active() -> bool:
+    """True iff a fault plan is loaded for this process."""
+    return _plan() is not None
+
+
+def _matches(site: str) -> List[tuple]:
+    plan = _plan()
+    if plan is None:
+        return []
+    out = []
+    for i, rule in enumerate(plan.get("faults", ())):
+        if rule.get("site") == site:
+            out.append((i, rule))
+    return out
+
+
+def _fire(idx: int, rule: Dict[str, Any]) -> bool:
+    """Advance the rule's hit counter; True when this hit is in the
+    rule's firing window."""
+    n = _HITS.get(idx, 0)
+    _HITS[idx] = n + 1
+    after = int(rule.get("after", 0))
+    times = int(rule.get("times", 1))
+    return after <= n < after + times
+
+
+def fault_point(site: str) -> None:
+    """The injection hook compute code calls at a named site. No plan ->
+    immediate return. A firing rule acts per its class (see module doc);
+    every injection is recorded on the run's robustness log BEFORE the
+    action, so even a SIGKILL leaves the fault attributable (the partial
+    flight record carries the log's live summary)."""
+    rules = _matches(site)
+    if not rules:
+        return
+    from scconsensus_tpu.robust import record as _record
+
+    # advance EVERY matching rule's hit counter before acting: a firing
+    # rule raises, and skipping the siblings' bookkeeping would desync
+    # their windows (hit counts must mean "times this site was reached",
+    # independent of which rule acted)
+    firing = [(idx, rule) for idx, rule in rules if _fire(idx, rule)]
+    for idx, rule in firing[:1]:  # at most one action per visit
+        fclass = rule["class"]
+        _record.note_fault(site, fclass, seq=_HITS[idx] - 1)
+        if fclass == "oom":
+            raise InjectedResourceExhausted(
+                f"RESOURCE_EXHAUSTED: injected device allocation failure "
+                f"at {site} (SCC_FAULT_PLAN)"
+            )
+        if fclass == "transient":
+            raise InjectedTransientError(
+                f"UNAVAILABLE: injected transient backend error at {site} "
+                "(SCC_FAULT_PLAN)"
+            )
+        if fclass == "kill":
+            import signal
+
+            # flush the robustness trail first: the whole point of the
+            # kill class is testing what the NEXT process can resume from
+            try:
+                from scconsensus_tpu.obs.live import flush_active
+
+                flush_active("signal")
+            except Exception:
+                pass
+            os.kill(os.getpid(), signal.SIGKILL)
+        if fclass == "stall":
+            time.sleep(float(rule.get("stall_s", 1.0)))
+        # "corrupt" rules are inert at compute sites (corrupt_artifact
+        # consumes them at artifact:<stage> sites)
+
+
+def corrupt_artifact(stage: str, path: str) -> bool:
+    """Apply any ``artifact:<stage>`` corrupt rule to a just-written
+    artifact file — called by the ArtifactStore AFTER its atomic replace,
+    so the corruption models a post-write disk/transport fault that the
+    load-time checksum must catch. ``mode``: 'truncate' (default — cut
+    the file to 60%) or 'flip' (xor one mid-file byte). Returns True when
+    a corruption was applied."""
+    applied = False
+    for idx, rule in _matches(f"artifact:{stage}"):
+        if rule["class"] != "corrupt" or not _fire(idx, rule):
+            continue
+        from scconsensus_tpu.robust import record as _record
+
+        _record.note_fault(f"artifact:{stage}", "corrupt",
+                           seq=_HITS[idx] - 1)
+        try:
+            size = os.path.getsize(path)
+            mode = rule.get("mode", "truncate")
+            if mode == "flip" and size:
+                with open(path, "r+b") as f:
+                    f.seek(size // 2)
+                    b = f.read(1)
+                    f.seek(size // 2)
+                    f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+            else:
+                with open(path, "r+b") as f:
+                    f.truncate(max(1, int(size * 0.6)))
+            applied = True
+        except OSError:
+            pass
+    return applied

@@ -1,0 +1,185 @@
+"""The one typed retry/degradation policy engine.
+
+Every recovery path in the repo — the devcache upload's evict-and-retry
+(formerly a bare try/except), the wilcox ladder's adaptive degrade, the
+embed stage, the pipeline's stage-boundary recovery — runs through
+:meth:`RetryPolicy.call`:
+
+  1. classify the exception: ``transient`` (backend/RPC hiccup — retry
+     as-is), ``resource`` (allocation failure — run the caller's
+     ``degrade`` hook, then retry), ``fatal`` (everything else —
+     re-raise immediately, a ValueError must never burn retry budget);
+  2. respect the per-run retry budget (``SCC_ROBUST_BUDGET``) — a retry
+     storm converts to a clean failure, not an unbounded loop;
+  3. back off exponentially with deterministic jitter (seeded by the
+     site name, so runs reproduce);
+  4. record every attempt: a ``robust_retry`` span event on the ambient
+     tracer, a ``robust_retries`` counter on the enclosing span, and an
+     entry in the run's robustness log (-> the validated ``robustness``
+     run-record section).
+
+``KeyboardInterrupt``/``SystemExit`` are never caught: an operator's
+ctrl-C (and the artifact-resume tests that simulate it) must keep its
+existing semantics.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Any, Callable, Optional
+
+from scconsensus_tpu.config import env_flag
+from scconsensus_tpu.robust import faults, record
+
+__all__ = [
+    "ERROR_CLASSES",
+    "classify_exception",
+    "classify_text",
+    "RetryPolicy",
+    "call",
+    "default_policy",
+]
+
+ERROR_CLASSES = ("transient", "resource", "fatal")
+
+# Message fragments, lowercase. Matched against str(exc) / raw text; the
+# XLA runtime stringifies device failures with their gRPC-style status
+# names, so text is the one classification surface that works for real
+# XlaRuntimeError, injected faults, and a dead worker's stderr tail alike.
+_RESOURCE_PAT = (
+    "resource_exhausted", "resource exhausted", "out of memory", "oom",
+    "allocation fail", "failed to allocate", "memoryerror",
+    "cannot allocate",
+)
+_TRANSIENT_PAT = (
+    "unavailable", "deadline_exceeded", "deadline exceeded", "aborted",
+    "connection reset", "connection refused", "broken pipe", "timed out",
+    "transient", "socket closed", "internal: failed to connect",
+)
+
+
+def classify_text(text: Optional[str]) -> Optional[str]:
+    """'transient' | 'resource' | None (no signature recognized) for raw
+    text — stderr tails, TUNNEL_LOG probe errors, heartbeat post-mortems.
+    Resource wins over transient when both match: degrading is the safer
+    adaptation (a transient retry of a genuinely too-big shape loops)."""
+    if not text:
+        return None
+    low = str(text).lower()
+    if any(p in low for p in _RESOURCE_PAT):
+        return "resource"
+    if any(p in low for p in _TRANSIENT_PAT):
+        return "transient"
+    return None
+
+
+def classify_exception(exc: BaseException) -> str:
+    """Error class of an exception: type first (MemoryError, the injected
+    fault types), then message text, else fatal."""
+    if isinstance(exc, (MemoryError, faults.InjectedResourceExhausted)):
+        return "resource"
+    if isinstance(exc, faults.InjectedTransientError):
+        return "transient"
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return "transient"
+    return classify_text(f"{type(exc).__name__}: {exc}") or "fatal"
+
+
+def _jitter(site: str, attempt: int) -> float:
+    """Deterministic jitter fraction in [0, 1): hash-derived so retry
+    timing reproduces run-to-run (no Date/random dependence)."""
+    h = hashlib.sha256(f"{site}:{attempt}".encode()).digest()
+    return int.from_bytes(h[:4], "big") / 2**32
+
+
+class RetryPolicy:
+    """Retry policy for one call site family.
+
+    ``max_attempts`` counts the first try (3 = up to 2 retries);
+    ``backoff_base`` defaults to ``SCC_ROBUST_BACKOFF_S``. The per-run
+    budget is shared across every policy instance (record.RunLog), so a
+    pathological run cannot multiply site-level retries without bound.
+    """
+
+    def __init__(self, max_attempts: int = 3,
+                 backoff_base: Optional[float] = None,
+                 backoff_cap: float = 30.0):
+        self.max_attempts = int(max_attempts)
+        self.backoff_base = (
+            float(env_flag("SCC_ROBUST_BACKOFF_S"))
+            if backoff_base is None else float(backoff_base)
+        )
+        self.backoff_cap = float(backoff_cap)
+
+    def backoff_s(self, site: str, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (1-based): exponential with
+        +0-50% deterministic jitter."""
+        base = min(self.backoff_base * 2 ** (attempt - 1), self.backoff_cap)
+        return base * (1.0 + 0.5 * _jitter(site, attempt))
+
+    def call(self, fn: Callable[[], Any], site: str,
+             degrade: Optional[Callable[[int], Any]] = None,
+             classify: Callable[[BaseException], str] = classify_exception,
+             ) -> Any:
+        """Run ``fn`` under this policy. ``degrade(attempt)`` runs before
+        a resource-class retry (evict caches, halve a chunk ladder —
+        whatever makes the retry *different*); a fault plan's injection
+        for ``site`` fires at each attempt's entry, so an injected fault
+        is recovered by the very machinery it tests."""
+        from scconsensus_tpu.obs import trace as obs_trace
+
+        run = record.current_run()
+        attempt = 1
+        backoff_total = 0.0
+        while True:
+            try:
+                faults.fault_point(site)
+                out = fn()
+                if attempt > 1:
+                    record.note_retry(site, err_class, attempt,
+                                      recovered=True,
+                                      backoff_s=backoff_total)
+                return out
+            except Exception as e:
+                err_class = classify(e)
+                if err_class == "fatal":
+                    raise
+                if attempt >= self.max_attempts or not run.budget_take():
+                    record.note_retry(site, err_class, attempt,
+                                      recovered=False,
+                                      backoff_s=backoff_total)
+                    raise
+                backoff = self.backoff_s(site, attempt)
+                backoff_total += backoff
+                # the attempt as a span event + counter: visible in the
+                # span tree, Chrome traces, and the heartbeat stream
+                sp = obs_trace.current_span()
+                if sp is not None:
+                    sp.metrics.counter("robust_retries").add(1)
+                with obs_trace.span(
+                    "robust_retry", site=site, error_class=err_class,
+                    attempt=attempt, backoff_s=round(backoff, 4),
+                ):
+                    if degrade is not None and err_class == "resource":
+                        degrade(attempt)
+                    time.sleep(backoff)
+                attempt += 1
+
+
+_DEFAULT: Optional[RetryPolicy] = None
+
+
+def default_policy() -> RetryPolicy:
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = RetryPolicy()
+    return _DEFAULT
+
+
+def call(fn: Callable[[], Any], site: str,
+         degrade: Optional[Callable[[int], Any]] = None,
+         policy: Optional[RetryPolicy] = None) -> Any:
+    """Module-level convenience: ``robust.call(fn, site=...)`` under the
+    default policy."""
+    return (policy or default_policy()).call(fn, site, degrade=degrade)

@@ -25,7 +25,14 @@ from scconsensus_tpu.obs.export import (
     atomic_write as _atomic_bytes_writer,
 )
 
-__all__ = ["ArtifactStore", "input_fingerprint", "config_fingerprint"]
+__all__ = ["ArtifactStore", "ArtifactCorrupt", "input_fingerprint",
+           "config_fingerprint"]
+
+
+class ArtifactCorrupt(ValueError):
+    """A stored artifact failed its content checksum or would not parse.
+    The offending files are already quarantined when this raises; callers
+    (``cached()``, the pipeline's de-resume path) recompute the stage."""
 
 # Stage saves atomically via obs.export.atomic_write (the shared
 # mkstemp+fsync+os.replace primitive): a half-written ``de.npz`` would
@@ -178,52 +185,194 @@ class ArtifactStore:
         npz, _ = self._paths(stage)
         return os.path.exists(npz)
 
+    @staticmethod
+    def _checksums_on() -> bool:
+        from scconsensus_tpu.config import env_flag
+
+        return bool(env_flag("SCC_ROBUST_CHECKSUM"))
+
+    @staticmethod
+    def _file_sha(path: str) -> str:
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
     def save(self, stage: str, arrays: Optional[Dict[str, np.ndarray]] = None,
              meta: Optional[Dict[str, Any]] = None) -> None:
         """Atomic per-file writes, meta BEFORE arrays: ``has()`` keys resume
         on the ``.npz``, so the only observable intermediate state (meta
         present, arrays absent) reads as stage-not-complete and recomputes.
         The reverse order could briefly expose arrays with a stale sidecar.
+
+        With checksums on (``SCC_ROBUST_CHECKSUM``, default) the arrays
+        file is serialized to its temp FIRST so its sha256 can ride the
+        sidecar (``_integrity``) — load verifies it, so a truncated or
+        bit-flipped artifact quarantines instead of resuming garbage.
         """
         if not self.enabled:
             return
+        from scconsensus_tpu.robust import faults as _faults
+        from scconsensus_tpu.robust import record as _robust_record
+
         npz, js = self._paths(stage)
-        if meta is not None:
+
+        def _write_sidecar(integrity: Optional[Dict[str, Any]]) -> None:
+            payload = dict(meta or {})
+            if integrity is not None:
+                payload["_integrity"] = integrity
+
             def _wj(tmp):
                 with open(tmp, "w") as f:
-                    json.dump(meta, f, indent=2, default=str)
+                    json.dump(payload, f, indent=2, default=str)
 
             _atomic_bytes_writer(js, _wj)
-        if arrays is not None:
-            def _wz(tmp):
-                # savez_compressed appends .npz when the name lacks it; an
-                # explicit file handle writes exactly to the temp path
-                with open(tmp, "wb") as f:
-                    np.savez_compressed(
-                        f, **{k: np.asarray(v) for k, v in arrays.items()}
-                    )
 
-            _atomic_bytes_writer(npz, _wz)
+        if arrays is None:
+            if meta is not None:
+                _write_sidecar(None)
+            return
+
+        def _wz(tmp):
+            # savez_compressed appends .npz when the name lacks it; an
+            # explicit file handle writes exactly to the temp path
+            with open(tmp, "wb") as f:
+                np.savez_compressed(
+                    f, **{k: np.asarray(v) for k, v in arrays.items()}
+                )
+
+        def _seal(tmp):
+            # between serialize and replace: checksum the exact bytes
+            # about to land, then write the sidecar — meta-before-arrays
+            # ordering holds because the outer replace runs after this
+            integrity = None
+            if self._checksums_on():
+                with _robust_record.timed():
+                    integrity = {
+                        "sha256": self._file_sha(tmp),
+                        "size": os.path.getsize(tmp),
+                    }
+            if meta is not None or integrity is not None:
+                _write_sidecar(integrity)
+
+        _atomic_bytes_writer(npz, _wz, inspect_fn=_seal)
+        # fault plan's post-write corruption hook (artifact:<stage>
+        # sites): models a disk/transport fault AFTER the atomic
+        # replace — exactly what the load-time checksum exists for
+        _faults.corrupt_artifact(stage, npz)
+
+    def _quarantine(self, stage: str, reason: str) -> None:
+        """Move the stage's files aside under ``*.quarantined-<n>`` names
+        (never silently delete what might be the only copy of a long
+        compute) and record the event on the robustness log."""
+        from scconsensus_tpu.robust import record as _robust_record
+        from scconsensus_tpu.utils.logging import get_logger
+
+        for path in self._paths(stage):
+            if not os.path.exists(path):
+                continue
+            n = 0
+            dest = f"{path}.quarantined-{n}"
+            while os.path.exists(dest):
+                n += 1
+                dest = f"{path}.quarantined-{n}"
+            try:
+                os.replace(path, dest)
+            except OSError:
+                try:  # last resort: a corrupt file must not stay loadable
+                    os.unlink(path)
+                except OSError:
+                    pass
+        _robust_record.note_degradation(
+            f"artifact:{stage}", "quarantine", reason
+        )
+        get_logger().warning(
+            "artifact %r quarantined (%s); stage will recompute",
+            stage, reason,
+        )
 
     def load(self, stage: str):
+        """(arrays, meta) for a stage. Verifies the sidecar's content
+        checksum when present (and ``SCC_ROBUST_CHECKSUM`` is on);
+        corrupt or unparseable entries are quarantined and raise
+        :class:`ArtifactCorrupt` — callers recompute, never resume
+        garbage. Stores written before checksums existed (no
+        ``_integrity``) load unverified, as before."""
+        from scconsensus_tpu.robust import record as _robust_record
+
         npz, js = self._paths(stage)
-        arrays: Dict[str, np.ndarray] = {}
         meta: Dict[str, Any] = {}
-        if os.path.exists(npz):
-            with np.load(npz, allow_pickle=False) as z:
-                arrays = {k: z[k] for k in z.files}
         if os.path.exists(js):
-            with open(js) as f:
-                meta = json.load(f)
+            try:
+                with open(js) as f:
+                    meta = json.load(f)
+            except (json.JSONDecodeError, OSError) as e:
+                self._quarantine(stage, f"sidecar unreadable: {e}")
+                raise ArtifactCorrupt(
+                    f"artifact {stage!r}: sidecar unreadable ({e}); "
+                    "quarantined"
+                )
+        arrays: Dict[str, np.ndarray] = {}
+        if os.path.exists(npz):
+            integ = meta.get("_integrity")
+            if integ and self._checksums_on():
+                with _robust_record.timed():
+                    actual = self._file_sha(npz)
+                if actual != integ.get("sha256"):
+                    self._quarantine(
+                        stage,
+                        f"checksum mismatch ({actual[:12]} != "
+                        f"{str(integ.get('sha256'))[:12]})",
+                    )
+                    raise ArtifactCorrupt(
+                        f"artifact {stage!r}: content checksum mismatch; "
+                        "quarantined"
+                    )
+            try:
+                with np.load(npz, allow_pickle=False) as z:
+                    arrays = {k: z[k] for k in z.files}
+            except Exception as e:  # BadZipFile, truncated stream, ...
+                self._quarantine(stage, f"unparseable npz: {e!r}")
+                raise ArtifactCorrupt(
+                    f"artifact {stage!r}: unparseable ({e!r}); quarantined"
+                )
         return arrays, meta
+
+    def discard_prefix(self, prefix: str) -> int:
+        """Remove every stage artifact whose FILE name starts with
+        ``prefix`` (both .npz and .json) — mid-stage checkpoint cleanup
+        once the covering stage artifact has landed. Returns the number
+        of files removed. Quarantined files are kept (post-mortems)."""
+        if not self.enabled:
+            return 0
+        n = 0
+        try:
+            for e in os.scandir(self.root):
+                if (e.name.startswith(prefix) and e.is_file()
+                        and (e.name.endswith(".npz")
+                             or e.name.endswith(".json"))):
+                    try:
+                        os.unlink(e.path)
+                        n += 1
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        return n
 
     def cached(self, stage: str, fn: Callable[[], Dict[str, np.ndarray]],
                meta_fn: Optional[Callable[[], Dict[str, Any]]] = None):
-        """Run ``fn`` (returning a dict of arrays) unless ``stage`` already has
-        a saved artifact, in which case load and return it."""
+        """Run ``fn`` (returning a dict of arrays) unless ``stage`` already
+        has a saved artifact, in which case load and return it. A corrupt
+        stored artifact (failed checksum / truncated zip) has been
+        quarantined by ``load`` — fall through and recompute."""
         if self.has(stage):
-            arrays, _ = self.load(stage)
-            return arrays
+            try:
+                arrays, _ = self.load(stage)
+                return arrays
+            except ArtifactCorrupt:
+                pass  # quarantined inside load(); recompute below
         arrays = fn()
         self.save(stage, arrays, meta_fn() if meta_fn else None)
         return arrays

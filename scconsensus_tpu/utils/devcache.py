@@ -31,7 +31,13 @@ from typing import Dict
 
 import numpy as np
 
-__all__ = ["device_put_cached"]
+__all__ = ["device_put_cached", "clear_cache"]
+
+
+def clear_cache() -> None:
+    """Drop every pinned device buffer (the resource-degradation hook:
+    an OOM elsewhere in the pipeline frees the cache's HBM first)."""
+    _cache.clear()
 
 
 class _Entry:
@@ -102,12 +108,26 @@ def device_put_cached(x: np.ndarray):
                 return ent.buf
         _cache.pop(key, None)  # freed id reuse or in-place mutation
     with boundary("input_staging"):  # THE intended matrix upload
-        try:
-            buf = jnp.asarray(x)
-        except Exception:
-            # device allocation failure: drop every pinned buffer, retry
+        # Device allocation failure: drop every pinned buffer and retry —
+        # the same evict-and-retry as always, but through the central
+        # robust.retry policy (span event + robust_retries counter per
+        # attempt, per-run budget respected). Any upload failure is
+        # classified "resource" here, preserving the historical contract
+        # that a failed jnp.asarray gets exactly one eviction retry.
+        from scconsensus_tpu.robust import record as _rb_record
+        from scconsensus_tpu.robust.retry import RetryPolicy
+
+        def _evict(_attempt):
             _cache.clear()
-            buf = jnp.asarray(x)
+            _rb_record.note_degradation(
+                "input_staging", "evict-devcache",
+                "dropped every pinned device buffer before re-upload",
+            )
+
+        buf = RetryPolicy(max_attempts=2).call(
+            lambda: jnp.asarray(x), site="input_staging",
+            degrade=_evict, classify=lambda _e: "resource",
+        )
     try:
         ref = weakref.ref(x, lambda _r, _k=key: _cache.pop(_k, None))
     except TypeError:

@@ -45,8 +45,13 @@ PATTERNS = {
 APPROVED = {
     "de/edger.py": {"np.asarray(": 41, "np.array(": 3},
     "de/edger_direct.py": {"np.asarray(": 27},
-    "de/engine.py": {"np.asarray(": 49, "np.array(": 7,
-                     "jax.device_get": 9, ".block_until_ready(": 4},
+    # r13 survivable pipeline: +8 np.asarray / +2 device_get inside the
+    # declared de_ckpt_fetch boundary — the wilcox ladder's mid-stage
+    # bucket checkpoints fetch each completed (Gb, P) block for the
+    # ArtifactStore (store-gated; SCC_ROBUST_DE_CKPT), and resume wraps
+    # the loaded host blocks back to device
+    "de/engine.py": {"np.asarray(": 57, "np.array(": 7,
+                     "jax.device_get": 11, ".block_until_ready(": 4},
     "ops/colors.py": {"np.asarray(": 1},
     "ops/distance.py": {"np.asarray(": 1, "np.array(": 1},
     "ops/knn_linkage.py": {"np.asarray(": 1},

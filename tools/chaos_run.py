@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Chaos harness: run a bench config under a named fault plan and ingest
+the recovered record into the evidence ledger.
+
+    chaos_run.py --plan PLAN.json [--config quick] [--evidence DIR]
+                 [--timeout S] [--no-fork] [--expect-recovery]
+
+The bench runs with ``SCC_FAULT_PLAN`` pointing at the plan (robust.faults
+injects the named fault classes at their sites) and auto-ingest disabled;
+afterwards this tool loads the final checkpoint record, requires a
+populated ``robustness`` section (a chaos run that injected nothing is a
+FAILED chaos run — it proved nothing), re-keys the record's dataset as
+``<config>-chaos`` so chaos walls can NEVER blend into the real config's
+regression baselines, and ingests it with ``source="chaos"``.
+``--expect-recovery`` additionally fails unless the section claims (and
+evidences — validate_run_record enforces that) recovery.
+
+Exit codes: 0 chaos contract held; 1 it did not; 2 usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from scconsensus_tpu.obs.export import validate_run_record  # noqa: E402
+from scconsensus_tpu.obs.ledger import (  # noqa: E402
+    Ledger,
+    default_evidence_dir,
+)
+
+
+def run_chaos(plan: str, config: str, evidence_dir: str, timeout_s: float,
+              no_fork: bool, expect_recovery: bool) -> int:
+    if not os.path.exists(plan):
+        print(f"chaos_run: plan {plan!r} not found", file=sys.stderr)
+        return 2
+    ckpt = os.path.join(evidence_dir, f"CHAOS_CHECKPOINT_{config}.json")
+    try:  # a stale checkpoint must not masquerade as this run's evidence
+        os.remove(ckpt)
+    except OSError:
+        pass
+    env = dict(os.environ)
+    env.update({
+        "SCC_FAULT_PLAN": os.path.abspath(plan),
+        "SCC_BENCH_CONFIG": config,
+        "SCC_BENCH_CKPT": ckpt,
+        "SCC_BENCH_LEDGER": "0",  # this tool ingests, re-keyed, below
+        "SCC_EVIDENCE_DIR": evidence_dir,
+    })
+    env.setdefault("SCC_BENCH_PLATFORM", "cpu")
+    if no_fork:
+        env["SCC_BENCH_NO_FORK"] = "1"
+    cmd = [sys.executable, os.path.join(_REPO, "bench.py")]
+    print(f"[chaos] {config} under plan {plan} "
+          f"({'in-process' if no_fork else 'orchestrated'})",
+          file=sys.stderr)
+    try:
+        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print("[chaos] bench run exceeded the chaos timeout",
+              file=sys.stderr)
+        return 1
+    tail = (proc.stderr or "").strip().splitlines()[-8:]
+    for ln in tail:
+        print(f"[bench] {ln}", file=sys.stderr)
+
+    rec = None
+    try:
+        with open(ckpt) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        # fall back to the stdout tail's last JSON line (trimmed record)
+        for line in reversed((proc.stdout or "").strip().splitlines()):
+            if line.strip().startswith("{"):
+                try:
+                    rec = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+    if rec is None:
+        print("[chaos] bench left no record at all — even dying runs "
+              "must checkpoint (that is the robustness contract)",
+              file=sys.stderr)
+        return 1
+
+    rb = rec.get("robustness")
+    checks = [
+        ("bench produced a record", True),
+        ("record carries a robustness section", bool(rb)),
+        ("faults were actually injected",
+         bool(rb and (rb.get("faults_injected")
+                      or (rb.get("orchestration") or {}).get("attempts")))),
+    ]
+    if expect_recovery:
+        checks.append(("run recovered", bool(rb and rb.get("recovered"))))
+    ok = all(c for _, c in checks)
+    for label, c in checks:
+        print(f"[chaos] {'ok  ' if c else 'FAIL'} {label}",
+              file=sys.stderr)
+    if not ok:
+        return 1
+
+    # re-key: chaos walls (backoffs, degraded shapes) must never become
+    # the real config's noise-banded baselines
+    rec.setdefault("extra", {})["config"] = f"{config}-chaos"
+    rec["extra"]["chaos_plan"] = os.path.basename(plan)
+    try:
+        validate_run_record(rec)
+        entry = Ledger(evidence_dir).ingest(rec, source="chaos")
+        print(f"[chaos] ingested {entry['file']}", file=sys.stderr)
+    except (OSError, ValueError) as e:
+        print(f"[chaos] ingest failed: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "chaos": "ok", "config": config, "plan": os.path.basename(plan),
+        "recovered": bool(rb.get("recovered")),
+        "faults_injected": len(rb.get("faults_injected") or []),
+        "retries": len(rb.get("retries") or []),
+    }))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description="fault-plan chaos harness")
+    ap.add_argument("--plan", required=True, help="fault plan JSON")
+    ap.add_argument("--config", default="quick",
+                    help="bench config (default: quick)")
+    ap.add_argument("--evidence", default=None,
+                    help="ledger dir (default: SCC_EVIDENCE_DIR or "
+                         "<repo>/evidence)")
+    ap.add_argument("--timeout", type=float, default=1800.0)
+    ap.add_argument("--no-fork", action="store_true",
+                    help="run the worker in-process (no orchestrator "
+                         "ladder — kill-class faults then end the run)")
+    ap.add_argument("--expect-recovery", action="store_true",
+                    help="fail unless the record claims recovery")
+    args = ap.parse_args(argv)
+    evidence = args.evidence or default_evidence_dir(_REPO)
+    os.makedirs(evidence, exist_ok=True)
+    return run_chaos(args.plan, args.config, evidence, args.timeout,
+                     args.no_fork, args.expect_recovery)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

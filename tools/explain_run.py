@@ -384,6 +384,66 @@ def health_section(quality: Dict[str, Any]) -> List[str]:
     return out
 
 
+def robustness_section(rec: Dict[str, Any]) -> List[str]:
+    """The survival story of a run: faults injected, typed retries,
+    degradations, mid-stage resume points, orchestration adaptations.
+    Absent section -> no lines (healthy runs say nothing)."""
+    rb = rec.get("robustness")
+    if not rb:
+        return []
+    out = ["## Robustness", ""]
+    verdict = ("**recovered**" if rb.get("recovered")
+               else "no recovery claimed")
+    budget = rb.get("budget") or {}
+    out.append(
+        f"{verdict} — retry budget {budget.get('used', 0)}"
+        f"/{budget.get('limit', '?')} used"
+        + (f", robustness-layer overhead {rb['consumed_s']}s"
+           if rb.get("consumed_s") else "")
+    )
+    faults = rb.get("faults_injected") or []
+    if faults:
+        out += ["", f"**{len(faults)} injected fault(s)** "
+                    "(SCC_FAULT_PLAN):", "",
+                "| site | class | seq |", "|---|---|---:|"]
+        out += [f"| {f.get('site')} | {f.get('class')} | {f.get('seq', 0)} |"
+                for f in faults]
+    retries = rb.get("retries") or []
+    if retries:
+        out += ["", "| retry site | class | attempts | recovered "
+                    "| backoff |", "|---|---|---:|---|---:|"]
+        out += [
+            f"| {r.get('site')} | {r.get('error_class')} "
+            f"| {r.get('attempts')} "
+            f"| {'yes' if r.get('recovered') else 'NO'} "
+            f"| {_fmt(r.get('backoff_s'), 3)}s |"
+            for r in retries
+        ]
+    degr = rb.get("degradations") or []
+    if degr:
+        out += ["", "Degradations:", ""]
+        out += [f"- `{d.get('site')}`: {d.get('action')}"
+                + (f" — {d.get('detail')}" if d.get("detail") else "")
+                for d in degr]
+    resumes = rb.get("resume_points") or []
+    if resumes:
+        out += ["", "Mid-stage resume points:", ""]
+        out += [
+            f"- `{p.get('stage')}`: {p.get('completed')}/{p.get('total')} "
+            f"{p.get('unit', 'unit')}(s) loaded instead of recomputed"
+            for p in resumes
+        ]
+    orch = rb.get("orchestration") or {}
+    if orch:
+        att = orch.get("attempts") or []
+        out += ["", "Orchestration: " + " → ".join(
+            f"{a.get('attempt')}[{a.get('outcome')}]" for a in att
+        )]
+        out += [f"- adaptation after {a.get('after')}: {a.get('reason')}"
+                for a in (orch.get("adaptations") or [])]
+    return out
+
+
 def fingerprint_section(rec: Dict[str, Any], evidence_dir: str,
                         history: List[Dict[str, Any]]) -> List[str]:
     fp = (rec.get("extra") or {}).get("numeric_fingerprint")
@@ -505,6 +565,7 @@ def report(rec: Dict[str, Any], evidence_dir: str) -> str:
     parts.append(cluster_table(quality))
     parts.append(residency_table(rec))
     parts.append(kernels_table(rec))
+    parts.append(robustness_section(rec))
     parts.append(health_section(quality))
     parts.append(fingerprint_section(rec, evidence_dir, history))
     if not quality:

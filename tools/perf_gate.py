@@ -291,6 +291,35 @@ def _smoke(fixtures: str, as_json: bool) -> int:
         lm_rejected,
     ))
 
+    # robustness schema (robust round): a recovered-run record with a
+    # populated robustness section (faults, retries, a resume point)
+    # validates and gates normally on its walls...
+    verdict_rb, drifts_rb = run_gate(
+        os.path.join(fixtures, "candidate_recovered.json"), evidence
+    )
+    rb = _load_json(
+        os.path.join(fixtures, "candidate_recovered.json")
+    ).get("robustness") or {}
+    checks.append((
+        "recovered-run candidate validates and passes with a populated "
+        "robustness section",
+        verdict_rb.ok and bool(rb.get("resume_points"))
+        and bool(rb.get("recovered")),
+    ))
+    # ...while a record CLAIMING recovery with no retry/resume evidence
+    # is REJECTED by validation — survival must be demonstrated, not
+    # asserted
+    try:
+        run_gate(os.path.join(fixtures, "candidate_bad_robustness.json"),
+                 evidence)
+        rb_rejected = False
+    except ValueError as e:
+        rb_rejected = "recovered" in str(e) and "resume" in str(e)
+    checks.append((
+        "recovery claim without resume/retry evidence rejected",
+        rb_rejected,
+    ))
+
     for label, ok in checks:
         print(f"[smoke] {'ok  ' if ok else 'FAIL'} {label}")
     ok_all = all(ok for _, ok in checks)

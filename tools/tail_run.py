@@ -250,6 +250,26 @@ def render(lines: List[Dict[str, Any]],
                 ))
             if bits:
                 out.append("  quality: " + "   ".join(bits))
+        rb = hb.get("robust") or {}
+        if rb:
+            bits = []
+            if rb.get("faults"):
+                bits.append(f"faults {rb['faults']}")
+            if rb.get("retries"):
+                last = rb.get("last_retry") or {}
+                bits.append(
+                    f"RETRIES {rb['retries']}"
+                    + (f" (last: {last.get('site')}"
+                       f" {last.get('error_class')}"
+                       f" {'ok' if last.get('recovered') else 'FAILED'})"
+                       if last else "")
+                )
+            if rb.get("degradations"):
+                bits.append(f"degraded x{rb['degradations']}")
+            if rb.get("resumes"):
+                bits.append(f"resumed x{rb['resumes']}")
+            if bits:
+                out.append("  robust: " + "   ".join(bits))
     if st["stall"]:
         sl = st["stall"]
         out.append(f"  STALL #{sl.get('stalls')} at +{_fmt_dur((sl.get('ts') or 0) - float((st['header'] or {}).get('ts') or 0))}"

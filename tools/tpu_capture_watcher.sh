@@ -9,6 +9,17 @@ cd "$REPO_ROOT"
 CFGS="flagship tm100k brain1m pbmc68k cite8k"
 LOG=/tmp/tpu_capture.log
 
+# Growth cap (robust round): a watcher looping for days against a dead
+# tunnel must not grow its logs without bound — past the cap, keep the
+# newest half. tunnel_probe rotates TUNNEL_LOG.jsonl itself.
+rotate() {
+  f=$1; max=${2:-262144}
+  if [ -f "$f" ] && [ "$(wc -c < "$f")" -gt "$max" ]; then
+    tail -c $((max / 2)) "$f" > "$f.tmp" && mv "$f.tmp" "$f"
+    echo "$(date +%H:%M:%S) rotated $f" >> "$LOG"
+  fi
+}
+
 captured() {
   python - "$1" "$REPO_ROOT" <<'PY' 2>/dev/null
 import json, sys
@@ -32,6 +43,8 @@ all_done() {
 
 DEADLINE=${SCC_WATCHER_DEADLINE:-0}   # epoch seconds; 0 = no deadline
 while true; do
+  rotate "$LOG"
+  for cfg in $CFGS; do rotate "/tmp/tpu_capture_$cfg.out"; done
   if [ "$DEADLINE" -gt 0 ] && [ "$(date +%s)" -ge "$DEADLINE" ]; then
     echo "$(date +%H:%M:%S) DEADLINE reached, exiting" >> $LOG; exit 0
   fi

@@ -48,6 +48,32 @@ sys.path.insert(0, _REPO)
 BACKOFF_BASE_S = 2.0
 BACKOFF_CAP_S = 60.0
 PROBE_HEARTBEAT_S = 1.0  # probes always heartbeat (short-lived, cheap)
+# TUNNEL_LOG rotation: 133 dead probes and counting — cap the committed
+# log and roll the old half to <log>.1 (gitignored) instead of growing
+# without bound.
+LOG_CAP_BYTES = 512 << 10
+
+
+def classify_outcome(outcome: str, probe: dict) -> "str | None":
+    """Typed error class for one probe attempt (robust.retry classes):
+    'transient' (tunnel wedged / backend down — the retry-later class),
+    'resource', 'fatal', or None for a healthy probe. Stamped on every
+    TUNNEL_LOG record so the capture watcher and post-mortems can filter
+    dead-tunnel noise from real breakage without re-parsing error text."""
+    if outcome == "alive":
+        return None
+    try:
+        from scconsensus_tpu.robust.retry import classify_text
+    except Exception:
+        return None
+    cls = classify_text((probe or {}).get("error"))
+    if cls is not None:
+        return cls
+    if outcome in ("timeout", "dead"):
+        # a probe killed at its deadline or a backend that answered
+        # "down": the wait-and-retry class by definition
+        return "transient"
+    return "fatal"
 
 
 def _start_recorder(hb_base: str):
@@ -173,10 +199,20 @@ def _heartbeat_summary(hb_base: str) -> "dict | None":
 
 
 def _append_log(path: str, record: dict) -> None:
-    """One JSON line per attempt; logging failure never kills the probe."""
+    """One JSON line per attempt; logging failure never kills the probe.
+    Rotation: past LOG_CAP_BYTES the log rolls to ``<path>.1`` (one
+    generation kept) so five rounds of dead probes cannot grow the file
+    without bound."""
     if not path:
         return
     try:
+        try:
+            if os.path.getsize(path) > LOG_CAP_BYTES:
+                os.replace(path, path + ".1")
+                print(f"[tunnel_probe] rotated {path} -> {path}.1",
+                      file=sys.stderr)
+        except OSError:
+            pass
         with open(path, "a") as f:
             f.write(json.dumps(record) + "\n")
             f.flush()
@@ -266,6 +302,7 @@ def main() -> int:
                 "timeout_s": args.timeout,
                 "wall_s": round(wall, 2),
                 "outcome": outcome,
+                "error_class": classify_outcome(outcome, probe),
                 "backoff_s": backoff,
                 "probe": probe,
                 "heartbeat": _heartbeat_summary(hb_base),
