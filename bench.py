@@ -153,9 +153,15 @@ def _trim_line(parsed: dict) -> str:
         rb = parsed.pop("robustness")
         ex = parsed.setdefault("extra", {})
         for k in ("retries", "degradations", "faults_injected",
-                  "resume_points"):
+                  "resume_points", "mesh_transitions"):
             if rb.get(k):
                 ex[f"robust_{k}"] = len(rb[k])
+        if rb.get("mesh_transitions"):
+            # the elastic headline a driver must see: where the mesh
+            # ended up (the full from/to trail lives in the checkpoint)
+            ex["robust_mesh_devices"] = len(
+                rb["mesh_transitions"][-1].get("to_devices") or []
+            )
         if rb.get("recovered"):
             ex["robust_recovered"] = True
         ex["truncated"] = True

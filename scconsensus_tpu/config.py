@@ -149,6 +149,20 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "QUARANTINED (renamed *.quarantined) and recomputed "
                 "instead of crashing or silently loading garbage. Set 0 "
                 "to skip verification (trusted store, max throughput)."),
+        EnvFlag("SCC_ELASTIC", bool, True,
+                "Elastic mesh execution (robust.elastic): the pipeline's "
+                "sharded paths run under a mesh supervisor that "
+                "classifies device-loss failures, rebuilds the mesh on "
+                "surviving devices (8 → 4 → 2 → 1 shrink ladder on an "
+                "indistinct loss), re-enters the stage from its last "
+                "completed checkpoint, and stamps every transition into "
+                "the validated robustness section. Set 0 for the "
+                "pre-elastic behavior (a lost device kills the run)."),
+        EnvFlag("SCC_ELASTIC_MIN_DEVICES", int, 1,
+                "Floor of the elastic shrink ladder: a device loss that "
+                "would leave fewer devices than this is FATAL instead of "
+                "recovered (for workloads whose sharded working set "
+                "genuinely needs a minimum aggregate HBM footprint)."),
         EnvFlag("SCC_ROBUST_DE_CKPT", bool, True,
                 "Mid-stage wilcox checkpointing: with an artifact store "
                 "active, each completed window-ladder bucket persists "

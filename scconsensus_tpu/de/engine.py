@@ -201,6 +201,23 @@ class PairwiseDEResult:
         )
 
 
+def filter_cluster_names(
+    names: np.ndarray, counts: np.ndarray, min_cluster_size: int,
+    drop_grey: bool = True
+) -> List[str]:
+    """The cluster-survival rule alone (count strictly greater than the
+    floor, §2d-7; 'grey' substring dropped) over pre-computed unique
+    names + counts — shared by :func:`filter_clusters` and the
+    input-contract pre-flight, which already holds the unique pass and
+    must not pay the O(N) per-cell index just to ask who survives.
+    ``names``/``counts`` are ``np.unique(..., return_counts=True)``
+    output over str-cast labels (host arrays by construction)."""
+    keep = counts > min_cluster_size
+    if drop_grey:
+        keep &= np.char.find(names, "grey") == -1
+    return [str(n) for n in names[keep]]
+
+
 def filter_clusters(
     labels: Sequence, min_cluster_size: int, drop_grey: bool = True
 ) -> Tuple[List[str], np.ndarray]:
@@ -209,10 +226,7 @@ def filter_clusters(
     names, -1 for dropped cells)."""
     lab = np.asarray(labels).astype(str)
     names, counts = np.unique(lab, return_counts=True)
-    keep = counts > min_cluster_size
-    if drop_grey:
-        keep &= np.char.find(names, "grey") == -1
-    kept = [str(n) for n in names[keep]]
+    kept = filter_cluster_names(names, counts, min_cluster_size, drop_grey)
     index = {n: i for i, n in enumerate(kept)}
     cell_idx = np.array([index.get(v, -1) for v in lab], dtype=np.int32)
     return kept, cell_idx
@@ -425,9 +439,13 @@ class _WilcoxCkpt:
 
     PREFIX = "de_wilcox_"
 
-    def __init__(self, store):
+    def __init__(self, store, mesh=None):
         self.store = store
+        self.mesh = mesh  # the RUN's mesh; blocks stamp it as provenance
         self.resumed = 0
+        # shape-polymorphic resume bookkeeping: stored mesh shapes larger
+        # than this run's, and the checkpoint bytes adopted from them
+        self._resumed_shapes: Dict[tuple, int] = {}
 
     def key(self, ids: np.ndarray, window: int, variant: str) -> str:
         import hashlib
@@ -445,7 +463,7 @@ class _WilcoxCkpt:
         if not self.store.has(key):
             return None
         try:
-            arrays, _ = self.store.load(key)
+            arrays, meta = self.store.load(key)
         except ArtifactCorrupt:
             return None
         if not all(k in arrays for k in ("lp", "u", "ts")):
@@ -454,13 +472,58 @@ class _WilcoxCkpt:
                jnp.asarray(arrays["ts"]))
         nr = (jnp.asarray(arrays["nr"]) if "nr" in arrays else None)
         self.resumed += 1
+        self._track_shape(meta)
         return out, nr
 
+    def _track_shape(self, meta) -> None:
+        """Remember a resumed block written on a LARGER mesh than this
+        run's (one entry per distinct shape; bytes accumulate) so the
+        ladder can stamp the shape-polymorphic crossing once. The
+        crossing rule itself lives in robust.elastic — one rule, every
+        consumer."""
+        from scconsensus_tpu.parallel.mesh import mesh_device_ids
+        from scconsensus_tpu.robust.elastic import resume_crossing_from_ids
+
+        from_ids = resume_crossing_from_ids(
+            meta, mesh_device_ids(self.mesh)
+        )
+        if from_ids is None:
+            return  # same mesh, growth, or no stamp — not a crossing
+        size = int(((meta or {}).get("_integrity") or {}).get("size") or 0)
+        from_t = tuple(from_ids)
+        self._resumed_shapes[from_t] = (
+            self._resumed_shapes.get(from_t, 0) + size
+        )
+
+    def note_transitions(self) -> None:
+        """Stamp one ``cause: "resume"`` mesh transition per distinct
+        larger-mesh shape the resumed blocks were written on — the
+        ledger evidence that an 8-device checkpoint ladder re-entered on
+        this run's smaller mesh."""
+        if not self._resumed_shapes:
+            return
+        from scconsensus_tpu.parallel.mesh import mesh_device_ids
+        from scconsensus_tpu.robust import elastic as robust_elastic
+        from scconsensus_tpu.robust import record as robust_record
+
+        if not robust_elastic.elastic_enabled():
+            return
+        to_ids = mesh_device_ids(self.mesh)
+        for from_t, nbytes in sorted(self._resumed_shapes.items()):
+            robust_record.note_mesh_transition(
+                stage="wilcox_test", from_devices=list(from_t),
+                to_devices=to_ids, recovered_state_bytes=nbytes,
+                cause="resume",
+            )
+
     def save(self, key: str, ids_n: int, out, nr) -> None:
-        """Persist one completed bucket (trimmed to the real gene rows).
-        The (Gb, P) fetch is a declared residency crossing — the cost of
+        """Persist one completed bucket (trimmed to the real gene rows),
+        stamped with the mesh shape it was computed on (the resume side
+        reads the stamp to record shape-polymorphic crossings). The
+        (Gb, P) fetch is a declared residency crossing — the cost of
         mid-stage durability, paid only when a store is active."""
         from scconsensus_tpu.obs.residency import boundary as _rbound
+        from scconsensus_tpu.parallel.mesh import mesh_shape_meta
 
         arrays = {}
         with _rbound("de_ckpt_fetch"):
@@ -471,7 +534,8 @@ class _WilcoxCkpt:
                       "ts": np.asarray(ts)}
             if nr is not None:
                 arrays["nr"] = np.asarray(jax.device_get(nr[:ids_n]))
-        self.store.save(key, arrays)
+        self.store.save(key, arrays,
+                        meta={"mesh_shape": mesh_shape_meta(self.mesh)})
 
 
 class _LadderRecovery:
@@ -529,6 +593,14 @@ class _LadderRecovery:
             return False
         err_class = robust_retry.classify_exception(ev)
         run = robust_record.current_run()
+        if err_class == "device_lost":
+            # the ladder cannot rebuild its own mesh: propagate to the
+            # stage-level guard, whose elastic supervisor shrinks the
+            # mesh and re-enters the WHOLE stage — completed buckets
+            # short-circuit through their checkpoints, so the re-entry
+            # resumes from exactly where the mesh died (no note_retry
+            # here: the stage-level policy records the recovery)
+            return False
         if (err_class == "fatal"
                 or self.attempt >= self.MAX_BUCKET_ATTEMPTS
                 or not run.budget_take()):
@@ -563,13 +635,13 @@ class _LadderRecovery:
         return True
 
 
-def _wilcox_ckpt_for(config_store) -> Optional[_WilcoxCkpt]:
+def _wilcox_ckpt_for(config_store, mesh=None) -> Optional[_WilcoxCkpt]:
     """The ladder's checkpoint handle: store present + flag on."""
     from scconsensus_tpu.config import env_flag
 
     if (config_store is not None and getattr(config_store, "enabled", False)
             and env_flag("SCC_ROBUST_DE_CKPT")):
-        return _WilcoxCkpt(config_store)
+        return _WilcoxCkpt(config_store, mesh=mesh)
     return None
 
 
@@ -959,6 +1031,9 @@ def _run_wilcox_device(
             robust_record.note_resume_point(
                 "wilcox_test", "bucket", ckpt.resumed, len(parts)
             )
+            # blocks written on a larger mesh: stamp the shape-
+            # polymorphic crossing (one transition per stored shape)
+            ckpt.note_transitions()
         if use_runspace and overflow:
             _redo_overflow_genes(
                 parts, overflow, refetch, jn, jpi, jpj, K, RUN_CAP,
@@ -1278,7 +1353,7 @@ def pairwise_de(
                 log_p, u_dev = _run_wilcox_device(
                     data, cell_idx_of, pair_i, pair_j,
                     mesh=mesh, jdata=jdata, probe_out=srec,
-                    ckpt=_wilcox_ckpt_for(store),
+                    ckpt=_wilcox_ckpt_for(store, mesh=mesh),
                 )
             if method == "roc":
                 # The reference's roc branch never produces a p-value usable

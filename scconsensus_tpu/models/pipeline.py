@@ -197,10 +197,18 @@ def _refine_impl(
 
     logger = timer.logger
     store = ArtifactStore(config.artifact_dir)
-    if mesh == "auto":
-        from scconsensus_tpu.parallel.mesh import auto_mesh
+    # Elastic mesh execution (robust.elastic): the supervisor owns mesh
+    # construction for the sharded paths — "auto" and explicit meshes
+    # both resolve through it (SCC_ELASTIC=0 restores the bare
+    # auto_mesh behavior). Stage closures read _mesh() at CALL time, so
+    # a device_lost retry re-enters against the rebuilt, smaller mesh.
+    from scconsensus_tpu.robust.elastic import ElasticMeshSupervisor
 
-        mesh = auto_mesh()
+    supervisor, mesh = ElasticMeshSupervisor.resolve(mesh)
+
+    def _mesh():
+        return supervisor.mesh if supervisor is not None else mesh
+
     if is_sparse(data):
         data = as_csr(data)
     elif is_jax(data):
@@ -217,19 +225,65 @@ def _refine_impl(
     def _rows_dense(idx: np.ndarray) -> np.ndarray:
         """Dense (|idx|, N) gather of gene rows (sparse-safe)."""
         return rows_dense(data, idx)
-    if len(labels) != N:
-        raise ValueError(f"labels length {len(labels)} != n_cells {N}")
 
+    # Input-contract pre-flight (robust.contract): degenerate inputs —
+    # shape mismatches, NaN/Inf in the matrix, labelings with no pairable
+    # clusters — fail HERE with a one-line typed InputContractError
+    # instead of a deep-stack crash; repair-policy findings land on the
+    # robustness log. Self-measured, so the <2% overhead guard prices it.
+    from scconsensus_tpu.robust import contract as robust_contract
+
+    with robust_record.timed():
+        robust_contract.preflight(data, labels, config)
+
+    if supervisor is not None:
+        # the sharded working set a shrink must re-lay-out: rides every
+        # mesh transition's recovered_state_bytes
+        supervisor.note_live_state(data)
+
+    run_log = robust_record.current_run()
     if store.enabled:
         from scconsensus_tpu.utils.artifacts import input_fingerprint
 
         store.check_config(config.to_json(), inputs=input_fingerprint(data, labels))
+        # Retry-budget persistence: seed budget_used from the store's
+        # robust_state sidecar (a kill-and-resume cycle must not refresh
+        # its allowance) and mirror every later take back into it.
+        try:
+            _, rb_meta = store.load("robust_state")
+            if rb_meta.get("budget_used"):
+                run_log.restore_budget(int(rb_meta["budget_used"]))
+        except ValueError:
+            pass  # quarantined sidecar: budget restarts, run continues
+        run_log.set_budget_persist(
+            lambda used: store.save("robust_state",
+                                    meta={"budget_used": used})
+        )
     # Stage-boundary recovery (robust.retry): each stage's compute runs
     # under the typed policy — transient/resource faults (injected or
-    # real) retry with backoff instead of killing the run; ValueError &
-    # co. stay fatal and propagate exactly as before. The fault plan's
-    # ``stage:<name>`` sites fire at each attempt's entry.
-    _guard = robust_retry.call
+    # real) retry with backoff instead of killing the run; device_lost
+    # faults hand the elastic supervisor the shrink before the retry;
+    # ValueError & co. stay fatal and propagate exactly as before. The
+    # fault plan's ``stage:<name>`` sites fire at each attempt's entry.
+
+    def _guard(fn, site, degrade=None):
+        return robust_retry.call(
+            fn, site, degrade=degrade,
+            on_device_loss=(supervisor.loss_handler(site)
+                            if supervisor is not None else None),
+        )
+
+    def _stage_cached(stage, fn):
+        """store.cached with elastic mesh provenance: saves stamp the
+        CURRENT mesh shape; resumes hand the stored stamp to the
+        supervisor, which records shape-polymorphic shrinks."""
+        if supervisor is None:
+            return store.cached(stage, fn)
+        return store.cached(
+            stage, fn,
+            meta_fn=lambda: {"mesh_shape": supervisor.shape_meta()},
+            on_load_meta=lambda m: supervisor.note_artifact_meta(stage, m),
+        )
 
     de_res = None
     if store.has("de"):
@@ -237,25 +291,31 @@ def _refine_impl(
             # ArtifactCorrupt (checksum mismatch / truncated zip) is a
             # ValueError: the store has already quarantined the files,
             # and the stage recomputes below
-            de_res = PairwiseDEResult.from_store(*store.load("de"))
+            de_arrays, de_meta = store.load("de")
+            if supervisor is not None:
+                supervisor.note_artifact_meta("de", de_meta)
+            de_res = PairwiseDEResult.from_store(de_arrays, de_meta)
             logger.info("stage de: resumed from artifact store")
         except ValueError as e:
             logger.warning("stage de: artifact unusable (%s); recomputing", e)
     if de_res is None:
         de_res = _guard(
             lambda: pairwise_de(data, labels, config, timer=timer,
-                                mesh=mesh, store=store),
+                                mesh=_mesh(), store=store),
             site="stage:de",
         )
         if store.enabled:  # to_store() materializes every lazy device field
-            store.save("de", *de_res.to_store())
+            de_arrays, de_meta = de_res.to_store()
+            if supervisor is not None:
+                de_meta["mesh_shape"] = supervisor.shape_meta()
+            store.save("de", de_arrays, de_meta)
             # the covering artifact landed: the ladder's mid-stage
             # checkpoint blocks have served their purpose
             store.discard_prefix("de_wilcox_")
 
     with timer.stage("union") as rec:
         union = _guard(
-            lambda: store.cached(
+            lambda: _stage_cached(
                 "union",
                 lambda: {"idx": de_gene_union(de_res,
                                               config.n_top_de_genes)},
@@ -315,9 +375,13 @@ def _refine_impl(
             )
 
         embedding = _guard(
-            lambda: store.cached("embed", _embed),
+            lambda: _stage_cached("embed", _embed),
             site="stage:embed", degrade=_embed_degrade,
         )["scores"]
+        if supervisor is not None:
+            # the embedding joins the sharded working set (tree knn /
+            # ring silhouette consume it on the mesh)
+            supervisor.note_live_state(data, embedding)
         if obs_quality.enabled():
             # a NaN/Inf PCA score silently corrupts every downstream
             # distance/tree/cut — trip here, span-attributed
@@ -349,7 +413,7 @@ def _refine_impl(
                 from scconsensus_tpu.ops.knn_linkage import knn_ward_linkage
 
                 t = knn_ward_linkage(embedding, k=config.knn_graph_k,
-                                     mesh=mesh)
+                                     mesh=_mesh())
                 return {"merge": t.merge, "height": t.height, "order": t.order}
             if lm_policy is not None:
                 from scconsensus_tpu.ops.pooling import landmark_ward_linkage
@@ -364,7 +428,7 @@ def _refine_impl(
                     k_max=lm_policy["k_max"],
                     linkage=lm_policy["linkage"],
                     knn_k=lm_policy["knn_k"],
-                    mesh=mesh,
+                    mesh=_mesh(),
                 )
                 return {"merge": t.merge, "height": t.height, "order": t.order,
                         "pool_assign": assign, "pool_centroids": cents,
@@ -387,7 +451,7 @@ def _refine_impl(
             t = ward_linkage(embedding)
             return {"merge": t.merge, "height": t.height, "order": t.order}
 
-        tree_arrays = _guard(lambda: store.cached("tree", _tree),
+        tree_arrays = _guard(lambda: _stage_cached("tree", _tree),
                              site="stage:tree")
         tree = HClustTree(
             merge=tree_arrays["merge"],
@@ -459,7 +523,7 @@ def _refine_impl(
                 out[f"ds{dsv}"] = cut_labels
             return out
 
-        cut_arrays = _guard(lambda: store.cached("cuts", _cuts),
+        cut_arrays = _guard(lambda: _stage_cached("cuts", _cuts),
                             site="stage:cuts")
         for dsv in config.deep_split_values:
             cut_labels = cut_arrays[f"ds{dsv}"]
@@ -509,7 +573,6 @@ def _refine_impl(
 
     if config.compat.return_silhouette:
         with timer.stage("silhouette") as sil_rec:
-            approx_si = N > config.approx_threshold and mesh is None
             # excluded-cell masking (label 0 → −1), shared by every branch
             labs = [
                 np.where(dynamic_labels[f"deepsplit: {dsv}"] > 0,
@@ -519,12 +582,16 @@ def _refine_impl(
             # recovery wrapper: the branch ladder runs as _silhouette()
             # under the typed retry policy — idempotent (it only assigns
             # per-cut info keys), so a transient-fault retry recomputes
-            # cleanly
+            # cleanly; the mesh reads fresh per attempt, so a device_lost
+            # retry rides the supervisor's shrunk mesh (or the serial
+            # branch once the mesh is gone)
             def _silhouette():
-                if mesh is not None:
+                mesh_now = _mesh()
+                approx_si = N > config.approx_threshold and mesh_now is None
+                if mesh_now is not None:
                     for info, lab in zip(deep_split_info, labs):
                         si, _per = mean_cluster_silhouette(
-                            embedding, lab, mesh=mesh
+                            embedding, lab, mesh=mesh_now
                         )
                         info["silhouette"] = si
                 elif approx_si:
@@ -649,6 +716,16 @@ def _refine_impl(
                 gene_labels=union_names.astype(str),
                 filename=config.plot_name,
             )
+    if store.enabled:
+        # the run COMPLETED: reset the persisted retry budget. The
+        # robust_state ratchet exists so a kill-and-resume cycle cannot
+        # refresh its allowance mid-run; a successful completion ENDS
+        # the run, and the next run over this store starts fresh
+        # (failure paths never reach here, so their ratchet stands).
+        try:
+            store.save("robust_state", meta={"budget_used": 0})
+        except Exception:
+            pass
     return result
 
 

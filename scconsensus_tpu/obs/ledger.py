@@ -369,6 +369,16 @@ class Ledger:
                 "resume_points": len(rb.get("resume_points") or []),
                 "recovered": bool(rb.get("recovered")),
             }
+            if rb.get("mesh_transitions"):
+                # elastic runs additionally index the mesh trail (count
+                # + final device count) — absent on mesh-stable runs, so
+                # pre-elastic manifest consumers see an unchanged shape
+                entry["robustness"]["mesh_transitions"] = len(
+                    rb["mesh_transitions"]
+                )
+                entry["robustness"]["mesh_devices"] = len(
+                    rb["mesh_transitions"][-1].get("to_devices") or []
+                )
         fp = (rec.get("extra") or {}).get("numeric_fingerprint")
         if isinstance(fp, dict) and fp:
             # every ingested run is fingerprint-stamped on its manifest

@@ -18,9 +18,37 @@ from jax.sharding import Mesh
 __all__ = [
     "make_mesh", "auto_mesh", "drain_if_cpu_mesh", "pad_axis_to_multiple",
     "pad_and_shard", "put_sharded", "require_dense", "CELL_AXIS",
+    "mesh_shape_meta", "mesh_device_ids",
 ]
 
 CELL_AXIS = "cells"
+
+
+def mesh_device_ids(mesh: Optional[Mesh]) -> list:
+    """Sorted device ids of a mesh (``[0]`` for the serial ``None`` path —
+    the 1-device mesh equivalent, which is what a mesh run shrinks to)."""
+    if mesh is None:
+        return [0]
+    return sorted(int(d.id) for d in mesh.devices.flat)
+
+
+def mesh_shape_meta(mesh: Optional[Mesh],
+                    axis_name: str = CELL_AXIS) -> dict:
+    """JSON-able mesh-shape stamp for checkpoint/artifact sidecars — the
+    provenance a shape-polymorphic resume reads to know which mesh the
+    bytes were computed on (robust.elastic compares it against the
+    resuming run's mesh and records the shrink as a mesh transition).
+    ``None`` stamps the serial path as a 1-device shape."""
+    if mesh is None:
+        return {"n_devices": 1, "device_ids": [0], "axis": axis_name,
+                "platform": None}
+    devs = list(mesh.devices.flat)
+    return {
+        "n_devices": len(devs),
+        "device_ids": sorted(int(d.id) for d in devs),
+        "axis": str(mesh.axis_names[0]) if mesh.axis_names else axis_name,
+        "platform": devs[0].platform if devs else None,
+    }
 
 
 def put_sharded(x, mesh: Mesh, spec):

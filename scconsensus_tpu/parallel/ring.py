@@ -82,6 +82,11 @@ def ring_cluster_distance_sums(
     """
     require_dense(x, onehot)
     mesh = mesh or make_mesh(axis_name=axis_name)
+    # mid-engine fault site: a device_loss here models a chip dying in
+    # the ring rotation (the silhouette stage guard's supervisor recovers)
+    from scconsensus_tpu.robust.faults import fault_point
+
+    fault_point("ring:distance_sums")
     n_shards = mesh.devices.size
     n = x.shape[0]
     xp, _ = pad_axis_to_multiple(np.asarray(x, np.float32), 0, n_shards)

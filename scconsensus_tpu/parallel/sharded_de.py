@@ -23,6 +23,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from scconsensus_tpu.obs import trace as obs_trace
 from scconsensus_tpu.obs.cost import attach_cost
+from scconsensus_tpu.robust.faults import fault_point
 from scconsensus_tpu.ops.gates import ClusterAggregates
 from scconsensus_tpu.ops.wilcoxon import wilcoxon_pairs_tile
 from scconsensus_tpu.parallel.mesh import (
@@ -85,6 +86,10 @@ def sharded_aggregates(
     with obs_trace.span(
         "sharded_aggregates", n_shards=int(mesh.devices.size),
     ) as sp:
+        # plan-injectable mid-engine fault site (robust.faults): a
+        # device_loss here models a chip dying inside the psum, and
+        # propagates to the stage guard whose supervisor rebuilds the mesh
+        fault_point("sharded:aggregates")
         # pad_and_shard keeps a device-resident jax.Array on device (pad +
         # redistribute in HBM); host numpy pads on host and uploads sharded
         # — on a multi-process mesh each process uploads only its
@@ -192,6 +197,9 @@ def sharded_allpairs_ranksum(
         "sharded_ranksum", n_shards=int(mesh.devices.size),
         n_genes=int(gc), window=int(window),
     ):
+        # mid-engine fault site: fires per bucket, so a device_loss plan
+        # can kill the mesh between completed (checkpointed) buckets
+        fault_point("sharded:ranksum")
         # host input pads+uploads; device-resident input pads+redistributes
         # in HBM — either way the jitted shard_map sees a pre-laid-out
         # operand

@@ -90,6 +90,11 @@ def _build_step(agg_fn, wilcox_fn, sil_fn, *, min_pct, log_fc_thrs, q_val_thrs, 
         # 'stage'-sync tracer leaves inner spans unsynced, so the jitted
         # program's async pipelining is untouched)
         with obs_trace.span("refine_step") as sp:
+            # plan-injectable fault site (robust.faults): elastic/chaos
+            # plans can kill the fused mesh program between steps
+            from scconsensus_tpu.robust.faults import fault_point
+
+            fault_point("refine_step")
             out = jitted(*args, **kw)
             sp["n_outputs"] = len(out)
             return out

@@ -1,6 +1,7 @@
-"""Survivable-pipeline layer: fault injection + typed recovery (round 13).
+"""Survivable-pipeline layer: fault injection + typed recovery (round 13),
+elastic mesh execution + input contracts (round 14).
 
-Three pieces, one contract:
+The pieces, one contract:
 
 * :mod:`~scconsensus_tpu.robust.faults` — deterministic, plan-driven
   injection of named fault classes (device OOM, transient backend error,
@@ -14,10 +15,20 @@ Three pieces, one contract:
   ``utils.devcache``'s old ad-hoc evict-and-retry now rides this policy.
 * :mod:`~scconsensus_tpu.robust.record` — the per-run robustness log and
   the validated ``robustness`` run-record section (faults injected,
-  retries, degradations, resume points) that flows through the ledger,
-  ``tools/explain_run.py`` and ``tools/tail_run.py``. A record claiming
-  recovery without retry/resume evidence is REJECTED by
-  ``validate_run_record``.
+  retries, degradations, resume points, mesh transitions) that flows
+  through the ledger, ``tools/explain_run.py`` and
+  ``tools/tail_run.py``. A record claiming recovery without
+  retry/resume/transition evidence is REJECTED by
+  ``validate_run_record``; so is a mesh transition whose device set
+  does not shrink.
+* :mod:`~scconsensus_tpu.robust.elastic` — the elastic mesh supervisor:
+  device-loss classification + mesh rebuild on survivors (the
+  8 → 4 → 2 → 1 shrink ladder), shape-polymorphic checkpoint resume
+  (mesh_shape provenance on every artifact), every movement stamped as
+  a validated mesh transition.
+* :mod:`~scconsensus_tpu.robust.contract` — input-contract pre-flight
+  at the ``refine()`` boundary: named repair-or-reject policies that
+  turn degenerate inputs into one-line typed diagnoses.
 
 The recovery *surfaces* live where the work lives: the wilcox ladder
 persists per-bucket completion into the ``ArtifactStore`` (mid-stage
@@ -27,8 +38,16 @@ the observed termination cause (stall -> capture armed, oom -> degraded,
 repeated crash -> poisoned config).
 """
 
+from scconsensus_tpu.robust.contract import (  # noqa: F401
+    InputContractError,
+)
+from scconsensus_tpu.robust.elastic import (  # noqa: F401
+    DeviceLossUnrecoverable,
+    ElasticMeshSupervisor,
+)
 from scconsensus_tpu.robust.faults import (  # noqa: F401
     FAULT_CLASSES,
+    InjectedDeviceLoss,
     InjectedFault,
     InjectedResourceExhausted,
     InjectedTransientError,
@@ -39,6 +58,7 @@ from scconsensus_tpu.robust.record import (  # noqa: F401
     begin_run,
     current_run,
     live_summary,
+    note_mesh_transition,
     note_resume_point,
     validate_robustness,
 )

@@ -1,0 +1,202 @@
+"""Input-contract pre-flight for the ``refine()`` boundary.
+
+Degenerate inputs used to surface as deep-stack crashes — an all-NaN
+matrix dies inside the rank-sum kernel, a labels/matrix shape mismatch
+inside an indexing op, a labeling with every cluster below the size
+floor as a ``K < 2`` ValueError three frames into ``pairwise_de``. The
+pre-flight turns each into a one-line, typed diagnosis at the boundary,
+under a NAMED policy per check:
+
+  ====================  ======  =============================================
+  check                 policy  behavior
+  ====================  ======  =============================================
+  shape                 reject  data must be 2-D with G, N >= 1 and
+                                len(labels) == N
+  nonfinite_matrix      reject  any NaN/Inf in the expression matrix (one
+                                bandwidth-bound sum pass; a full scan runs
+                                only to diagnose an already-failed check)
+  nan_labels            reject  float-NaN label values (they would collapse
+                                into a single "nan" pseudo-cluster)
+  degenerate_clusters   reject  fewer than 2 clusters survive the engine's
+                                size filter (empty/singleton/sub-floor
+                                clusters cannot be paired for DE)
+  noncontiguous_ids     repair  integer label ids with gaps are accepted
+                                as-is (labels are categorical NAMES here;
+                                the repair is the canonical str-cast every
+                                stage already applies) — recorded on the
+                                robustness log so the normalization is
+                                visible
+  small_clusters        repair  clusters at/below min_cluster_size are
+                                dropped by the engine (reference semantics);
+                                pre-flight names them up front
+  ====================  ======  =============================================
+
+Reject-policy violations raise :class:`InputContractError` (a ValueError
+subclass, so callers that guarded the old deep-stack errors keep
+working); repair-policy findings are logged and — when they changed
+anything — recorded as ``input_contract`` degradations on the run's
+robustness trail.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+__all__ = ["InputContractError", "CHECKS", "preflight"]
+
+
+class InputContractError(ValueError):
+    """A refine() input violated a reject-policy contract check. The
+    message is the one-line diagnosis; ``check`` names the failed check
+    (a key of :data:`CHECKS`)."""
+
+    def __init__(self, check: str, msg: str):
+        super().__init__(f"input contract [{check}]: {msg}")
+        self.check = check
+
+
+# check name -> policy; the docs table and the tests read this registry
+CHECKS: Dict[str, str] = {
+    "shape": "reject",
+    "nonfinite_matrix": "reject",
+    "nan_labels": "reject",
+    "degenerate_clusters": "reject",
+    "noncontiguous_ids": "repair",
+    "small_clusters": "repair",
+}
+
+
+def _matrix_sum(data) -> float:
+    """One bandwidth-bound reduction whose result is non-finite iff the
+    matrix holds any NaN/Inf (inf - inf folds to NaN; both stay
+    non-finite through the sum). float64 accumulation so a large finite
+    matrix cannot overflow into a false positive."""
+    from scconsensus_tpu.io.sparsemat import is_jax, is_sparse
+
+    if is_sparse(data):
+        return float(np.sum(data.data, dtype=np.float64)) if data.nnz \
+            else 0.0
+    if is_jax(data):
+        import jax.numpy as jnp
+
+        # float32 accumulation (x64 is typically disabled): log-normalized
+        # expression sums sit ~30 orders of magnitude under f32 overflow,
+        # so a finite matrix cannot false-positive
+        return float(jnp.sum(data))
+    return float(np.sum(data, dtype=np.float64))
+
+
+def _nonfinite_counts(data) -> Dict[str, int]:
+    """Full diagnostic scan — only runs once the cheap sum already failed
+    the check, so the one-line diagnosis can say HOW the matrix is bad."""
+    from scconsensus_tpu.io.sparsemat import is_jax, is_sparse
+
+    if is_sparse(data):
+        vals = np.asarray(data.data)
+    elif is_jax(data):
+        import jax.numpy as jnp
+
+        return {"nan": int(jnp.isnan(data).sum()),
+                "inf": int(jnp.isinf(data).sum())}
+    else:
+        vals = np.asarray(data)
+    return {"nan": int(np.isnan(vals).sum()),
+            "inf": int(np.isinf(vals).sum())}
+
+
+def preflight(data, labels, config) -> List[Dict[str, Any]]:
+    """Run every contract check against a refine() call's inputs.
+
+    Raises :class:`InputContractError` on the first reject-policy
+    violation; returns the list of repair records (possibly empty) —
+    each ``{"check", "policy", "detail"}`` — which the pipeline also
+    notes on the robustness log so a repaired run says so.
+    """
+    from scconsensus_tpu.robust import record as robust_record
+
+    repairs: List[Dict[str, Any]] = []
+
+    # shape — everything downstream indexes (G, N) against labels
+    shape = getattr(data, "shape", None)
+    if shape is None or len(shape) != 2:
+        raise InputContractError(
+            "shape", f"expression matrix must be 2-D (genes × cells), "
+                     f"got shape {shape!r}")
+    G, N = int(shape[0]), int(shape[1])
+    if G < 1 or N < 1:
+        raise InputContractError(
+            "shape", f"expression matrix must be non-empty, got "
+                     f"({G} genes × {N} cells)")
+    if len(labels) != N:
+        raise InputContractError(
+            "shape", f"labels length {len(labels)} != n_cells {N}")
+
+    # nan_labels — float NaN would str()-collapse into one "nan" cluster
+    lab_arr = np.asarray(labels)
+    if lab_arr.dtype.kind == "f" and bool(np.isnan(lab_arr).any()):
+        n_bad = int(np.isnan(lab_arr).sum())
+        raise InputContractError(
+            "nan_labels", f"{n_bad} of {N} labels are NaN — every one "
+                          "would alias into a single 'nan' pseudo-cluster")
+
+    # nonfinite_matrix — one reduction; full scan only for the diagnosis
+    s = _matrix_sum(data)
+    if not np.isfinite(s):
+        c = _nonfinite_counts(data)
+        raise InputContractError(
+            "nonfinite_matrix",
+            f"expression matrix contains {c['nan']} NaN and {c['inf']} "
+            f"Inf value(s) — clean or mask them before refine()")
+
+    # noncontiguous_ids (repair) — integer labelings with gaps are legal
+    # (labels are categorical names), but the gap usually means an
+    # upstream filter dropped clusters; say so once
+    if lab_arr.dtype.kind in "iu":
+        uniq = np.unique(lab_arr)
+        lo, hi = int(uniq.min()), int(uniq.max())
+        if uniq.size and uniq.size != hi - lo + 1:
+            repairs.append({
+                "check": "noncontiguous_ids", "policy": "repair",
+                "detail": f"integer label ids have gaps ({uniq.size} "
+                          f"distinct ids spanning [{lo}, {hi}]); treated "
+                          "as categorical names",
+            })
+
+    # degenerate_clusters / small_clusters — the engine's own survival
+    # rule, applied at the boundary so the failure is one line, not a
+    # stack. One unique pass; no O(N) per-cell index (pairwise_de builds
+    # that itself right after).
+    from scconsensus_tpu.de.engine import filter_cluster_names
+
+    lab_str = lab_arr.astype(str)
+    all_names, counts = np.unique(lab_str, return_counts=True)
+    names = filter_cluster_names(
+        all_names, counts, config.min_cluster_size, config.drop_grey
+    )
+    dropped = [
+        f"{n!s}({c})" for n, c in zip(all_names, counts)
+        if str(n) not in names
+    ]
+    if len(names) < 2:
+        raise InputContractError(
+            "degenerate_clusters",
+            f"only {len(names)} cluster(s) survive the size filter "
+            f"(min_cluster_size={config.min_cluster_size}, "
+            f"drop_grey={config.drop_grey}); dropped: "
+            f"{', '.join(dropped) if dropped else 'none'} — pairwise DE "
+            "needs at least 2 clusters")
+    if dropped:
+        repairs.append({
+            "check": "small_clusters", "policy": "repair",
+            "detail": f"dropped {len(dropped)} empty/singleton/sub-floor "
+                      f"cluster(s) before DE: {', '.join(dropped[:8])}"
+                      + (" …" if len(dropped) > 8 else ""),
+        })
+
+    for r in repairs:
+        robust_record.note_degradation(
+            "input_contract", f"repair:{r['check']}", r["detail"]
+        )
+    return repairs

@@ -1,0 +1,309 @@
+"""Elastic mesh execution: device-loss recovery + shape-polymorphic resume.
+
+The round-13 ``robust/`` subsystem made the single-process pipeline
+survivable; this module makes the MESH layer survivable. One
+:class:`ElasticMeshSupervisor` per pipeline run owns mesh construction
+for the sharded paths (wrapping ``parallel.mesh.auto_mesh``/``make_mesh``)
+and implements the two halves of elasticity:
+
+**In-process device loss.** A stage failing with a ``device_lost``-class
+error (robust.retry classification: real XLA device-loss signatures, the
+injected ``device_loss`` fault class) retries through the typed policy
+with the supervisor's :meth:`loss_handler` as the ``on_device_loss``
+hook: the supervisor probes the current mesh's devices, rebuilds the
+mesh on survivors (an indistinct failure — every device still answers
+the probe, which is what an injected fault looks like — shrinks
+deterministically by halving onto the lowest-id devices: 8 → 4 → 2 → 1),
+records the transition on the run's robustness log, and the stage
+re-enters. Live sharded state needs no explicit migration: every sharded
+entry point lays its operands out per call (``pad_and_shard`` against
+the mesh it is handed), so the re-entered stage re-pads onto the new
+shard count by construction — the supervisor only has to hand it the
+smaller mesh and account the bytes.
+
+**Shape-polymorphic resume.** Stage artifacts and the ``_WilcoxCkpt``
+bucket checkpoints carry a ``mesh_shape`` stamp
+(``parallel.mesh.mesh_shape_meta``). Artifacts hold only mesh-invariant
+results (the mesh-vs-serial parity tests pin that), so a checkpoint
+written on an 8-device mesh resumes with identical labels on 4, 2, or 1
+devices; when a resume adopts state computed on a LARGER mesh, the
+supervisor stamps a ``cause: "resume"`` mesh transition (from the stored
+device set to the live one) so the ledger record proves the
+shape-polymorphic crossing happened.
+
+Both transition kinds flow through the validated ``robustness``
+run-record section (``mesh_transitions``), the ledger manifest summary,
+``explain_run.py``, and the heartbeat stream's robust panel.
+
+Gated by ``SCC_ELASTIC`` (default on — with no fault the supervisor is
+one attribute read per stage); ``SCC_ELASTIC_MIN_DEVICES`` floors the
+shrink ladder (below it a device loss is fatal, for runs whose working
+set genuinely needs a minimum HBM footprint).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from scconsensus_tpu.config import env_flag
+from scconsensus_tpu.robust import record as robust_record
+
+__all__ = [
+    "ElasticMeshSupervisor",
+    "elastic_enabled",
+    "resume_crossing_from_ids",
+    "DeviceLossUnrecoverable",
+]
+
+
+class DeviceLossUnrecoverable(RuntimeError):
+    """A device was lost and there is no smaller mesh to shrink to (the
+    floor is ``SCC_ELASTIC_MIN_DEVICES``, default 1). Classified fatal by
+    robust.retry — retrying cannot help."""
+
+
+def elastic_enabled() -> bool:
+    return bool(env_flag("SCC_ELASTIC"))
+
+
+def resume_crossing_from_ids(meta: Optional[Dict[str, Any]],
+                             to_ids: List[int]) -> Optional[List[int]]:
+    """THE shape-polymorphic crossing rule, in one place: the sorted
+    stored device ids when ``meta``'s ``mesh_shape`` stamp names a
+    STRICTLY larger device set than the live ``to_ids`` (i.e. this
+    resume shrinks), else None — same shape, growth, and unstamped
+    legacy artifacts are not elastic crossings. Both consumers (the
+    supervisor's artifact resumes and the wilcox bucket checkpoints)
+    route through here so the ledger evidence cannot diverge."""
+    shape = (meta or {}).get("mesh_shape")
+    if not isinstance(shape, dict):
+        return None
+    from_ids = shape.get("device_ids")
+    if not isinstance(from_ids, list) or not from_ids:
+        n = shape.get("n_devices")
+        if not isinstance(n, int) or n < 1:
+            return None
+        from_ids = list(range(n))
+    from_ids = sorted(int(d) for d in from_ids)
+    if not (set(int(d) for d in to_ids) < set(from_ids)):
+        return None
+    return from_ids
+
+
+class ElasticMeshSupervisor:
+    """Owns the mesh for one pipeline run and shrinks it on device loss.
+
+    Stage closures must read :attr:`mesh` at CALL time (not capture the
+    mesh object): after a loss the property serves the rebuilt, smaller
+    mesh, and the retrying stage re-enters against it. A mesh shrunk to
+    one device serves ``None`` — the serial path, which the parity tests
+    pin as result-identical to every mesh size.
+    """
+
+    def __init__(self, devices: Optional[List[Any]] = None,
+                 axis_name: Optional[str] = None,
+                 auto: bool = True):
+        from scconsensus_tpu.parallel.mesh import CELL_AXIS
+
+        self.axis_name = axis_name or CELL_AXIS
+        # auto=True follows the auto_mesh policy (all visible devices,
+        # serial below 2); an explicit device list pins the starting mesh
+        self._auto = devices is None and auto
+        self._devices = list(devices) if devices is not None else None
+        self._mesh = None
+        self._mesh_built = False
+        self.min_devices = max(int(env_flag("SCC_ELASTIC_MIN_DEVICES")), 1)
+        self.live_state_bytes = 0
+        self.transitions = 0
+        self._resume_stamped: set = set()
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def resolve(cls, mesh) -> Tuple[Optional["ElasticMeshSupervisor"], Any]:
+        """The pipeline's mesh policy, supervised.
+
+        ``mesh`` is refine()'s argument: "auto", an explicit Mesh, or
+        None. Returns ``(supervisor, initial_mesh)`` — supervisor is None
+        when SCC_ELASTIC is off (the pre-elastic behavior, byte-for-byte:
+        the caller uses ``initial_mesh`` directly). A serial (None) run
+        still gets a supervisor: it cannot lose a device, but it CAN
+        resume artifacts checkpointed on a larger mesh, and that shrink
+        must be stamped.
+        """
+        if not elastic_enabled():
+            if mesh == "auto":
+                from scconsensus_tpu.parallel.mesh import auto_mesh
+
+                mesh = auto_mesh()
+            return None, mesh
+        if mesh == "auto":
+            sup = cls(auto=True)
+        elif mesh is None:
+            sup = cls(devices=[], auto=False)
+        else:
+            sup = cls(devices=list(mesh.devices.flat), auto=False,
+                      axis_name=(str(mesh.axis_names[0])
+                                 if mesh.axis_names else None))
+        return sup, sup.mesh
+
+    def _device_list(self) -> List[Any]:
+        if self._devices is None:
+            import jax
+
+            self._devices = list(jax.devices())
+        return self._devices
+
+    @property
+    def mesh(self):
+        """The current mesh (None = serial). Rebuilt lazily after a
+        shrink; repeat reads between transitions return the same Mesh
+        object so the sharded engines' jit caches keep hitting."""
+        if not self._mesh_built:
+            devs = self._device_list()
+            if len(devs) < 2:
+                self._mesh = None  # the auto_mesh serial policy
+            else:
+                from scconsensus_tpu.parallel.mesh import make_mesh
+
+                self._mesh = make_mesh(devices=devs,
+                                       axis_name=self.axis_name)
+            self._mesh_built = True
+        return self._mesh
+
+    @property
+    def n_devices(self) -> int:
+        return max(len(self._device_list()), 1)
+
+    def device_ids(self) -> List[int]:
+        from scconsensus_tpu.parallel.mesh import mesh_device_ids
+
+        return mesh_device_ids(self.mesh)
+
+    def shape_meta(self) -> Dict[str, Any]:
+        from scconsensus_tpu.parallel.mesh import mesh_shape_meta
+
+        return mesh_shape_meta(self.mesh, self.axis_name)
+
+    # -- live-state accounting --------------------------------------------
+    def note_live_state(self, *arrays) -> None:
+        """Declare the sharded working set (re-laid-out on every shrink);
+        its byte count rides each transition's recovered_state_bytes."""
+        total = 0
+        for x in arrays:
+            if x is None:
+                continue
+            nbytes = getattr(x, "nbytes", None)
+            if nbytes is None and hasattr(x, "data"):  # scipy sparse
+                nbytes = getattr(x.data, "nbytes", 0)
+            total += int(nbytes or 0)
+        self.live_state_bytes = total
+
+    # -- in-process device loss -------------------------------------------
+    @staticmethod
+    def _probe_device(dev) -> bool:
+        """One tiny round-trip through the device. A lost/preempted chip
+        raises out of device_put or the ready-wait; a WEDGED (silently
+        hanging) device is the stall watchdog's territory, not ours."""
+        import jax
+
+        try:
+            x = jax.device_put(np.zeros(8, np.float32), dev)
+            jax.block_until_ready(x)
+            return True
+        except Exception:
+            return False
+
+    def survivors(self) -> List[Any]:
+        devs = self._device_list()
+        return [d for d in devs if self._probe_device(d)]
+
+    def shrink(self, stage: str) -> None:
+        """Rebuild the mesh on surviving devices after a device_lost
+        failure at ``stage``. Probe-identified casualties are dropped
+        exactly; an indistinct loss (every device still answers — the
+        injected-fault case, and real transient mesh wedges) halves onto
+        the lowest-id devices, so the shrink ladder is deterministic:
+        8 → 4 → 2 → 1. Raises :class:`DeviceLossUnrecoverable` at the
+        ``SCC_ELASTIC_MIN_DEVICES`` floor."""
+        with robust_record.timed():
+            before = self._device_list()
+            from_ids = sorted(
+                int(d.id) for d in before
+            ) if before else [0]
+            alive = self.survivors()
+            if len(alive) >= len(before):
+                # indistinct failure: deterministic halving, keep low ids
+                alive = sorted(before, key=lambda d: int(d.id))
+                alive = alive[: max(len(alive) // 2, 1)]
+            if len(alive) < self.min_devices or not alive or (
+                len(alive) >= len(before) and before
+            ):
+                raise DeviceLossUnrecoverable(
+                    f"device lost at {stage} with no smaller mesh to "
+                    f"shrink to ({len(before)} -> {len(alive)} devices; "
+                    f"floor SCC_ELASTIC_MIN_DEVICES={self.min_devices})"
+                )
+            self._devices = list(alive)
+            self._mesh_built = False  # next .mesh read rebuilds
+            try:
+                # pinned upload-cache buffers may live on the lost
+                # device; evict so the re-entered stage re-stages its
+                # inputs instead of consuming a dead buffer
+                from scconsensus_tpu.utils.devcache import clear_cache
+
+                clear_cache()
+            except Exception:
+                pass
+            to_ids = sorted(int(d.id) for d in alive)
+            self.transitions += 1
+            robust_record.note_mesh_transition(
+                stage=stage, from_devices=from_ids, to_devices=to_ids,
+                recovered_state_bytes=self.live_state_bytes,
+                cause="device_loss",
+            )
+            from scconsensus_tpu.utils.logging import get_logger
+
+            get_logger().warning(
+                "elastic mesh: device loss at %s — mesh shrunk %d -> %d "
+                "devices (%s); stage re-enters from its last completed "
+                "checkpoint", stage, len(before), len(alive), to_ids,
+            )
+
+    def loss_handler(self, stage: str):
+        """The ``on_device_loss`` hook for robust.retry at ``stage``."""
+        def _handle(_attempt: int) -> None:
+            self.shrink(stage)
+
+        return _handle
+
+    # -- shape-polymorphic resume -----------------------------------------
+    def note_artifact_meta(self, stage: str,
+                           meta: Optional[Dict[str, Any]]) -> None:
+        """Called when a stage resumes from a stored artifact: if the
+        artifact was computed on a LARGER mesh than this run's, stamp the
+        shape-polymorphic crossing as a ``cause: "resume"`` transition
+        (once per (stage, shape) pair — a ladder of bucket checkpoints
+        from one dead run is one transition, not fifty)."""
+        to_ids = self.device_ids()
+        from_ids = resume_crossing_from_ids(meta, to_ids)
+        if from_ids is None:
+            return  # same shape, growth, or no stamp — not a crossing
+        key = (stage, tuple(from_ids), tuple(to_ids))
+        if key in self._resume_stamped:
+            return
+        self._resume_stamped.add(key)
+        self.transitions += 1
+        size = int(((meta or {}).get("_integrity") or {}).get("size") or 0)
+        robust_record.note_mesh_transition(
+            stage=stage, from_devices=from_ids, to_devices=to_ids,
+            recovered_state_bytes=size, cause="resume",
+        )
+        from scconsensus_tpu.utils.logging import get_logger
+
+        get_logger().info(
+            "elastic mesh: stage %r resumed a checkpoint written on %d "
+            "device(s) onto %d device(s) — shape-polymorphic resume",
+            stage, len(from_ids), len(to_ids),
+        )

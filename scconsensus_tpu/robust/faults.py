@@ -16,8 +16,11 @@ Each rule fires on hits ``after <= n < after + times`` of its site
 deterministic, so the fault-matrix test can assert exact recovery
 behavior. Sites are the pipeline's stage boundaries (``stage:<name>``),
 the DE ladder's buckets (``wilcox_bucket``), the devcache upload
-(``input_staging``), and artifact writes (``artifact:<stage>``, consumed
-by :func:`corrupt_artifact` rather than :func:`fault_point`).
+(``input_staging``), artifact writes (``artifact:<stage>``, consumed
+by :func:`corrupt_artifact` rather than :func:`fault_point`), and the
+mesh engines' entries (``sharded:aggregates``, ``sharded:ranksum``,
+``ring:distance_sums``, ``refine_step``) — the elastic plans' way of
+killing a mesh INSIDE a collective rather than at a stage boundary.
 
 Fault classes and what they do at a compute site:
 
@@ -25,6 +28,11 @@ Fault classes and what they do at a compute site:
              ``RESOURCE_EXHAUSTED`` so the classifier sees exactly what a
              real XLA allocation failure looks like)
   transient  raise :class:`InjectedTransientError` (``UNAVAILABLE``)
+  device_loss
+             raise :class:`InjectedDeviceLoss` (message carries the
+             ``device lost``/``FAILED_PRECONDITION`` signature a real
+             lost/preempted chip stringifies to) — the elastic mesh
+             supervisor's test vector (robust.elastic)
   kill       SIGKILL the process — no handler runs, the artifact store's
              atomicity and the mid-stage checkpoints are what survive
   stall      sleep ``stall_s`` (default 1.0) without raising — exercises
@@ -52,13 +60,15 @@ __all__ = [
     "InjectedFault",
     "InjectedResourceExhausted",
     "InjectedTransientError",
+    "InjectedDeviceLoss",
     "fault_point",
     "corrupt_artifact",
     "active",
     "reset",
 ]
 
-FAULT_CLASSES = ("oom", "transient", "kill", "stall", "corrupt")
+FAULT_CLASSES = ("oom", "transient", "kill", "stall", "corrupt",
+                 "device_loss")
 
 
 class InjectedFault(Exception):
@@ -73,6 +83,12 @@ class InjectedResourceExhausted(InjectedFault):
 
 class InjectedTransientError(InjectedFault):
     """Mimics a transient backend/RPC error."""
+
+
+class InjectedDeviceLoss(InjectedFault):
+    """Mimics a lost/preempted accelerator device (the XLA runtime
+    stringifies these as FAILED_PRECONDITION/INTERNAL errors naming the
+    device)."""
 
 
 # plan cache: (path, mtime) -> parsed plan; hit counters reset on reload
@@ -183,6 +199,11 @@ def fault_point(site: str) -> None:
             raise InjectedTransientError(
                 f"UNAVAILABLE: injected transient backend error at {site} "
                 "(SCC_FAULT_PLAN)"
+            )
+        if fclass == "device_loss":
+            raise InjectedDeviceLoss(
+                f"FAILED_PRECONDITION: device lost: injected device "
+                f"preemption at {site} (SCC_FAULT_PLAN)"
             )
         if fclass == "kill":
             import signal

@@ -10,15 +10,29 @@ additive ``robustness`` section::
       retries:      [{site, error_class, attempts, recovered, backoff_s}],
       degradations: [{site, action, detail}],
       resume_points: [{stage, unit, completed, total}],
-      recovered: bool,          # any retry recovered or any resume point
+      mesh_transitions: [{stage, from_devices, to_devices,
+                          recovered_state_bytes, cause}],
+      recovered: bool,          # recovered retry, resume point, or
+                                # mesh transition
       budget: {limit, used},
       consumed_s: float,        # self-measured robustness-layer overhead
       orchestration?: {...}     # bench.py attempt-ladder adaptations
     }
 
 Validation contract (the perf-gate smoke pins it): ``recovered: true``
-without evidence — no recovered retry AND no resume point — is REJECTED,
-so a record cannot *claim* survival the run never demonstrated.
+without evidence — no recovered retry AND no resume point AND no mesh
+transition — is REJECTED, so a record cannot *claim* survival the run
+never demonstrated. A ``mesh_transitions`` entry whose device set does
+not SHRINK (to_devices must be a non-empty proper subset of
+from_devices) is likewise rejected: elastic recovery only ever moves
+onto survivors, so a growing or disjoint "transition" is evidence of a
+corrupted record, not of recovery.
+
+Budget persistence: with an artifact store active the pipeline arms
+``set_budget_persist`` so every consumed retry lands in the store's
+``robust_state`` sidecar; a kill-and-resume cycle re-seeds
+``budget_used`` from it (``restore_budget``) instead of refreshing the
+allowance.
 
 Import discipline: this module must stay importable without jax (the
 bench orchestrator and ``validate_run_record`` load it) — stdlib only.
@@ -40,6 +54,7 @@ __all__ = [
     "note_retry",
     "note_degradation",
     "note_resume_point",
+    "note_mesh_transition",
     "add_consumed",
     "section",
     "live_summary",
@@ -60,10 +75,12 @@ class RunLog:
         self.retries: List[Dict[str, Any]] = []
         self.degradations: List[Dict[str, Any]] = []
         self.resume_points: List[Dict[str, Any]] = []
+        self.mesh_transitions: List[Dict[str, Any]] = []
         self.budget_limit = int(env_flag("SCC_ROBUST_BUDGET"))
         self.budget_used = 0
         self.consumed_s = 0.0
         self._n_dropped = 0
+        self._budget_persist = None  # set_budget_persist (stdlib contract)
         self._lock = threading.Lock()
 
     def _append(self, lst: List[Dict[str, Any]], item: Dict[str, Any]):
@@ -75,16 +92,37 @@ class RunLog:
 
     def budget_take(self) -> bool:
         """Consume one retry from the per-run budget; False = exhausted
-        (the caller must re-raise instead of retrying)."""
+        (the caller must re-raise instead of retrying). Every take is
+        mirrored through the persist hook (when armed), so a killed run
+        cannot resurrect with a fresh allowance."""
         with self._lock:
             if self.budget_used >= self.budget_limit:
                 return False
             self.budget_used += 1
-            return True
+            used, persist = self.budget_used, self._budget_persist
+        if persist is not None:
+            try:  # durability must not become a new failure mode
+                persist(used)
+            except Exception:
+                pass
+        return True
+
+    def restore_budget(self, used: int) -> None:
+        """Seed ``budget_used`` from a persisted resume checkpoint — takes
+        the max so an in-process restore can never LOWER the count."""
+        with self._lock:
+            self.budget_used = max(self.budget_used, int(used))
+
+    def set_budget_persist(self, fn) -> None:
+        """Arm ``fn(used)`` to run after every budget take (the pipeline
+        points this at the artifact store's robust_state sidecar)."""
+        with self._lock:
+            self._budget_persist = fn
 
     def empty(self) -> bool:
         return not (self.faults or self.retries or self.degradations
-                    or self.resume_points or self.budget_used)
+                    or self.resume_points or self.mesh_transitions
+                    or self.budget_used)
 
     def section(self) -> Optional[Dict[str, Any]]:
         """The run record's ``robustness`` section, or None when nothing
@@ -96,6 +134,7 @@ class RunLog:
             recovered = (
                 any(r.get("recovered") for r in self.retries)
                 or bool(self.resume_points)
+                or bool(self.mesh_transitions)
             )
             out: Dict[str, Any] = {
                 "faults_injected": [dict(f) for f in self.faults],
@@ -107,6 +146,12 @@ class RunLog:
                            "used": self.budget_used},
                 "consumed_s": round(self.consumed_s, 4),
             }
+            if self.mesh_transitions:
+                # absent on mesh-stable runs: the list only exists when
+                # elastic execution actually moved the run between meshes
+                out["mesh_transitions"] = [
+                    dict(t) for t in self.mesh_transitions
+                ]
             if self._n_dropped:
                 out["events_dropped"] = self._n_dropped
             return out
@@ -163,6 +208,24 @@ def note_resume_point(stage: str, unit: str, completed: int,
     })
 
 
+def note_mesh_transition(stage: str, from_devices, to_devices,
+                         recovered_state_bytes: int = 0,
+                         cause: str = "device_loss") -> None:
+    """Record an elastic mesh transition: the run moved from the
+    ``from_devices`` mesh onto the smaller ``to_devices`` mesh at
+    ``stage`` — either in-process (a lost device, cause="device_loss")
+    or across a checkpoint boundary (a shape-polymorphic resume onto a
+    smaller mesh, cause="resume"). ``recovered_state_bytes`` counts the
+    live sharded state re-laid-out / checkpoint bytes re-adopted."""
+    current_run()._append(current_run().mesh_transitions, {
+        "stage": stage,
+        "from_devices": [int(d) for d in from_devices],
+        "to_devices": [int(d) for d in to_devices],
+        "recovered_state_bytes": int(recovered_state_bytes),
+        "cause": str(cause),
+    })
+
+
 def add_consumed(dt: float) -> None:
     run = current_run()
     with run._lock:
@@ -202,6 +265,20 @@ def live_summary() -> Optional[Dict[str, Any]]:
             out["degradations"] = len(run.degradations)
         if run.resume_points:
             out["resumes"] = len(run.resume_points)
+        if run.mesh_transitions:
+            # live mesh panel feed (tail_run): current device count =
+            # the latest transition's destination
+            last = run.mesh_transitions[-1]
+            out["mesh"] = {
+                "transitions": len(run.mesh_transitions),
+                "devices": len(last.get("to_devices") or []),
+                "path": " → ".join(
+                    [str(len(run.mesh_transitions[0].get("from_devices")
+                             or []))]
+                    + [str(len(t.get("to_devices") or []))
+                       for t in run.mesh_transitions]
+                ),
+            }
         return out or None
 
 
@@ -209,7 +286,8 @@ def live_summary() -> Optional[Dict[str, Any]]:
 # schema validation
 # --------------------------------------------------------------------------
 
-_ERROR_CLASSES = ("transient", "resource", "fatal")
+_ERROR_CLASSES = ("transient", "resource", "device_lost", "fatal")
+_TRANSITION_CAUSES = ("device_loss", "resume")
 
 
 def _require(cond: bool, msg: str) -> None:
@@ -226,7 +304,7 @@ def validate_robustness(rb: Dict[str, Any]) -> None:
 
     _require(isinstance(rb, dict), "must be an object")
     for key in ("faults_injected", "retries", "degradations",
-                "resume_points"):
+                "resume_points", "mesh_transitions"):
         v = rb.get(key, [])
         _require(isinstance(v, list), f"{key} must be a list")
         for i, item in enumerate(v):
@@ -256,10 +334,35 @@ def validate_robustness(rb: Dict[str, Any]) -> None:
                  f"resume_points[{i}].completed must be an int >= 0")
         _require(isinstance(tot, int) and tot >= comp,
                  f"resume_points[{i}].total must be an int >= completed")
+    for i, t in enumerate(rb.get("mesh_transitions", [])):
+        where = f"mesh_transitions[{i}]"
+        _require(bool(t.get("stage")), f"{where} missing stage")
+        src, dst = t.get("from_devices"), t.get("to_devices")
+        _require(isinstance(src, list) and isinstance(dst, list),
+                 f"{where}: from_devices/to_devices must be lists")
+        _require(len(dst) >= 1, f"{where}: to_devices must be non-empty "
+                                "(a mesh cannot shrink to zero devices)")
+        # the shrink rule: elastic recovery only ever moves onto
+        # SURVIVORS, so the destination must be a strict subset of the
+        # source — anything else (growth, disjoint sets, same set) is a
+        # corrupted or fabricated transition, not recovery evidence
+        _require(
+            set(dst) < set(src),
+            f"{where}: device sets must shrink (to_devices must be a "
+            f"proper subset of from_devices; got {src} -> {dst})",
+        )
+        rsb = t.get("recovered_state_bytes", 0)
+        _require(isinstance(rsb, int) and rsb >= 0,
+                 f"{where}.recovered_state_bytes must be an int >= 0")
+        cause = t.get("cause", "device_loss")
+        _require(cause in _TRANSITION_CAUSES,
+                 f"{where}.cause must be one of {_TRANSITION_CAUSES}, "
+                 f"got {cause!r}")
     if rb.get("recovered"):
         has_evidence = (
             any(r.get("recovered") for r in rb.get("retries", []))
             or bool(rb.get("resume_points"))
+            or bool(rb.get("mesh_transitions"))
         )
         _require(
             has_evidence,

@@ -7,8 +7,13 @@ embed stage, the pipeline's stage-boundary recovery — runs through
 
   1. classify the exception: ``transient`` (backend/RPC hiccup — retry
      as-is), ``resource`` (allocation failure — run the caller's
-     ``degrade`` hook, then retry), ``fatal`` (everything else —
-     re-raise immediately, a ValueError must never burn retry budget);
+     ``degrade`` hook, then retry), ``device_lost`` (a lost/preempted
+     device or a mesh whose device set no longer exists — run the
+     caller's ``on_device_loss`` hook, which rebuilds the mesh on
+     survivors (robust.elastic), then retry; without a hook the class
+     is FATAL, because retrying against a dead mesh just loops),
+     ``fatal`` (everything else — re-raise immediately, a ValueError
+     must never burn retry budget);
   2. respect the per-run retry budget (``SCC_ROBUST_BUDGET``) — a retry
      storm converts to a clean failure, not an unbounded loop;
   3. back off exponentially with deterministic jitter (seeded by the
@@ -41,7 +46,7 @@ __all__ = [
     "default_policy",
 ]
 
-ERROR_CLASSES = ("transient", "resource", "fatal")
+ERROR_CLASSES = ("transient", "resource", "device_lost", "fatal")
 
 # Message fragments, lowercase. Matched against str(exc) / raw text; the
 # XLA runtime stringifies device failures with their gRPC-style status
@@ -57,16 +62,38 @@ _TRANSIENT_PAT = (
     "connection reset", "connection refused", "broken pipe", "timed out",
     "transient", "socket closed", "internal: failed to connect",
 )
+# Device-loss signatures: what the XLA/PJRT runtime actually prints when
+# a chip dies or is preempted mid-program, plus the JAX-level errors a
+# Mesh raises once its device set no longer matches the live client
+# (a preempted TPU slice re-enumerates with fresh device objects).
+_DEVICE_LOST_PAT = (
+    "device lost", "device is lost", "device was lost",
+    "device preempted", "preemption", "worker preempted",
+    # NOTE deliberately absent: "halted by previous error" — XLA emits it
+    # as follow-on noise after ANY prior failure (an OOM's aftermath most
+    # commonly), and classifying it device_lost would trigger the
+    # exactly-wrong adaptation (shrink the mesh instead of degrade)
+    "device not found", "no such device", "device has been removed",
+    "chip is unhealthy", "device unhealthy",
+    "data_loss", "failed_precondition: device",
+    "failed precondition: device",
+    "device assignment", "mesh should contain", "mismatched devices",
+    "not addressable",
+)
 
 
 def classify_text(text: Optional[str]) -> Optional[str]:
-    """'transient' | 'resource' | None (no signature recognized) for raw
-    text — stderr tails, TUNNEL_LOG probe errors, heartbeat post-mortems.
-    Resource wins over transient when both match: degrading is the safer
-    adaptation (a transient retry of a genuinely too-big shape loops)."""
+    """'device_lost' | 'resource' | 'transient' | None (no signature
+    recognized) for raw text — stderr tails, TUNNEL_LOG probe errors,
+    heartbeat post-mortems. Device-loss wins over everything (a dead chip
+    often also prints UNAVAILABLE, and only a mesh rebuild helps);
+    resource wins over transient (degrading is the safer adaptation — a
+    transient retry of a genuinely too-big shape loops)."""
     if not text:
         return None
     low = str(text).lower()
+    if any(p in low for p in _DEVICE_LOST_PAT):
+        return "device_lost"
     if any(p in low for p in _RESOURCE_PAT):
         return "resource"
     if any(p in low for p in _TRANSIENT_PAT):
@@ -77,6 +104,8 @@ def classify_text(text: Optional[str]) -> Optional[str]:
 def classify_exception(exc: BaseException) -> str:
     """Error class of an exception: type first (MemoryError, the injected
     fault types), then message text, else fatal."""
+    if isinstance(exc, faults.InjectedDeviceLoss):
+        return "device_lost"
     if isinstance(exc, (MemoryError, faults.InjectedResourceExhausted)):
         return "resource"
     if isinstance(exc, faults.InjectedTransientError):
@@ -121,12 +150,17 @@ class RetryPolicy:
     def call(self, fn: Callable[[], Any], site: str,
              degrade: Optional[Callable[[int], Any]] = None,
              classify: Callable[[BaseException], str] = classify_exception,
+             on_device_loss: Optional[Callable[[int], Any]] = None,
              ) -> Any:
         """Run ``fn`` under this policy. ``degrade(attempt)`` runs before
         a resource-class retry (evict caches, halve a chunk ladder —
-        whatever makes the retry *different*); a fault plan's injection
-        for ``site`` fires at each attempt's entry, so an injected fault
-        is recovered by the very machinery it tests."""
+        whatever makes the retry *different*); ``on_device_loss(attempt)``
+        runs before a device_lost-class retry (rebuild the mesh on
+        surviving devices — robust.elastic wires the supervisor in here;
+        without the hook device_lost is FATAL, since re-running the same
+        program against a dead mesh can only fail again); a fault plan's
+        injection for ``site`` fires at each attempt's entry, so an
+        injected fault is recovered by the very machinery it tests."""
         from scconsensus_tpu.obs import trace as obs_trace
 
         run = record.current_run()
@@ -143,7 +177,9 @@ class RetryPolicy:
                 return out
             except Exception as e:
                 err_class = classify(e)
-                if err_class == "fatal":
+                if err_class == "fatal" or (
+                    err_class == "device_lost" and on_device_loss is None
+                ):
                     raise
                 if attempt >= self.max_attempts or not run.budget_take():
                     record.note_retry(site, err_class, attempt,
@@ -161,7 +197,11 @@ class RetryPolicy:
                     "robust_retry", site=site, error_class=err_class,
                     attempt=attempt, backoff_s=round(backoff, 4),
                 ):
-                    if degrade is not None and err_class == "resource":
+                    if err_class == "device_lost":
+                        # the adaptation IS the recovery here: shrink the
+                        # mesh onto survivors before re-entering the stage
+                        on_device_loss(attempt)
+                    elif degrade is not None and err_class == "resource":
                         degrade(attempt)
                     time.sleep(backoff)
                 attempt += 1
@@ -179,7 +219,10 @@ def default_policy() -> RetryPolicy:
 
 def call(fn: Callable[[], Any], site: str,
          degrade: Optional[Callable[[int], Any]] = None,
-         policy: Optional[RetryPolicy] = None) -> Any:
+         policy: Optional[RetryPolicy] = None,
+         on_device_loss: Optional[Callable[[int], Any]] = None) -> Any:
     """Module-level convenience: ``robust.call(fn, site=...)`` under the
     default policy."""
-    return (policy or default_policy()).call(fn, site, degrade=degrade)
+    return (policy or default_policy()).call(
+        fn, site, degrade=degrade, on_device_loss=on_device_loss
+    )

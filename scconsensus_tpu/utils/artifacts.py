@@ -362,14 +362,21 @@ class ArtifactStore:
         return n
 
     def cached(self, stage: str, fn: Callable[[], Dict[str, np.ndarray]],
-               meta_fn: Optional[Callable[[], Dict[str, Any]]] = None):
+               meta_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+               on_load_meta: Optional[Callable[[Dict[str, Any]], Any]]
+               = None):
         """Run ``fn`` (returning a dict of arrays) unless ``stage`` already
         has a saved artifact, in which case load and return it. A corrupt
         stored artifact (failed checksum / truncated zip) has been
-        quarantined by ``load`` — fall through and recompute."""
+        quarantined by ``load`` — fall through and recompute.
+        ``on_load_meta(meta)`` fires only on the resume path with the
+        stored sidecar — the elastic supervisor reads the ``mesh_shape``
+        stamp there to record shape-polymorphic resumes."""
         if self.has(stage):
             try:
-                arrays, _ = self.load(stage)
+                arrays, meta = self.load(stage)
+                if on_load_meta is not None:
+                    on_load_meta(meta)
                 return arrays
             except ArtifactCorrupt:
                 pass  # quarantined inside load(); recompute below

@@ -1,0 +1,813 @@
+"""Elastic mesh execution (robust.elastic): device-loss recovery and
+shape-polymorphic resume.
+
+The elastic fault matrix contract: ``device_loss`` injected at every
+pipeline stage boundary on a forced 8-device CPU mesh (conftest) recovers
+IN-PROCESS onto a smaller mesh with final cut labels identical to an
+uninterrupted run, every movement stamped as a validated
+``mesh_transitions`` entry; a checkpoint written on an 8-device mesh
+resumes with identical labels on 4, 2, or 1 devices (mesh_shape
+provenance + ``cause: "resume"`` transitions). Extends the
+``test_robust_faults.py`` patterns.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from scconsensus_tpu.config import ReclusterConfig
+from scconsensus_tpu.models.pipeline import refine
+from scconsensus_tpu.parallel.mesh import (
+    make_mesh,
+    mesh_device_ids,
+    mesh_shape_meta,
+)
+from scconsensus_tpu.robust import faults, record as robust_record
+from scconsensus_tpu.robust.contract import (
+    CHECKS,
+    InputContractError,
+    preflight,
+)
+from scconsensus_tpu.robust.elastic import (
+    DeviceLossUnrecoverable,
+    ElasticMeshSupervisor,
+)
+from scconsensus_tpu.robust.record import validate_robustness
+from scconsensus_tpu.robust.retry import (
+    RetryPolicy,
+    classify_exception,
+    classify_text,
+)
+from scconsensus_tpu.utils.artifacts import ArtifactStore
+from scconsensus_tpu.utils.synthetic import noisy_labeling, synthetic_scrna
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    """Millisecond backoffs + fresh fault/robustness state per test."""
+    monkeypatch.setenv("SCC_ROBUST_BACKOFF_S", "0.002")
+    monkeypatch.delenv("SCC_FAULT_PLAN", raising=False)
+    faults.reset()
+    robust_record.begin_run()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def small_case():
+    data, truth, _ = synthetic_scrna(
+        n_genes=60, n_cells=152, n_clusters=3, n_markers_per_cluster=8,
+        seed=11,
+    )
+    return data, noisy_labeling(truth, 0.05, seed=2)
+
+
+@pytest.fixture(scope="module")
+def serial_ref(small_case):
+    data, labels = small_case
+    return refine(data, labels, ReclusterConfig(deep_split_values=(1, 2)),
+                  mesh=None)
+
+
+def _plan(tmp_path, rules, name="plan.json"):
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        json.dump({"faults": rules}, f)
+    return path
+
+
+def _assert_labels_equal(res, ref):
+    for key in ref.dynamic_labels:
+        np.testing.assert_array_equal(
+            res.dynamic_labels[key], ref.dynamic_labels[key]
+        )
+
+
+# --------------------------------------------------------------------------
+# classification + policy plumbing
+# --------------------------------------------------------------------------
+
+class TestDeviceLostClassification:
+    def test_real_xla_signatures(self):
+        assert classify_text(
+            "XlaRuntimeError: INTERNAL: Device lost: TPU_3 halted"
+        ) == "device_lost"
+        assert classify_text(
+            "FAILED_PRECONDITION: device 5 not found in client"
+        ) == "device_lost"
+        assert classify_text("worker preempted by scheduler") == \
+            "device_lost"
+        assert classify_text(
+            "ValueError: mesh should contain the devices of its operands"
+        ) == "device_lost"
+
+    def test_device_lost_wins_over_transient_and_resource(self):
+        # a dead chip often also prints UNAVAILABLE / allocation noise;
+        # only a mesh rebuild helps, so device_lost must win
+        assert classify_text(
+            "UNAVAILABLE: device lost during allreduce"
+        ) == "device_lost"
+        assert classify_text(
+            "RESOURCE_EXHAUSTED after device preempted"
+        ) == "device_lost"
+
+    def test_injected_type(self):
+        assert classify_exception(
+            faults.InjectedDeviceLoss("FAILED_PRECONDITION: device lost")
+        ) == "device_lost"
+
+    def test_device_lost_without_handler_is_fatal(self):
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            raise faults.InjectedDeviceLoss("device lost")
+
+        with pytest.raises(faults.InjectedDeviceLoss):
+            RetryPolicy(max_attempts=5).call(fn, site="t")
+        assert calls["n"] == 1  # no blind retry against a dead mesh
+        assert not robust_record.current_run().retries
+
+    def test_device_lost_with_handler_recovers(self):
+        calls = {"n": 0}
+        handled = []
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise faults.InjectedDeviceLoss("device lost")
+            return "ok"
+
+        out = RetryPolicy(max_attempts=3).call(
+            fn, site="t", on_device_loss=lambda a: handled.append(a)
+        )
+        assert out == "ok" and handled == [1]
+        (entry,) = robust_record.current_run().retries
+        assert entry["error_class"] == "device_lost"
+        assert entry["recovered"] is True
+
+
+# --------------------------------------------------------------------------
+# mesh_transitions schema: the shrink rule
+# --------------------------------------------------------------------------
+
+def _section_with(transition):
+    return {"recovered": True, "mesh_transitions": [transition]}
+
+
+class TestTransitionValidation:
+    def test_valid_shrink_accepted(self):
+        validate_robustness(_section_with({
+            "stage": "stage:de", "from_devices": [0, 1, 2, 3],
+            "to_devices": [0, 1], "recovered_state_bytes": 128,
+            "cause": "device_loss",
+        }))
+
+    def test_transition_counts_as_recovery_evidence(self):
+        # no retries, no resume points — the transition alone evidences
+        validate_robustness(_section_with({
+            "stage": "s", "from_devices": [0, 1], "to_devices": [0],
+            "recovered_state_bytes": 0, "cause": "resume",
+        }))
+
+    @pytest.mark.parametrize("src,dst", [
+        ([0, 1], [0, 1, 2, 3]),   # growth
+        ([0, 1], [0, 1]),         # no change
+        ([0, 1], [2, 3]),         # disjoint
+        ([0, 1, 2, 3], []),       # shrink to nothing
+    ])
+    def test_non_shrinking_sets_rejected(self, src, dst):
+        with pytest.raises(ValueError, match="shrink|non-empty"):
+            validate_robustness(_section_with({
+                "stage": "s", "from_devices": src, "to_devices": dst,
+                "recovered_state_bytes": 0, "cause": "device_loss",
+            }))
+
+    def test_bad_cause_rejected(self):
+        with pytest.raises(ValueError, match="cause"):
+            validate_robustness(_section_with({
+                "stage": "s", "from_devices": [0, 1], "to_devices": [0],
+                "recovered_state_bytes": 0, "cause": "wandered",
+            }))
+
+    def test_run_record_validates_transitions(self):
+        from scconsensus_tpu.obs.export import (
+            build_run_record,
+            validate_run_record,
+        )
+
+        rec = build_run_record(metric="m", value=1.0, robustness={
+            "recovered": True,
+            "mesh_transitions": [{
+                "stage": "s", "from_devices": [0, 1],
+                "to_devices": [0, 1, 2],
+                "recovered_state_bytes": 0, "cause": "device_loss",
+            }],
+        })
+        with pytest.raises(ValueError, match="shrink"):
+            validate_run_record(rec)
+
+
+# --------------------------------------------------------------------------
+# supervisor unit behavior
+# --------------------------------------------------------------------------
+
+class TestSupervisor:
+    def test_shrink_ladder_8_4_2_1(self):
+        sup = ElasticMeshSupervisor(devices=list(make_mesh(8).devices.flat),
+                                    auto=False)
+        assert sup.mesh is not None and sup.n_devices == 8
+        for expect in (4, 2, 1):
+            sup.shrink("stage:t")
+            assert sup.n_devices == expect
+            assert sup.device_ids() == list(range(expect))
+        assert sup.mesh is None  # one device = the serial path
+        with pytest.raises(DeviceLossUnrecoverable):
+            sup.shrink("stage:t")
+        # every step recorded, every step shrinks, all validate
+        run = robust_record.current_run()
+        assert len(run.mesh_transitions) == 3
+        validate_robustness(robust_record.section())
+
+    def test_min_devices_floor(self, monkeypatch):
+        monkeypatch.setenv("SCC_ELASTIC_MIN_DEVICES", "4")
+        sup = ElasticMeshSupervisor(devices=list(make_mesh(8).devices.flat),
+                                    auto=False)
+        sup.shrink("s")  # 8 -> 4 allowed
+        with pytest.raises(DeviceLossUnrecoverable):
+            sup.shrink("s")  # 4 -> 2 would cross the floor
+
+    def test_elastic_off_restores_bare_mesh(self, monkeypatch):
+        monkeypatch.setenv("SCC_ELASTIC", "0")
+        sup, mesh = ElasticMeshSupervisor.resolve("auto")
+        assert sup is None
+        assert mesh is not None and mesh.devices.size == 8
+
+    def test_resume_meta_stamps_only_shrinks(self):
+        sup = ElasticMeshSupervisor(devices=list(make_mesh(2).devices.flat),
+                                    auto=False)
+        run = robust_record.current_run()
+        # larger stored mesh -> stamped once (dedup on repeat)
+        meta = {"mesh_shape": {"n_devices": 8,
+                               "device_ids": list(range(8))},
+                "_integrity": {"size": 4096}}
+        sup.note_artifact_meta("tree", meta)
+        sup.note_artifact_meta("tree", meta)
+        assert len(run.mesh_transitions) == 1
+        t = run.mesh_transitions[0]
+        assert t["cause"] == "resume"
+        assert t["recovered_state_bytes"] == 4096
+        assert t["to_devices"] == [0, 1]
+        # same-shape and growth stamp nothing
+        sup.note_artifact_meta("cuts", {"mesh_shape": {
+            "n_devices": 2, "device_ids": [0, 1]}})
+        sup.note_artifact_meta("cuts", {"mesh_shape": {
+            "n_devices": 1, "device_ids": [0]}})
+        assert len(run.mesh_transitions) == 1
+
+
+# --------------------------------------------------------------------------
+# the elastic fault matrix: device_loss at every stage boundary
+# --------------------------------------------------------------------------
+
+STAGE_SITES = ("stage:de", "stage:union", "stage:embed", "stage:tree",
+               "stage:cuts", "stage:silhouette", "stage:nodg")
+
+
+class TestElasticFaultMatrix:
+    @pytest.fixture(scope="class")
+    def mesh_ref(self, small_case):
+        data, labels = small_case
+        return refine(data, labels,
+                      ReclusterConfig(deep_split_values=(1, 2)),
+                      mesh=make_mesh(8))
+
+    @pytest.mark.parametrize("site", STAGE_SITES)
+    def test_device_loss_recovers_on_smaller_mesh(
+        self, tmp_path, monkeypatch, small_case, serial_ref, mesh_ref,
+        site,
+    ):
+        data, labels = small_case
+        plan = _plan(tmp_path, [{"site": site, "class": "device_loss"}],
+                     name=f"dl_{site.replace(':', '_')}.json")
+        monkeypatch.setenv("SCC_FAULT_PLAN", plan)
+        faults.reset()
+        res = refine(data, labels,
+                     ReclusterConfig(deep_split_values=(1, 2)),
+                     mesh=make_mesh(8))
+        _assert_labels_equal(res, mesh_ref)
+        _assert_labels_equal(res, serial_ref)
+        rb = res.metrics["robustness"]
+        assert rb["recovered"] is True
+        assert any(f["site"] == site and f["class"] == "device_loss"
+                   for f in rb["faults_injected"])
+        assert any(r["site"] == site and r["recovered"]
+                   and r["error_class"] == "device_lost"
+                   for r in rb["retries"])
+        (t,) = rb["mesh_transitions"]
+        assert t["stage"] == site and t["cause"] == "device_loss"
+        assert t["from_devices"] == list(range(8))
+        assert t["to_devices"] == list(range(4))
+        assert t["recovered_state_bytes"] > 0
+        validate_robustness(rb)
+
+    def test_loss_inside_sharded_engine_recovers(
+        self, tmp_path, monkeypatch, small_case, serial_ref
+    ):
+        """device_loss fired INSIDE a mesh collective (the sharded
+        rank-sum engine's per-bucket site), not at a stage boundary —
+        the loss must still propagate to the stage guard and recover."""
+        data, labels = small_case
+        plan = _plan(tmp_path, [
+            {"site": "sharded:ranksum", "class": "device_loss"},
+        ], name="dl_engine.json")
+        monkeypatch.setenv("SCC_FAULT_PLAN", plan)
+        faults.reset()
+        res = refine(data, labels,
+                     ReclusterConfig(deep_split_values=(1, 2)),
+                     mesh=make_mesh(8))
+        _assert_labels_equal(res, serial_ref)
+        rb = res.metrics["robustness"]
+        assert any(r["site"] == "stage:de" and r["recovered"]
+                   and r["error_class"] == "device_lost"
+                   for r in rb["retries"])
+        assert any(t["cause"] == "device_loss"
+                   for t in rb["mesh_transitions"])
+        validate_robustness(rb)
+
+    def test_double_loss_shrinks_twice(self, tmp_path, monkeypatch,
+                                       small_case, serial_ref):
+        data, labels = small_case
+        plan = _plan(tmp_path, [
+            {"site": "stage:de", "class": "device_loss"},
+            {"site": "stage:tree", "class": "device_loss"},
+        ], name="dl_twice.json")
+        monkeypatch.setenv("SCC_FAULT_PLAN", plan)
+        faults.reset()
+        res = refine(data, labels,
+                     ReclusterConfig(deep_split_values=(1, 2)),
+                     mesh=make_mesh(8))
+        _assert_labels_equal(res, serial_ref)
+        rb = res.metrics["robustness"]
+        paths = [(len(t["from_devices"]), len(t["to_devices"]))
+                 for t in rb["mesh_transitions"]]
+        assert paths == [(8, 4), (4, 2)]
+        validate_robustness(rb)
+
+
+# --------------------------------------------------------------------------
+# mid-ladder device loss: shrink + resume from completed buckets
+# --------------------------------------------------------------------------
+
+class TestMidLadderLoss:
+    @pytest.fixture()
+    def tiny_budget(self, monkeypatch):
+        import scconsensus_tpu.ops.ranksum_allpairs as ra
+
+        monkeypatch.setattr(ra, "_ALLPAIRS_ELEM_BUDGET", 16 * 256 * 3)
+
+    def test_mid_ladder_loss_resumes_completed_buckets(
+        self, tmp_path, monkeypatch, small_case, serial_ref, tiny_budget
+    ):
+        data, labels = small_case
+        store_dir = str(tmp_path / "store")
+        # fire on the SECOND bucket: bucket 0 lands + checkpoints at 8
+        # devices, then the mesh dies mid-ladder
+        plan = _plan(tmp_path, [
+            {"site": "wilcox_bucket", "class": "device_loss", "after": 1},
+        ])
+        monkeypatch.setenv("SCC_FAULT_PLAN", plan)
+        faults.reset()
+        res = refine(
+            data, labels,
+            ReclusterConfig(deep_split_values=(1, 2),
+                            artifact_dir=store_dir),
+            mesh=make_mesh(8),
+        )
+        _assert_labels_equal(res, serial_ref)
+        rb = res.metrics["robustness"]
+        # the loss propagated out of the ladder to the stage guard,
+        # which shrank the mesh and re-entered stage:de
+        assert any(r["site"] == "stage:de" and r["recovered"]
+                   and r["error_class"] == "device_lost"
+                   for r in rb["retries"])
+        dl = [t for t in rb["mesh_transitions"]
+              if t["cause"] == "device_loss"]
+        assert dl and dl[0]["from_devices"] == list(range(8))
+        # re-entry resumed the pre-loss bucket from its checkpoint
+        assert any(p["stage"] == "wilcox_test" and p["completed"] >= 1
+                   for p in rb["resume_points"])
+        validate_robustness(rb)
+
+    def test_bucket_ckpts_written_at_8_resume_at_2(
+        self, tmp_path, small_case, tiny_budget, monkeypatch
+    ):
+        """In-process interrupt of the 8-device ladder, then a
+        pairwise_de resume on a 2-device mesh: the content-addressed
+        blocks (same 'mesh' kernel variant at any mesh size) load, the
+        shape-polymorphic crossing is stamped."""
+        import scconsensus_tpu.parallel.sharded_de as sd
+        from scconsensus_tpu.de.engine import pairwise_de
+
+        data, labels = small_case
+        cfg = ReclusterConfig(deep_split_values=(1,))
+        ref = pairwise_de(data, labels, cfg, mesh=make_mesh(8),
+                          store=ArtifactStore(None))
+
+        real = sd.sharded_allpairs_ranksum
+        calls = {"n": 0}
+
+        def dying(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] > 2:
+                raise KeyboardInterrupt("mesh host killed mid-ladder")
+            return real(*a, **kw)
+
+        store = ArtifactStore(str(tmp_path))
+        monkeypatch.setattr(sd, "sharded_allpairs_ranksum", dying)
+        # engine imports the symbol inside the function scope from the
+        # module, so patching the module attribute is enough
+        with pytest.raises(KeyboardInterrupt):
+            pairwise_de(data, labels, cfg, mesh=make_mesh(8), store=store)
+        monkeypatch.setattr(sd, "sharded_allpairs_ranksum", real)
+        done = [n for n in os.listdir(str(tmp_path))
+                if n.startswith("de_wilcox_") and n.endswith(".npz")]
+        assert len(done) == 2, "exactly the completed buckets persist"
+        # the blocks carry 8-device provenance
+        _, meta = store.load(os.path.splitext(done[0])[0])
+        assert meta["mesh_shape"]["n_devices"] == 8
+
+        robust_record.begin_run()
+        res = pairwise_de(data, labels, cfg, mesh=make_mesh(2),
+                          store=store)
+        np.testing.assert_array_equal(res.log_p, ref.log_p)
+        np.testing.assert_array_equal(res.de_mask, ref.de_mask)
+        run = robust_record.current_run()
+        (rp,) = run.resume_points
+        assert rp["stage"] == "wilcox_test" and rp["completed"] == 2
+        (t,) = run.mesh_transitions
+        assert t["cause"] == "resume"
+        assert t["from_devices"] == list(range(8))
+        assert t["to_devices"] == [0, 1]
+        assert t["recovered_state_bytes"] > 0
+
+
+# --------------------------------------------------------------------------
+# shape-polymorphic artifact resume: 8 -> 4 -> 1
+# --------------------------------------------------------------------------
+
+class TestShrinkResumeChain:
+    def test_store_written_at_8_resumes_at_4_then_1(
+        self, tmp_path, small_case, serial_ref
+    ):
+        data, labels = small_case
+        store_dir = str(tmp_path / "store")
+        cfg = ReclusterConfig(deep_split_values=(1, 2),
+                              artifact_dir=store_dir)
+        first = refine(data, labels, cfg, mesh=make_mesh(8))
+        _assert_labels_equal(first, serial_ref)
+
+        # resume the 8-device store on a 4-device mesh
+        robust_record.begin_run()
+        at4 = refine(data, labels, cfg, mesh=make_mesh(4))
+        _assert_labels_equal(at4, serial_ref)
+        rb4 = at4.metrics["robustness"]
+        assert rb4["recovered"] is True
+        assert all(t["cause"] == "resume"
+                   for t in rb4["mesh_transitions"])
+        assert {tuple(t["from_devices"])
+                for t in rb4["mesh_transitions"]} == {tuple(range(8))}
+        assert all(t["to_devices"] == list(range(4))
+                   for t in rb4["mesh_transitions"])
+        # every resumed artifact stage is covered (de + the cached four)
+        stages = {t["stage"] for t in rb4["mesh_transitions"]}
+        assert {"de", "union", "embed", "tree", "cuts"} <= stages
+        validate_robustness(rb4)
+
+        # and the acceptance pin: the same 8-device store resumes to
+        # IDENTICAL labels on ONE device (the serial path)
+        robust_record.begin_run()
+        at1 = refine(data, labels, cfg, mesh=None)
+        _assert_labels_equal(at1, serial_ref)
+        rb1 = at1.metrics["robustness"]
+        assert rb1["recovered"] is True
+        assert all(t["cause"] == "resume" and t["to_devices"] == [0]
+                   for t in rb1["mesh_transitions"])
+        validate_robustness(rb1)
+
+    def test_resume_record_flows_to_ledger(self, tmp_path, small_case):
+        """mesh_transitions ride build_run_record -> validate -> ledger
+        ingest with the manifest summary stamped."""
+        from scconsensus_tpu.obs.export import (
+            build_run_record,
+            validate_run_record,
+        )
+        from scconsensus_tpu.obs.ledger import Ledger
+
+        data, labels = small_case
+        store_dir = str(tmp_path / "store")
+        cfg = ReclusterConfig(deep_split_values=(1,),
+                              artifact_dir=store_dir)
+        refine(data, labels, cfg, mesh=make_mesh(8))
+        robust_record.begin_run()
+        res = refine(data, labels, cfg, mesh=make_mesh(2))
+        rb = res.metrics["robustness"]
+        rec = build_run_record(
+            metric="elastic resume", value=1.0,
+            extra={"config": "elastic-test", "platform": "cpu"},
+            robustness=rb,
+        )
+        validate_run_record(rec)
+        entry = Ledger(str(tmp_path / "evidence")).ingest(
+            rec, source="test"
+        )
+        assert entry["robustness"]["mesh_transitions"] == \
+            len(rb["mesh_transitions"])
+        assert entry["robustness"]["mesh_devices"] == 2
+        assert entry["robustness"]["recovered"] is True
+
+
+# --------------------------------------------------------------------------
+# retry-budget persistence across kill/resume
+# --------------------------------------------------------------------------
+
+class TestBudgetPersistence:
+    def test_killed_run_cannot_refresh_budget_on_resume(
+        self, tmp_path, monkeypatch, small_case
+    ):
+        data, labels = small_case
+        store_dir = str(tmp_path / "store")
+        monkeypatch.setenv("SCC_ROBUST_BUDGET", "3")
+        # run 1 DIES at stage:tree with retries consumed: 2 of the 3
+        # budget slots burn (attempt cap re-raises the third fault)
+        plan = _plan(tmp_path, [
+            {"site": "stage:tree", "class": "transient", "times": 99},
+        ])
+        monkeypatch.setenv("SCC_FAULT_PLAN", plan)
+        faults.reset()
+        cfg = ReclusterConfig(deep_split_values=(1,),
+                              artifact_dir=store_dir)
+        with pytest.raises(faults.InjectedTransientError):
+            refine(data, labels, cfg, mesh=None)
+        # the consumed budget persisted into the store's sidecar
+        _, meta = ArtifactStore(store_dir).load("robust_state")
+        assert meta["budget_used"] == 2
+
+        # "new process": fresh in-memory log, same store — the resumed
+        # run starts from used=2, so its FIRST retry exhausts the
+        # allowance and the second fault re-raises
+        plan2 = _plan(tmp_path, [
+            {"site": "stage:union", "class": "transient", "times": 2},
+        ], name="plan2.json")
+        monkeypatch.setenv("SCC_FAULT_PLAN", plan2)
+        faults.reset()
+        with pytest.raises(faults.InjectedTransientError):
+            refine(data, labels, cfg, mesh=None)
+        run = robust_record.current_run()
+        assert run.budget_used == 3  # 2 restored + 1 taken, then denied
+
+        # control: the same double fault on a FRESH store recovers
+        monkeypatch.setenv("SCC_FAULT_PLAN", plan2)
+        faults.reset()
+        fresh = ReclusterConfig(deep_split_values=(1,),
+                                artifact_dir=str(tmp_path / "fresh"))
+        res = refine(data, labels, fresh, mesh=None)
+        assert res.metrics["robustness"]["recovered"] is True
+
+    def test_successful_completion_resets_budget(self, tmp_path,
+                                                 monkeypatch, small_case):
+        """The ratchet is per-RUN (a run spans its resumes): a COMPLETED
+        run ends it, so the next run over the same store starts fresh."""
+        data, labels = small_case
+        store_dir = str(tmp_path / "store")
+        plan = _plan(tmp_path, [
+            {"site": "stage:embed", "class": "transient", "times": 2},
+        ])
+        monkeypatch.setenv("SCC_FAULT_PLAN", plan)
+        faults.reset()
+        cfg = ReclusterConfig(deep_split_values=(1,),
+                              artifact_dir=store_dir)
+        res = refine(data, labels, cfg, mesh=None)
+        assert res.metrics["robustness"]["recovered"] is True
+        _, meta = ArtifactStore(store_dir).load("robust_state")
+        assert meta["budget_used"] == 0
+
+
+# --------------------------------------------------------------------------
+# input-contract pre-flight
+# --------------------------------------------------------------------------
+
+class TestInputContract:
+    def test_registry_names_policies(self):
+        assert CHECKS["nonfinite_matrix"] == "reject"
+        assert CHECKS["noncontiguous_ids"] == "repair"
+        assert set(CHECKS.values()) <= {"reject", "repair"}
+
+    def test_shape_mismatch_one_line(self, small_case):
+        data, labels = small_case
+        with pytest.raises(InputContractError, match="labels length") as ei:
+            refine(data, list(labels)[:-3],
+                   ReclusterConfig(deep_split_values=(1,)), mesh=None)
+        assert ei.value.check == "shape"
+        assert isinstance(ei.value, ValueError)  # back-compat contract
+
+    def test_nan_matrix_rejected(self, small_case):
+        data, labels = small_case
+        bad = np.array(data, copy=True)
+        bad[3, 7] = np.nan
+        with pytest.raises(InputContractError, match="NaN") as ei:
+            refine(bad, labels, ReclusterConfig(deep_split_values=(1,)),
+                   mesh=None)
+        assert ei.value.check == "nonfinite_matrix"
+
+    def test_inf_sparse_rejected(self, small_case):
+        import scipy.sparse as sp
+
+        data, labels = small_case
+        bad = np.array(data, copy=True)
+        bad[5, 11] = np.inf
+        with pytest.raises(InputContractError, match="Inf"):
+            refine(sp.csr_matrix(bad), labels,
+                   ReclusterConfig(deep_split_values=(1,)), mesh=None)
+
+    def test_nan_labels_rejected(self, small_case):
+        data, _ = small_case
+        labels = np.zeros(data.shape[1], np.float64)
+        labels[: data.shape[1] // 2] = 1.0
+        labels[0] = np.nan
+        with pytest.raises(InputContractError, match="NaN") as ei:
+            refine(data, labels, ReclusterConfig(deep_split_values=(1,)),
+                   mesh=None)
+        assert ei.value.check == "nan_labels"
+
+    def test_degenerate_clusters_one_line(self, small_case):
+        data, _ = small_case
+        # one big cluster + a singleton: nothing to pair
+        labels = ["a"] * (data.shape[1] - 1) + ["b"]
+        with pytest.raises(InputContractError,
+                           match="cluster.*survive") as ei:
+            refine(data, labels, ReclusterConfig(deep_split_values=(1,)),
+                   mesh=None)
+        assert ei.value.check == "degenerate_clusters"
+        assert "b(1)" in str(ei.value)  # the diagnosis names the dropped
+
+    def test_repairs_recorded_not_fatal(self, small_case, serial_ref):
+        data, labels = small_case
+        # non-contiguous integer ids: 0/1/2 -> 0/5/9 (gap), plus the
+        # run must still produce the same clustering
+        # single-digit gapped ids so the lexicographic name sort keeps
+        # the reference's cluster order
+        remap = {n: i * 4 for i, n in enumerate(sorted(set(labels)))}
+        gappy = np.array([remap[v] for v in labels], np.int64)
+        res = refine(data, gappy,
+                     ReclusterConfig(deep_split_values=(1, 2)), mesh=None)
+        for k, v in serial_ref.dynamic_labels.items():
+            np.testing.assert_array_equal(res.dynamic_labels[k], v)
+        rb = res.metrics["robustness"]
+        assert any(d["site"] == "input_contract"
+                   and d["action"] == "repair:noncontiguous_ids"
+                   for d in rb["degradations"])
+
+    def test_preflight_direct_returns_repairs(self, small_case):
+        data, labels = small_case
+        out = preflight(data, labels,
+                        ReclusterConfig(deep_split_values=(1,)))
+        assert out == []  # clean inputs: no repairs, no exception
+
+
+# --------------------------------------------------------------------------
+# zero-fault overhead guard (<2%, r13 pattern, elastic layer included)
+# --------------------------------------------------------------------------
+
+class TestElasticOverheadGuard:
+    def test_supervised_mesh_run_under_two_percent(self, tmp_path,
+                                                   small_case):
+        data, labels = small_case
+        mesh = make_mesh(8)
+        cfg_warm = ReclusterConfig(deep_split_values=(1, 2))
+        refine(data, labels, cfg_warm, mesh=mesh)  # warm compiles
+        best_ratio = float("inf")
+        for i in range(3):
+            robust_record.begin_run()
+            t0 = time.perf_counter()
+            refine(data, labels,
+                   ReclusterConfig(deep_split_values=(1, 2),
+                                   artifact_dir=str(tmp_path / f"s{i}")),
+                   mesh=mesh)
+            wall = time.perf_counter() - t0
+            consumed = robust_record.current_run().consumed_s
+            best_ratio = min(best_ratio, consumed / max(wall, 1e-9))
+        assert best_ratio < 0.02, (
+            f"robustness+elastic layer consumed {best_ratio:.1%} of a "
+            "supervised mesh run's wall (checksums + fault points + "
+            "pre-flight + mesh provenance); contract is < 2%"
+        )
+
+
+# --------------------------------------------------------------------------
+# tooling: heartbeat mesh panel, explain_run, soak harness
+# --------------------------------------------------------------------------
+
+class TestTooling:
+    def test_live_summary_and_tail_panel(self, small_case):
+        robust_record.note_mesh_transition(
+            "stage:de", list(range(8)), list(range(4)),
+            recovered_state_bytes=1024, cause="device_loss",
+        )
+        robust_record.note_mesh_transition(
+            "stage:tree", list(range(4)), list(range(2)),
+            recovered_state_bytes=512, cause="device_loss",
+        )
+        live = robust_record.live_summary()
+        assert live["mesh"] == {"transitions": 2, "devices": 2,
+                                "path": "8 → 4 → 2"}
+
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import tail_run
+
+        hb = {"t": "hb", "ts": 1000.0, "seq": 1, "up_s": 5.0,
+              "progress_unix": 1000.0, "since_progress_s": 0.0,
+              "open_spans": [], "spans_done": 3, "stalls": 0,
+              "rss_bytes": 1 << 20, "robust": live}
+        header = {"t": "header", "ts": 995.0, "pid": 1,
+                  "interval_s": 5.0, "argv": [], "key": {}}
+        panel = tail_run.render([header, hb], {})
+        assert "MESH 2 dev" in panel
+        assert "8 → 4 → 2" in panel
+        assert "2 transition(s)" in panel
+
+    def test_explain_run_renders_transitions(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import explain_run
+
+        rb = {
+            "retries": [{"site": "stage:de",
+                         "error_class": "device_lost", "attempts": 2,
+                         "recovered": True, "backoff_s": 0.05}],
+            "mesh_transitions": [
+                {"stage": "stage:de", "from_devices": list(range(8)),
+                 "to_devices": list(range(4)),
+                 "recovered_state_bytes": 36480,
+                 "cause": "device_loss"},
+                {"stage": "wilcox_test", "from_devices": list(range(4)),
+                 "to_devices": [0], "recovered_state_bytes": 18240,
+                 "cause": "resume"},
+            ],
+            "recovered": True,
+            "budget": {"limit": 16, "used": 1},
+        }
+        text = "\n".join(
+            explain_run.robustness_section({"robustness": rb})
+        )
+        assert "Elastic mesh transitions" in text
+        assert "8 → 4 → 1" in text
+        assert "device_loss" in text and "resume" in text
+        assert "36,480 B" in text
+
+    def test_soak_matrix_and_budget(self, tmp_path, monkeypatch):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import chaos_run
+
+        names = [m[0] for m in chaos_run.SOAK_MATRIX]
+        assert "device-loss-de" in names and "device-loss-tree" in names
+        dl = dict((m[0], m) for m in chaos_run.SOAK_MATRIX)
+        assert dl["device-loss-de"][2] is True   # expects recovery
+        assert dl["device-loss-de"][3] is True   # forces the mesh env
+
+        calls = []
+
+        def fake_chaos(plan, config, evidence, timeout, no_fork, expect):
+            calls.append((os.path.basename(plan), round(timeout, 1)))
+            if dl[os.path.basename(plan)[:-5]][3]:
+                # the device-loss plans must run under a forced mesh
+                assert "--xla_force_host_platform_device_count=8" in \
+                    os.environ.get("XLA_FLAGS", "")
+            return 0
+
+        monkeypatch.setattr(chaos_run, "run_chaos", fake_chaos)
+        rc = chaos_run.run_soak("quick", str(tmp_path), 100.0, True,
+                                only=["transient-embed",
+                                      "device-loss-de"])
+        assert rc == 0 and len(calls) == 2
+
+        # one budget across the matrix: an exhausted budget fails the
+        # remaining plans instead of silently skipping them
+        monkeypatch.setattr(chaos_run, "run_chaos",
+                            lambda *a: (_ for _ in ()).throw(
+                                AssertionError("must not run")))
+        rc = chaos_run.run_soak("quick", str(tmp_path), -1.0, True,
+                                only=["transient-embed"])
+        assert rc == 1
+
+    def test_soak_unknown_plan_is_usage_error(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import chaos_run
+
+        assert chaos_run.run_soak("quick", str(tmp_path), 10.0, True,
+                                  only=["no-such-plan"]) == 2

@@ -4,6 +4,7 @@ the recovered record into the evidence ledger.
 
     chaos_run.py --plan PLAN.json [--config quick] [--evidence DIR]
                  [--timeout S] [--no-fork] [--expect-recovery]
+    chaos_run.py --soak [--soak-plans a,b,...] [--config quick] ...
 
 The bench runs with ``SCC_FAULT_PLAN`` pointing at the plan (robust.faults
 injects the named fault classes at their sites) and auto-ingest disabled;
@@ -15,6 +16,15 @@ regression baselines, and ingests it with ``source="chaos"``.
 ``--expect-recovery`` additionally fails unless the section claims (and
 evidences — validate_run_record enforces that) recovery.
 
+``--soak`` runs the NAMED matrix of fault plans (:data:`SOAK_MATRIX` —
+transient/oom/stall at the classic sites plus the elastic device-loss
+plans, which force an 8-virtual-device CPU mesh so the shrink ladder is
+exercised without hardware) back-to-back under ONE wall-clock budget
+(``--timeout`` covers the whole soak; a plan that would start past the
+budget is failed as budget-exhausted, never silently skipped) and emits
+a single pass/fail soak summary line. ``--soak-plans`` filters the
+matrix by name (comma-separated) for bounded CI runs.
+
 Exit codes: 0 chaos contract held; 1 it did not; 2 usage/IO error.
 """
 
@@ -25,7 +35,9 @@ import json
 import os
 import subprocess
 import sys
-from typing import List, Optional
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
@@ -35,6 +47,83 @@ from scconsensus_tpu.obs.ledger import (  # noqa: E402
     Ledger,
     default_evidence_dir,
 )
+
+
+# The standing soak matrix: (name, fault rules, expect_recovery,
+# needs_mesh). needs_mesh plans run the bench under a forced
+# 8-virtual-device CPU mesh (XLA_FLAGS) so device-loss recovery — the
+# elastic shrink ladder — is exercised deterministically on any box.
+SOAK_MATRIX: List[Tuple[str, List[Dict[str, Any]], bool, bool]] = [
+    ("transient-embed",
+     [{"site": "stage:embed", "class": "transient", "after": 0},
+      {"site": "stage:embed", "class": "transient", "after": 2}],
+     True, False),
+    ("oom-wilcox-bucket",
+     [{"site": "wilcox_bucket", "class": "oom"}],
+     True, False),
+    ("stall-de",
+     [{"site": "stage:de", "class": "stall", "stall_s": 0.2}],
+     False, False),
+    ("device-loss-de",
+     [{"site": "stage:de", "class": "device_loss"}],
+     True, True),
+    ("device-loss-tree",
+     [{"site": "stage:tree", "class": "device_loss"}],
+     True, True),
+]
+
+
+def run_soak(config: str, evidence_dir: str, budget_s: float,
+             no_fork: bool, only: Optional[List[str]] = None) -> int:
+    """Run the soak matrix back-to-back under one wall-clock budget and
+    print a single pass/fail summary JSON line."""
+    matrix = [m for m in SOAK_MATRIX if not only or m[0] in only]
+    if not matrix:
+        print(f"chaos_run: --soak-plans matched nothing "
+              f"(known: {[m[0] for m in SOAK_MATRIX]})", file=sys.stderr)
+        return 2
+    t0 = time.monotonic()
+    results: List[Dict[str, Any]] = []
+    with tempfile.TemporaryDirectory(prefix="scc-soak-") as tmp:
+        for name, rules, expect_recovery, needs_mesh in matrix:
+            remaining = budget_s - (time.monotonic() - t0)
+            if remaining <= 0:
+                results.append({"plan": name, "ok": False,
+                                "outcome": "budget-exhausted"})
+                continue
+            plan_path = os.path.join(tmp, f"{name}.json")
+            with open(plan_path, "w") as f:
+                json.dump({"faults": rules}, f)
+            saved_xla = os.environ.get("XLA_FLAGS")
+            if needs_mesh:
+                os.environ["XLA_FLAGS"] = (
+                    (saved_xla or "")
+                    + " --xla_force_host_platform_device_count=8"
+                ).strip()
+            t_plan = time.monotonic()
+            try:
+                rc = run_chaos(plan_path, config, evidence_dir,
+                               remaining, no_fork, expect_recovery)
+            finally:
+                if needs_mesh:
+                    if saved_xla is None:
+                        os.environ.pop("XLA_FLAGS", None)
+                    else:
+                        os.environ["XLA_FLAGS"] = saved_xla
+            results.append({
+                "plan": name, "ok": rc == 0,
+                "outcome": "ok" if rc == 0 else f"rc={rc}",
+                "elapsed_s": round(time.monotonic() - t_plan, 1),
+            })
+    ok = all(r["ok"] for r in results)
+    print(json.dumps({
+        "soak": "ok" if ok else "FAIL",
+        "config": config,
+        "plans": results,
+        "budget_s": budget_s,
+        "consumed_s": round(time.monotonic() - t0, 1),
+    }))
+    return 0 if ok else 1
 
 
 def run_chaos(plan: str, config: str, evidence_dir: str, timeout_s: float,
@@ -131,21 +220,36 @@ def run_chaos(plan: str, config: str, evidence_dir: str, timeout_s: float,
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description="fault-plan chaos harness")
-    ap.add_argument("--plan", required=True, help="fault plan JSON")
+    ap.add_argument("--plan", help="fault plan JSON")
     ap.add_argument("--config", default="quick",
                     help="bench config (default: quick)")
     ap.add_argument("--evidence", default=None,
                     help="ledger dir (default: SCC_EVIDENCE_DIR or "
                          "<repo>/evidence)")
-    ap.add_argument("--timeout", type=float, default=1800.0)
+    ap.add_argument("--timeout", type=float, default=1800.0,
+                    help="per-run timeout; with --soak, the ONE budget "
+                         "the whole matrix shares")
     ap.add_argument("--no-fork", action="store_true",
                     help="run the worker in-process (no orchestrator "
                          "ladder — kill-class faults then end the run)")
     ap.add_argument("--expect-recovery", action="store_true",
                     help="fail unless the record claims recovery")
+    ap.add_argument("--soak", action="store_true",
+                    help="run the named soak matrix of fault plans "
+                         "back-to-back under one budget")
+    ap.add_argument("--soak-plans", default=None,
+                    help="comma-separated soak plan names to run "
+                         "(default: the full matrix)")
     args = ap.parse_args(argv)
     evidence = args.evidence or default_evidence_dir(_REPO)
     os.makedirs(evidence, exist_ok=True)
+    if args.soak:
+        only = ([s.strip() for s in args.soak_plans.split(",") if s.strip()]
+                if args.soak_plans else None)
+        return run_soak(args.config, evidence, args.timeout,
+                        args.no_fork, only)
+    if not args.plan:
+        ap.error("--plan required (or --soak)")
     return run_chaos(args.plan, args.config, evidence, args.timeout,
                      args.no_fork, args.expect_recovery)
 

@@ -433,6 +433,26 @@ def robustness_section(rec: Dict[str, Any]) -> List[str]:
             f"{p.get('unit', 'unit')}(s) loaded instead of recomputed"
             for p in resumes
         ]
+    transitions = rb.get("mesh_transitions") or []
+    if transitions:
+        path = " → ".join(
+            [str(len(transitions[0].get("from_devices") or []))]
+            + [str(len(t.get("to_devices") or [])) for t in transitions]
+        )
+        out += ["", f"**Elastic mesh transitions** (device path: {path}):",
+                "",
+                "| stage | from | to | cause | recovered state |",
+                "|---|---|---|---|---:|"]
+        out += [
+            f"| {t.get('stage')} "
+            f"| {len(t.get('from_devices') or [])} dev "
+            f"{t.get('from_devices')} "
+            f"| {len(t.get('to_devices') or [])} dev "
+            f"{t.get('to_devices')} "
+            f"| {t.get('cause', 'device_loss')} "
+            f"| {t.get('recovered_state_bytes', 0):,} B |"
+            for t in transitions
+        ]
     orch = rb.get("orchestration") or {}
     if orch:
         att = orch.get("attempts") or []

@@ -320,6 +320,40 @@ def _smoke(fixtures: str, as_json: bool) -> int:
         rb_rejected,
     ))
 
+    # elastic mesh schema (elastic round): a record whose run shrank its
+    # mesh (in-process device loss + a shape-polymorphic checkpoint
+    # resume, both stamped as mesh_transitions) validates and gates
+    # normally on its walls...
+    verdict_el, _ = run_gate(
+        os.path.join(fixtures, "candidate_elastic_recovered.json"),
+        evidence,
+    )
+    el_rb = _load_json(
+        os.path.join(fixtures, "candidate_elastic_recovered.json")
+    ).get("robustness") or {}
+    el_tr = el_rb.get("mesh_transitions") or []
+    checks.append((
+        "elastic-recovered candidate validates and passes with "
+        "mesh_transitions evidence",
+        verdict_el.ok and len(el_tr) >= 2
+        and any(t.get("cause") == "device_loss" for t in el_tr)
+        and any(t.get("cause") == "resume" for t in el_tr),
+    ))
+    # ...while a transition whose device set GROWS is REJECTED — elastic
+    # recovery only ever moves onto survivors
+    try:
+        run_gate(
+            os.path.join(fixtures, "candidate_bad_mesh_transition.json"),
+            evidence,
+        )
+        el_rejected = False
+    except ValueError as e:
+        el_rejected = "shrink" in str(e)
+    checks.append((
+        "mesh transition with a non-shrinking device set rejected",
+        el_rejected,
+    ))
+
     for label, ok in checks:
         print(f"[smoke] {'ok  ' if ok else 'FAIL'} {label}")
     ok_all = all(ok for _, ok in checks)

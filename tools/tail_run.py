@@ -268,6 +268,16 @@ def render(lines: List[Dict[str, Any]],
                 bits.append(f"degraded x{rb['degradations']}")
             if rb.get("resumes"):
                 bits.append(f"resumed x{rb['resumes']}")
+            mesh = rb.get("mesh") or {}
+            if mesh:
+                # live elastic panel: current device count + the shrink
+                # path so far (robust.record.live_summary feeds it)
+                bits.append(
+                    f"MESH {mesh.get('devices')} dev"
+                    + (f" (path {mesh['path']})" if mesh.get("path")
+                       else "")
+                    + f" after {mesh.get('transitions')} transition(s)"
+                )
             if bits:
                 out.append("  robust: " + "   ".join(bits))
     if st["stall"]:
