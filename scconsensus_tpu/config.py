@@ -170,6 +170,46 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "from completed buckets instead of recomputing the whole "
                 "DE stage. Set 0 to disable (store-less runs are always "
                 "unaffected)."),
+        # --- serving (serve/) ---
+        EnvFlag("SCC_SERVE_MAX_BATCH", int, 512,
+                "Serving micro-batch cell cap (serve.driver): the worker "
+                "coalesces queued requests until this many cells or the "
+                "batch window elapses; a single request larger than this "
+                "is rejected typed at admission (split it client-side)."),
+        EnvFlag("SCC_SERVE_QUEUE_CAP", int, 256,
+                "Bounded admission queue capacity in REQUESTS: a submit "
+                "at capacity raises typed QueueFull carrying a "
+                "retry_after_s hint — backpressure, never unbounded "
+                "growth."),
+        EnvFlag("SCC_SERVE_BATCH_WINDOW_S", float, 0.002,
+                "Micro-batch linger window: after the first request the "
+                "worker waits up to this long for concurrent arrivals "
+                "before dispatching the batch (latency floor vs "
+                "throughput knob)."),
+        EnvFlag("SCC_SERVE_DEADLINE_S", float, 30.0,
+                "Default per-request deadline: overruns (queue wait or "
+                "compute) resolve as typed DeadlineExceeded, never a "
+                "silently late answer. Per-request override via "
+                "submit(deadline_s=)."),
+        EnvFlag("SCC_SERVE_BREAKER_THRESHOLD", int, 3,
+                "Circuit breaker trip threshold: this many consecutive "
+                "device-class failures (resource/transient/device_lost "
+                "per the robust.retry classifier) open the breaker and "
+                "route batches to the degraded-flagged host fallback."),
+        EnvFlag("SCC_SERVE_BREAKER_COOLDOWN_S", float, 5.0,
+                "Seconds an open breaker waits before half-open-probing "
+                "the device path again (a probe success closes it, a "
+                "failure re-opens and restarts the cooldown)."),
+        EnvFlag("SCC_SERVE_DRIFT_FRAC", float, 0.5,
+                "Drift-quarantine gate: a request whose fraction of "
+                "cells past the model's calibrated foreign-cell distance "
+                "threshold reaches this value gets NO labels — it is "
+                "appended to the quarantine ledger and flagged "
+                "quarantined. Values > 1 disable the gate."),
+        EnvFlag("SCC_SERVE_DRIFT_MARGIN", float, 1.5,
+                "Export-time drift calibration margin: the foreign-cell "
+                "threshold is the training q99 nearest-landmark distance "
+                "times this factor (stored in the frozen model)."),
         # --- DE engine ---
         EnvFlag("SCC_WILCOX_PROBE", bool, False,
                 "Synced per-bucket occupancy DIAGNOSIS of the Wilcoxon "

@@ -58,6 +58,14 @@ schema ``scc-run-record`` version 1 — top-level keys:
                     robust.record.validate_robustness — a section
                     claiming recovery without retry/resume evidence is
                     rejected. Absent on healthy unfaulted runs.
+  serving           OPTIONAL (still schema version 1 — additive): the
+                    online-serving trail (serve.metrics) — per-outcome
+                    request counters, p50/p99 latency, throughput, queue
+                    depth/capacity, circuit-breaker state + trips, drift
+                    quarantine counts, driver overhead. Validated by
+                    serve.metrics.validate_serving — a section whose
+                    outcome counters do not sum to its submissions
+                    (a lost request) is rejected.
 
 The Chrome trace export (:func:`chrome_trace`) converts the span tree to
 ``traceEvents`` complete ("X") events — open the file in Perfetto
@@ -128,6 +136,7 @@ def build_run_record(
     residency: Optional[Dict[str, Any]] = None,
     kernels: Optional[Dict[str, Any]] = None,
     robustness: Optional[Dict[str, Any]] = None,
+    serving: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One schema-v1 run record. Pass ``tracer`` to take spans + compile
     stats from it; or pre-built ``spans`` (e.g. a resumed pipeline's
@@ -137,7 +146,8 @@ def build_run_record(
     trips; ``residency`` / ``kernels`` (optional) attach the
     obs.residency transfer audit and the obs.kernels device-op
     timeline; ``robustness`` (optional) attaches the robust.record
-    fault/retry/resume trail."""
+    fault/retry/resume trail; ``serving`` (optional) attaches the
+    serve.metrics online-serving section."""
     if spans is None:
         spans = tracer.span_records() if tracer is not None else []
     extra = dict(extra or {})
@@ -173,6 +183,8 @@ def build_run_record(
         rec["kernels"] = kernels
     if robustness is not None:
         rec["robustness"] = robustness
+    if serving is not None:
+        rec["serving"] = serving
     return rec
 
 
@@ -273,6 +285,12 @@ def validate_run_record(rec: Dict[str, Any]) -> None:
         from scconsensus_tpu.robust.record import validate_robustness
 
         validate_robustness(rb)
+    sv = rec.get("serving")
+    if sv is not None:
+        # jax-free import (serve.metrics is stdlib-only by contract)
+        from scconsensus_tpu.serve.metrics import validate_serving
+
+        validate_serving(sv)
 
 
 # --------------------------------------------------------------------------

@@ -379,6 +379,18 @@ class Ledger:
                 entry["robustness"]["mesh_devices"] = len(
                     rb["mesh_transitions"][-1].get("to_devices") or []
                 )
+        sv = rec.get("serving")
+        if isinstance(sv, dict) and sv:
+            # serving latency summary on the index: the perf gate's
+            # latency baselines (regress.serving_baselines) read the
+            # manifest, not N record files — exactly like stage_walls
+            lat = sv.get("latency_ms") or {}
+            entry["serving"] = {
+                "p50_ms": lat.get("p50"),
+                "p99_ms": lat.get("p99"),
+                "throughput_rps": sv.get("throughput_rps"),
+                "requests": (sv.get("requests") or {}).get("submitted"),
+            }
         fp = (rec.get("extra") or {}).get("numeric_fingerprint")
         if isinstance(fp, dict) and fp:
             # every ingested run is fingerprint-stamped on its manifest

@@ -472,6 +472,18 @@ class LiveRecorder:
                 hb["robust"] = rs
         except Exception:
             pass
+        try:
+            # serving panel: queue depth, rolling p99, breaker state and
+            # the degraded/quarantined/rejected tallies of the process's
+            # active serving driver — an online path fighting for its
+            # life shows it on the stream tick by tick
+            from scconsensus_tpu.serve import metrics as serve_metrics
+
+            ss = serve_metrics.live_summary()
+            if ss:
+                hb["serving"] = ss
+        except Exception:
+            pass
         mem = obs_device.memory_snapshot()
         if mem is not None:
             hb["hbm"] = mem

@@ -38,9 +38,11 @@ __all__ = [
     "ANCHOR_RUNS",
     "StageVerdict",
     "TransferVerdict",
+    "ServingVerdict",
     "GateVerdict",
     "stage_baselines",
     "stage_transfer_baselines",
+    "serving_baselines",
     "diff_span_trees",
     "gate_record",
     "DRIFT_LEDGER_NAME",
@@ -64,18 +66,27 @@ ABS_NOISE_FLOOR_S = 0.05  # ...or 50 ms (timer + drain jitter at tiny walls)
 # dependent paths (overflow redo, exact-branch pair counts) wiggle a few
 # KiB — 64 KiB absolute floor, same 10 % relative floor as walls.
 ABS_NOISE_FLOOR_BYTES = 64 << 10
+# Serving-latency bands (BASELINE.md serving-latency policy): tail
+# latency is the noisiest gated quantity (scheduler jitter, GC pauses,
+# queue-shape luck), so the relative floor is 25 % — wide enough that a
+# loaded CI box doesn't false-fail, narrow enough that a 3× p99 cannot
+# hide — with a 1 ms absolute floor for sub-ms baselines.
+SERVE_REL_NOISE_FLOOR = 0.25
+ABS_NOISE_FLOOR_MS = 1.0
 
 
 # --------------------------------------------------------------------------
 # per-stage baselines (walls and transfer bytes share one banding policy)
 # --------------------------------------------------------------------------
 
-def _banded_baselines(series: Dict[str, List[float]], abs_floor: float
+def _banded_baselines(series: Dict[str, List[float]], abs_floor: float,
+                      rel_floor: float = REL_NOISE_FLOOR
                       ) -> Dict[str, Dict[str, float]]:
     """Median-of-≤ANCHOR_RUNS with a noise band floored at
-    ``max(spread, 10% of baseline, abs_floor)`` — the BASELINE.md policy,
-    shared by stage walls and stage transfer bytes so the two gates can
-    never drift apart."""
+    ``max(spread, rel_floor·baseline, abs_floor)`` — the BASELINE.md
+    policy, shared by stage walls, stage transfer bytes, and serving
+    latency so the gates can never drift apart (only the floors differ
+    per quantity)."""
     out: Dict[str, Dict[str, float]] = {}
     for stage, vs in series.items():
         anchor = sorted(vs[-ANCHOR_RUNS:])
@@ -84,7 +95,7 @@ def _banded_baselines(series: Dict[str, List[float]], abs_floor: float
             0.5 * (anchor[n // 2 - 1] + anchor[n // 2])
         )
         spread = anchor[-1] - anchor[0]
-        band = max(spread, REL_NOISE_FLOOR * baseline, abs_floor)
+        band = max(spread, rel_floor * baseline, abs_floor)
         out[stage] = {
             "baseline": baseline,
             "band": band,
@@ -152,6 +163,38 @@ def stage_transfer_baselines(history: Sequence[Dict[str, Any]]
         }
         for stage, b in _banded_baselines(
             series, ABS_NOISE_FLOOR_BYTES
+        ).items()
+    }
+
+
+def serving_baselines(history: Sequence[Dict[str, Any]]
+                      ) -> Dict[str, Dict[str, float]]:
+    """Serving-latency baselines from manifest entries' ledger-stamped
+    ``serving`` summaries (obs.ledger ingest). Gated metrics: ``p99_ms``
+    (the tail is the serving contract) with ``p50_ms`` carried for the
+    report. Same median-of-≤3 machinery, SERVING floors (25 % / 1 ms),
+    partials excluded. Entries without a serving stamp simply don't
+    anchor — absence of serving must not read as zero latency."""
+    from scconsensus_tpu.obs.ledger import is_partial_entry
+
+    series: Dict[str, List[float]] = {}
+    for e in history:
+        if is_partial_entry(e):
+            continue
+        sv = e.get("serving") or {}
+        for metric in ("p50_ms", "p99_ms"):
+            v = sv.get(metric)
+            if isinstance(v, (int, float)) and v >= 0:
+                series.setdefault(metric, []).append(float(v))
+    return {
+        metric: {
+            "baseline_ms": round(b["baseline"], 4),
+            "band_ms": round(b["band"], 4),
+            "spread_ms": round(b["spread"], 4),
+            "n": b["n"],
+        }
+        for metric, b in _banded_baselines(
+            series, ABS_NOISE_FLOOR_MS, rel_floor=SERVE_REL_NOISE_FLOOR
         ).items()
     }
 
@@ -245,6 +288,24 @@ class TransferVerdict:
 
 
 @dataclasses.dataclass
+class ServingVerdict:
+    """Serving-latency verdict (candidate serving section vs the key's
+    ledger-stamped latency baselines) — the tail-latency equivalent of a
+    stage-wall claim. A clean-walls candidate whose p99 blew out fails
+    on THIS verdict alone."""
+
+    metric: str                    # "p99_ms" | "p50_ms"
+    value_ms: float
+    baseline_ms: float
+    band_ms: float
+    regressed: bool
+    excess_ms: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
 class GateVerdict:
     ok: bool
     key: Dict[str, str]
@@ -261,6 +322,11 @@ class GateVerdict:
     transfers: List[TransferVerdict] = dataclasses.field(
         default_factory=list
     )
+    # serving-latency verdicts (empty when the candidate carried no
+    # serving section or the key has no latency history)
+    serving: List[ServingVerdict] = dataclasses.field(
+        default_factory=list
+    )
 
     @property
     def regressions(self) -> List[StageVerdict]:
@@ -269,6 +335,10 @@ class GateVerdict:
     @property
     def transfer_regressions(self) -> List[TransferVerdict]:
         return [t for t in self.transfers if t.regressed]
+
+    @property
+    def serving_regressions(self) -> List[ServingVerdict]:
+        return [s for s in self.serving if s.regressed]
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -283,6 +353,10 @@ class GateVerdict:
             "transfers": [t.to_dict() for t in self.transfers],
             "transfer_regressions": [
                 t.to_dict() for t in self.transfer_regressions
+            ],
+            "serving": [s.to_dict() for s in self.serving],
+            "serving_regressions": [
+                s.to_dict() for s in self.serving_regressions
             ],
         }
 
@@ -400,14 +474,37 @@ def gate_record(candidate: Dict[str, Any],
             if tv.regressed:
                 tv.excess_bytes = int(nbytes - limit_b)
             transfers.append(tv)
-    ok = not any(s.regressed for s in stages) and not any(
-        t.regressed for t in transfers
-    )
+    # serving-latency gate: the candidate's p50/p99 vs the key's ledger-
+    # stamped latency baselines (BASELINE.md serving-latency policy).
+    # Only the tail (p99) fails the gate; p50 is reported informationally
+    # — a p50 shift inside a clean p99 is tuning, not a regression.
+    serving: List[ServingVerdict] = []
+    cand_lat = ((candidate.get("serving") or {}).get("latency_ms")
+                or {})
+    if cand_lat.get("n"):
+        sbase = serving_baselines(history)
+        for metric in ("p50_ms", "p99_ms"):
+            v = cand_lat.get(metric.split("_")[0])
+            base = sbase.get(metric)
+            if v is None or base is None:
+                continue
+            limit_ms = base["baseline_ms"] + base["band_ms"]
+            svv = ServingVerdict(
+                metric=metric, value_ms=round(float(v), 4),
+                baseline_ms=base["baseline_ms"], band_ms=base["band_ms"],
+                regressed=(metric == "p99_ms" and v > limit_ms),
+            )
+            if svv.regressed:
+                svv.excess_ms = round(float(v) - limit_ms, 4)
+            serving.append(svv)
+    ok = (not any(s.regressed for s in stages)
+          and not any(t.regressed for t in transfers)
+          and not any(s.regressed for s in serving))
     return GateVerdict(ok=ok, key=key, n_history=len(history),
                        stages=stages, note=note,
                        n_partial_excluded=n_partial,
                        candidate_termination=cand_term,
-                       transfers=transfers)
+                       transfers=transfers, serving=serving)
 
 
 # --------------------------------------------------------------------------

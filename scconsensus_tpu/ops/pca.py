@@ -17,7 +17,31 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-__all__ = ["pca_scores"]
+__all__ = ["pca_scores", "pca_basis"]
+
+
+def _subspace_basis(x, n_components: int, n_oversample: int, n_iter: int,
+                    seed: int):
+    """The one randomized-subspace-iteration body behind both public
+    entry points: returns ``(mean (F,), vt (n_components, F), xc)``.
+    Shared so the serving guarantee — a frozen model's persisted basis
+    reproduces the pipeline's scores — holds by construction, not by
+    keeping two copies of this loop in sync."""
+    n, f = x.shape
+    k = min(n_components + n_oversample, f, n)
+    mean = jnp.mean(x, axis=0)
+    xc = x - mean[None, :]
+    omega = jax.random.normal(jax.random.PRNGKey(seed), (f, k), dtype=x.dtype)
+    y = xc @ omega                       # (N, k)
+    q, _ = jnp.linalg.qr(y)
+    for _ in range(n_iter):
+        z = xc.T @ q                     # (F, k)
+        w, _ = jnp.linalg.qr(z)
+        y = xc @ w                       # (N, k)
+        q, _ = jnp.linalg.qr(y)
+    b = q.T @ xc                         # (k, F)
+    _, _, vt = jnp.linalg.svd(b, full_matrices=False)
+    return mean, vt[:n_components], xc
 
 
 @partial(jax.jit, static_argnames=("n_components", "n_oversample", "n_iter"))
@@ -37,19 +61,27 @@ def pca_scores(
     Returns (N, n_components) scores = centered x projected onto the top PCs,
     matching ``prcomp_irlba(...)$x`` up to column signs.
     """
-    n, f = x.shape
-    k = min(n_components + n_oversample, f, n)
-    xc = x - jnp.mean(x, axis=0, keepdims=True)
-    omega = jax.random.normal(jax.random.PRNGKey(seed), (f, k), dtype=x.dtype)
-    y = xc @ omega                       # (N, k)
-    q, _ = jnp.linalg.qr(y)
-    for _ in range(n_iter):
-        z = xc.T @ q                     # (F, k)
-        w, _ = jnp.linalg.qr(z)
-        y = xc @ w                       # (N, k)
-        q, _ = jnp.linalg.qr(y)
-    b = q.T @ xc                         # (k, F)
-    _, s, vt = jnp.linalg.svd(b, full_matrices=False)
-    scores = xc @ vt[:n_components].T    # (N, n_components)
-    del s
-    return scores
+    _, vt, xc = _subspace_basis(x, n_components, n_oversample, n_iter, seed)
+    return xc @ vt.T                     # (N, n_components)
+
+
+@partial(jax.jit, static_argnames=("n_components", "n_oversample", "n_iter"))
+def pca_basis(
+    x: jnp.ndarray,
+    n_components: int,
+    n_oversample: int = 10,
+    n_iter: int = 4,
+    seed: int = 0,
+):
+    """The EXPLICIT projection basis behind :func:`pca_scores`.
+
+    Returns ``(mean (F,), components (n_components, F))`` from the same
+    subspace iteration (one shared body, same seed), so
+    ``(x - mean) @ components.T`` reproduces the training embedding —
+    the piece a frozen consensus model must persist to project NEW cells
+    into the space its landmarks live in (``pca_scores`` alone discards
+    it, which is fine for batch runs that never see another cell).
+    """
+    mean, vt, _ = _subspace_basis(x, n_components, n_oversample, n_iter,
+                                  seed)
+    return mean, vt

@@ -47,6 +47,7 @@ __all__ = [
     "landmark_sketch_policy",
     "landmark_pool",
     "landmark_ward_linkage",
+    "centroid_majority_labels",
 ]
 
 
@@ -361,3 +362,35 @@ def landmark_ward_linkage(
             tree = ward_linkage(cent, weights=counts)
     info["linkage"] = linkage
     return tree, assign, cent, info
+
+
+def centroid_majority_labels(
+    assign: np.ndarray, labels: np.ndarray, k: int
+) -> np.ndarray:
+    """Per-landmark cluster labels by occupancy-weighted majority vote.
+
+    ``assign`` (N,) maps cells to landmarks, ``labels`` (N,) carries the
+    cells' cluster labels with 0 = unassigned (the dynamic-cut
+    convention); unassigned cells never vote. Returns (k,) int64 labels,
+    0 for a landmark whose members are all unassigned (or empty). Ties
+    break to the SMALLEST label — deterministic, so a frozen consensus
+    model exports identically run-to-run.
+    """
+    assign = np.asarray(assign, np.int64)
+    labels = np.asarray(labels, np.int64)
+    if assign.shape != labels.shape:
+        raise ValueError(
+            f"assign {assign.shape} and labels {labels.shape} differ"
+        )
+    out = np.zeros(int(k), np.int64)
+    voting = labels > 0
+    if not voting.any():
+        return out
+    a, lab = assign[voting], labels[voting]
+    n_lab = int(lab.max()) + 1
+    votes = np.zeros((int(k), n_lab), np.int64)
+    np.add.at(votes, (a, lab), 1)
+    winners = np.argmax(votes, axis=1)  # argmax ties -> smallest label
+    has_votes = votes.sum(axis=1) > 0
+    out[has_votes] = winners[has_votes]
+    return out

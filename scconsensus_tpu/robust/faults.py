@@ -20,7 +20,12 @@ the DE ladder's buckets (``wilcox_bucket``), the devcache upload
 by :func:`corrupt_artifact` rather than :func:`fault_point`), and the
 mesh engines' entries (``sharded:aggregates``, ``sharded:ranksum``,
 ``ring:distance_sums``, ``refine_step``) — the elastic plans' way of
-killing a mesh INSIDE a collective rather than at a stage boundary.
+killing a mesh INSIDE a collective rather than at a stage boundary —
+and the serving driver's three sites (``serve_load`` at model load,
+``serve_batch`` at micro-batch assembly, ``serve_device`` inside the
+device classify call), so ``tools/chaos_run.py`` soaks the online path
+the same way it soaks the pipeline; the serving model's write-time
+corruption rides the generic ``artifact:consensus_model`` site.
 
 Fault classes and what they do at a compute site:
 

@@ -1,0 +1,62 @@
+"""Online serving: label new cells against a frozen consensus model.
+
+ROADMAP item 4 realized as a guarded path: a **frozen consensus-model
+artifact** (``serve.model`` — DE-gene panel, PCA basis, landmark
+centroids + dendrogram, drift calibration; persisted and verified
+through the ArtifactStore's sha256/quarantine machinery), a jitted
+one-device-call ``classify``, and an **async micro-batching driver**
+(``serve.driver``) whose robustness is the point: bounded admission with
+typed backpressure, per-request deadlines, a circuit breaker over the
+device path with an explicitly-flagged degraded host fallback, and drift
+quarantine routing out-of-distribution batches to a ledger instead of
+confidently mislabeling them. ``serve.metrics`` validates the run
+record's ``serving`` section — every submitted request must be accounted
+for by exactly one outcome.
+
+Import discipline: the package root, ``errors``, and ``metrics`` are
+jax-free (chaos harness + validators load them); ``model``/``driver``
+pull jax in lazily on first classify.
+"""
+
+from scconsensus_tpu.serve.errors import (  # noqa: F401
+    DeadlineExceeded,
+    ModelLoadError,
+    QueueFull,
+    RequestFailed,
+    RequestInvalid,
+    ServeError,
+    ServerClosed,
+)
+from scconsensus_tpu.serve.metrics import (  # noqa: F401
+    OUTCOMES,
+    ServingStats,
+    validate_serving,
+)
+
+__all__ = [
+    "ServeError",
+    "ModelLoadError",
+    "RequestInvalid",
+    "QueueFull",
+    "DeadlineExceeded",
+    "ServerClosed",
+    "RequestFailed",
+    "OUTCOMES",
+    "ServingStats",
+    "validate_serving",
+]
+
+
+def __getattr__(name):
+    # Lazy: ConsensusServer/ConsensusModel pull in numpy+jax paths.
+    if name in ("ConsensusServer", "ServeConfig", "ServeResponse",
+                "CircuitBreaker"):
+        from scconsensus_tpu.serve import driver
+
+        return getattr(driver, name)
+    if name in ("ConsensusModel", "export_consensus_model",
+                "load_consensus_model"):
+        from scconsensus_tpu.serve import model
+
+        return getattr(model, name)
+    raise AttributeError(name)

@@ -1,0 +1,312 @@
+"""Serving stats + the validated ``serving`` run-record section.
+
+One :class:`ServingStats` per driver (the driver registers it as the
+process's active stats so the heartbeat sampler can feed ``tail_run``'s
+serving panel live). The section's load-bearing rule, enforced by
+:func:`validate_serving` exactly like the robustness section's
+recovery-needs-evidence rule: **every submitted request must be accounted
+for** — ``requests.submitted`` must equal the sum of the outcome
+counters. A serving record that lost track of even one request is
+rejected, because "silently dropped" is the failure mode the whole
+guarded path exists to make impossible.
+
+Import discipline: stdlib only (``validate_run_record`` and the chaos
+harness load this without jax).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "OUTCOMES",
+    "BREAKER_STATES",
+    "ServingStats",
+    "active_stats",
+    "live_summary",
+    "validate_serving",
+]
+
+# Every way a request can leave the system. submit-time rejections
+# (queue-full, invalid, closed) never reach a batch; the rest resolve
+# from one.
+OUTCOMES = (
+    "ok",                 # labels returned, device path, breaker closed
+    "degraded",           # labels returned by the HOST fallback, flagged
+    "quarantined",        # drift gate refused confident labels; ledgered
+    "rejected_queue",     # bounded-admission backpressure (retry-after)
+    "rejected_invalid",   # malformed request, refused at admission
+    "rejected_closed",    # typed ServerClosed (shutdown / undrained stop)
+    "deadline_exceeded",  # typed late failure (queue wait or compute)
+    "failed",             # fatal batch error, typed RequestFailed
+)
+
+BREAKER_STATES = ("closed", "open", "half_open")
+
+# Rolling latency reservoir size: enough for a stable p99 (the live panel
+# and the section both read it), bounded so a soak cannot grow the record.
+_LATENCY_RING = 4096
+
+
+class ServingStats:
+    """Thread-safe counters for one serving driver's lifetime."""
+
+    def __init__(self, queue_capacity: int = 0):
+        self.queue_capacity = int(queue_capacity)
+        self.counts: Dict[str, int] = {o: 0 for o in OUTCOMES}
+        self.submitted = 0
+        self.queue_depth = 0
+        self.queue_peak = 0
+        self.batches = 0
+        self.batch_cells = 0
+        self.batch_max = 0
+        self.breaker_state = "closed"
+        self.breaker_trips = 0
+        self.drift_batches = 0
+        self.quarantine_entries = 0
+        self.consumed_s = 0.0       # self-measured driver bookkeeping
+        self.classify_wall_s = 0.0  # cumulative classify-call wall
+        self.started_unix = time.time()
+        self._lat_ms: List[float] = []
+        self._lat_i = 0             # ring cursor
+        self._lat_n = 0
+        self._lat_sum = 0.0
+        self._lat_max = 0.0
+        self._lock = threading.Lock()
+
+    # -- notes -------------------------------------------------------------
+    def note_submit(self, depth: int) -> None:
+        with self._lock:
+            self.submitted += 1
+            self.queue_depth = int(depth)
+            self.queue_peak = max(self.queue_peak, int(depth))
+
+    def note_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = int(depth)
+            self.queue_peak = max(self.queue_peak, int(depth))
+
+    def note_outcome(self, outcome: str,
+                     latency_s: Optional[float] = None) -> None:
+        if outcome not in OUTCOMES:
+            raise ValueError(f"unknown serving outcome {outcome!r}")
+        with self._lock:
+            self.counts[outcome] += 1
+            if latency_s is not None:
+                ms = max(float(latency_s), 0.0) * 1e3
+                if len(self._lat_ms) < _LATENCY_RING:
+                    self._lat_ms.append(ms)
+                else:
+                    self._lat_ms[self._lat_i] = ms
+                    self._lat_i = (self._lat_i + 1) % _LATENCY_RING
+                self._lat_n += 1
+                self._lat_sum += ms
+                self._lat_max = max(self._lat_max, ms)
+
+    def note_batch(self, n_requests: int, n_cells: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batch_cells += int(n_cells)
+            self.batch_max = max(self.batch_max, int(n_cells))
+
+    def note_breaker(self, state: str, tripped: bool = False) -> None:
+        if state not in BREAKER_STATES:
+            raise ValueError(f"unknown breaker state {state!r}")
+        with self._lock:
+            self.breaker_state = state
+            if tripped:
+                self.breaker_trips += 1
+
+    def note_drift_batch(self, quarantined: int = 0) -> None:
+        with self._lock:
+            self.drift_batches += 1
+            self.quarantine_entries += int(quarantined)
+
+    def add_consumed(self, dt: float) -> None:
+        with self._lock:
+            self.consumed_s += max(float(dt), 0.0)
+
+    def add_classify_wall(self, dt: float) -> None:
+        with self._lock:
+            self.classify_wall_s += max(float(dt), 0.0)
+
+    # -- reads -------------------------------------------------------------
+    def latency_ms(self) -> Dict[str, Any]:
+        with self._lock:
+            if self._lat_n == 0:
+                return {"n": 0}
+            # ONE sort for both quantiles: this runs on every heartbeat
+            # tick under the same lock the submit/resolve hot path takes
+            s = sorted(self._lat_ms)
+            return {
+                "n": self._lat_n,
+                "p50": round(s[min(int(0.50 * len(s)), len(s) - 1)], 4),
+                "p99": round(s[min(int(0.99 * len(s)), len(s) - 1)], 4),
+                "max": round(self._lat_max, 4),
+                "mean": round(self._lat_sum / self._lat_n, 4),
+            }
+
+    def section(self) -> Dict[str, Any]:
+        """The run record's ``serving`` section (always present once a
+        driver ran — unlike robustness, an all-healthy serving window is
+        itself the evidence: N requests in, N outcomes out)."""
+        lat = self.latency_ms()
+        with self._lock:
+            wall = max(time.time() - self.started_unix, 0.0)
+            served = sum(self.counts[o]
+                         for o in ("ok", "degraded", "quarantined"))
+            return {
+                "requests": {"submitted": self.submitted,
+                             **dict(self.counts)},
+                "latency_ms": lat,
+                "throughput_rps": round(served / wall, 4) if wall else 0.0,
+                "batches": {
+                    "count": self.batches,
+                    "cells": self.batch_cells,
+                    "max_cells": self.batch_max,
+                    "mean_cells": (round(self.batch_cells / self.batches, 2)
+                                   if self.batches else 0.0),
+                },
+                "queue": {"depth_peak": self.queue_peak,
+                          "capacity": self.queue_capacity},
+                "breaker": {"state": self.breaker_state,
+                            "trips": self.breaker_trips},
+                "drift": {"batches_flagged": self.drift_batches,
+                          "quarantine_entries": self.quarantine_entries},
+                "consumed_s": round(self.consumed_s, 4),
+                "classify_wall_s": round(self.classify_wall_s, 4),
+                "window_s": round(wall, 4),
+            }
+
+
+# -- the process's active stats (heartbeat feed) ----------------------------
+
+_ACTIVE: Optional[ServingStats] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def set_active(stats: Optional[ServingStats]) -> None:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = stats
+
+
+def active_stats() -> Optional[ServingStats]:
+    return _ACTIVE
+
+
+def live_summary() -> Optional[Dict[str, Any]]:
+    """Compact serving counters for one heartbeat tick (None = no driver
+    running) — queue depth, rolling p99, breaker state, and the
+    degraded/quarantined/rejected tallies tail_run's panel renders."""
+    st = _ACTIVE
+    if st is None:
+        return None
+    lat = st.latency_ms()
+    with st._lock:
+        out: Dict[str, Any] = {
+            "queue_depth": st.queue_depth,
+            "queue_cap": st.queue_capacity,
+            "breaker": st.breaker_state,
+            "ok": st.counts["ok"],
+        }
+        for key in ("degraded", "quarantined", "deadline_exceeded",
+                    "failed"):
+            if st.counts[key]:
+                out[key] = st.counts[key]
+        rejected = (st.counts["rejected_queue"]
+                    + st.counts["rejected_invalid"]
+                    + st.counts["rejected_closed"])
+        if rejected:
+            out["rejected"] = rejected
+        if st.breaker_trips:
+            out["breaker_trips"] = st.breaker_trips
+    if lat.get("p99") is not None:
+        out["p99_ms"] = lat["p99"]
+    return out
+
+
+# -- schema validation ------------------------------------------------------
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(f"serving section: {msg}")
+
+
+def validate_serving(sv: Dict[str, Any]) -> None:
+    """Structural validation of a record's ``serving`` section
+    (``export.validate_run_record`` dispatches here). Load-bearing rules:
+
+    * accounting — ``requests.submitted == sum(outcome counters)``; a
+      record that lost a request is rejected;
+    * latency sanity — ``0 <= p50 <= p99 <= max`` whenever latencies
+      were measured;
+    * evidence coupling — degraded responses require a tripped breaker,
+      quarantined responses require drift-flagged batches, queue
+      rejections require a bounded queue (capacity > 0).
+    """
+    _require(isinstance(sv, dict), "must be an object")
+    req = sv.get("requests")
+    _require(isinstance(req, dict), "requests must be an object")
+    sub = req.get("submitted")
+    _require(isinstance(sub, int) and sub >= 0,
+             "requests.submitted must be an int >= 0")
+    total = 0
+    for o in OUTCOMES:
+        v = req.get(o, 0)
+        _require(isinstance(v, int) and v >= 0,
+                 f"requests.{o} must be an int >= 0")
+        total += v
+    _require(
+        total == sub,
+        f"request accounting broken: submitted={sub} but outcomes sum to "
+        f"{total} — every request must end as exactly one of {OUTCOMES}",
+    )
+    lat = sv.get("latency_ms")
+    _require(isinstance(lat, dict), "latency_ms must be an object")
+    n = lat.get("n", 0)
+    _require(isinstance(n, int) and n >= 0,
+             "latency_ms.n must be an int >= 0")
+    if n > 0:
+        p50, p99, mx = lat.get("p50"), lat.get("p99"), lat.get("max")
+        for name, v in (("p50", p50), ("p99", p99), ("max", mx)):
+            _require(isinstance(v, (int, float)) and v >= 0,
+                     f"latency_ms.{name} must be a number >= 0")
+        _require(p50 <= p99 <= mx,
+                 f"latency ordering broken: p50={p50} p99={p99} max={mx}")
+    br = sv.get("breaker")
+    _require(isinstance(br, dict), "breaker must be an object")
+    _require(br.get("state") in BREAKER_STATES,
+             f"breaker.state must be one of {BREAKER_STATES}, "
+             f"got {br.get('state')!r}")
+    trips = br.get("trips", 0)
+    _require(isinstance(trips, int) and trips >= 0,
+             "breaker.trips must be an int >= 0")
+    if req.get("degraded", 0) > 0:
+        _require(
+            trips >= 1,
+            "degraded responses claimed with breaker.trips == 0 — the "
+            "host fallback only serves behind a tripped breaker",
+        )
+    drift = sv.get("drift") or {}
+    _require(isinstance(drift, dict), "drift must be an object")
+    if req.get("quarantined", 0) > 0:
+        _require(
+            int(drift.get("batches_flagged", 0)) >= 1
+            and int(drift.get("quarantine_entries", 0)) >= 1,
+            "quarantined responses claimed without drift evidence "
+            "(drift.batches_flagged / quarantine_entries)",
+        )
+    q = sv.get("queue") or {}
+    if req.get("rejected_queue", 0) > 0:
+        _require(
+            int(q.get("capacity", 0)) > 0,
+            "queue rejections claimed with no bounded queue "
+            "(queue.capacity must be > 0)",
+        )
+    tp = sv.get("throughput_rps")
+    if tp is not None:
+        _require(isinstance(tp, (int, float)) and tp >= 0,
+                 "throughput_rps must be a number >= 0")

@@ -1,0 +1,419 @@
+"""The frozen consensus-model artifact + the one-device-call classifier.
+
+A consensus model is everything ``classify(new_cells)`` needs to label a
+cell against a finished consensus run WITHOUT re-running DE + tree
+(ROADMAP item 4), persisted as ONE ArtifactStore stage so it rides the
+existing atomic-write + sha256-checksum + quarantine machinery:
+
+  * the DE-gene **panel** (the union the pipeline re-embedded on),
+  * the **PCA basis** (column mean + components) that projects panel
+    expression into the training embedding space (``ops.pca.pca_basis``),
+  * the **landmark centroids + occupancy-weighted dendrogram** from
+    ``ops/pooling`` — closing the ROADMAP item-1 follow-up: the landmark
+    artifacts ARE the frozen model's assignment structure,
+  * per-landmark **cluster labels** (occupancy-weighted majority vote),
+  * a **drift calibration**: quantiles of the training cells' distance to
+    their own landmark, from which the serving driver's quarantine gate
+    derives its "this batch no longer fits the model" threshold.
+
+Load goes through ``ArtifactStore.load`` — a corrupt artifact (failed
+checksum, truncated zip) is QUARANTINED by the store and surfaces here
+as a typed :class:`~scconsensus_tpu.serve.errors.ModelLoadError`; a
+wrong-schema or shape-incoherent artifact is refused the same way. The
+server never starts on a model it cannot prove intact.
+
+``classify`` is one jitted device call: gather panel columns → center →
+project → nearest landmark (``ops.distance._sq_dists_raw``) → label +
+distance. ``classify_host`` is the numpy mirror the driver's degraded
+mode serves from when the circuit breaker is open.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from scconsensus_tpu.serve.errors import ModelLoadError
+
+__all__ = [
+    "MODEL_STAGE",
+    "MODEL_SCHEMA",
+    "MODEL_VERSION",
+    "ConsensusModel",
+    "freeze_model_arrays",
+    "export_consensus_model",
+    "load_consensus_model",
+]
+
+MODEL_STAGE = "consensus_model"
+MODEL_SCHEMA = "scc-consensus-model"
+MODEL_VERSION = 1
+
+# Calibration quantiles of the training nearest-landmark distance
+# (q50/q90/q99/max); the drift threshold is q99 × margin.
+_CALIB_QS = (0.50, 0.90, 0.99, 1.0)
+
+
+@dataclasses.dataclass
+class ConsensusModel:
+    """In-memory frozen model. Arrays are host numpy; ``device_buffers``
+    uploads once and memoizes so every batch classify is one dispatch."""
+
+    panel_idx: np.ndarray          # (F,) int64 gene rows of the DE union
+    pca_mean: np.ndarray           # (F,) float32
+    pca_components: np.ndarray     # (n_pcs, F) float32
+    centroids: np.ndarray          # (k, n_pcs) float32 landmark centroids
+    centroid_labels: np.ndarray    # (k,) int64 cluster label per landmark
+    centroid_counts: np.ndarray    # (k,) int64 training occupancy
+    tree_merge: np.ndarray         # landmark dendrogram (ops.linkage shape)
+    tree_height: np.ndarray
+    tree_order: np.ndarray
+    calib_q: np.ndarray            # (len(_CALIB_QS),) distance quantiles
+    drift_threshold: float         # distance beyond which a cell is foreign
+    meta: Dict[str, Any]
+    _dev: Optional[tuple] = dataclasses.field(default=None, repr=False)
+
+    # -- derived -----------------------------------------------------------
+    @property
+    def n_genes(self) -> int:
+        return int(self.meta["n_genes"])
+
+    @property
+    def n_pcs(self) -> int:
+        return int(self.pca_components.shape[0])
+
+    @property
+    def k(self) -> int:
+        return int(self.centroids.shape[0])
+
+    def fingerprint(self) -> str:
+        """Short content hash of the decision surface (panel + basis +
+        centroids + labels): two servers answering from the same
+        fingerprint answer identically — the kill-and-restart durability
+        test pins this."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for a in (self.panel_idx, self.pca_mean, self.pca_components,
+                  self.centroids, self.centroid_labels):
+            h.update(np.ascontiguousarray(a).tobytes())
+        return h.hexdigest()[:16]
+
+    # -- classify ----------------------------------------------------------
+    def _gather_panel(self, cells: np.ndarray) -> np.ndarray:
+        x = np.asarray(cells, np.float32)
+        if x.ndim != 2 or x.shape[1] != self.n_genes:
+            raise ValueError(
+                f"cells must be (n, {self.n_genes}) genes-length rows, "
+                f"got {x.shape}"
+            )
+        return x[:, self.panel_idx]
+
+    def device_buffers(self) -> tuple:
+        if self._dev is None:
+            import jax.numpy as jnp
+
+            self._dev = (
+                jnp.asarray(self.pca_mean, jnp.float32),
+                jnp.asarray(self.pca_components, jnp.float32),
+                jnp.asarray(self.centroids, jnp.float32),
+                jnp.asarray(self.centroid_labels, jnp.int32),
+            )
+        return self._dev
+
+    def classify(self, cells: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        """Project + assign ``cells`` (n, G) in ONE device call. Returns
+        ``(labels (n,) int64, dist (n,) float64)`` where ``dist`` is the
+        euclidean distance to the winning landmark (the drift gate's
+        signal)."""
+        import jax
+
+        xp = self._gather_panel(cells)
+        mean, comps, cents, clab = self.device_buffers()
+        lab, dist = _classify_kernel(
+            jax.numpy.asarray(xp), mean, comps, cents, clab
+        )
+        lab, dist = jax.device_get((lab, dist))
+        return np.asarray(lab, np.int64), np.asarray(dist, np.float64)
+
+    def classify_host(self, cells: np.ndarray
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """Numpy mirror of :meth:`classify` — the degraded-mode fallback
+        when the device path is broken. Same math, same labels on
+        well-separated data (ties may break differently at float32 vs
+        float64 margins; degraded responses are flagged, never silent)."""
+        xp = self._gather_panel(cells).astype(np.float64)
+        proj = (xp - self.pca_mean.astype(np.float64)) @ \
+            self.pca_components.astype(np.float64).T
+        c = self.centroids.astype(np.float64)
+        d2 = (
+            np.sum(proj * proj, axis=1, keepdims=True)
+            - 2.0 * proj @ c.T
+            + np.sum(c * c, axis=1)[None, :]
+        )
+        j = np.argmin(d2, axis=1)
+        dist = np.sqrt(np.maximum(d2[np.arange(j.size), j], 0.0))
+        return self.centroid_labels[j].astype(np.int64), dist
+
+    def drift_fraction(self, dist: np.ndarray) -> float:
+        """Share of a batch past the calibrated foreign-cell threshold."""
+        d = np.asarray(dist, np.float64)
+        if d.size == 0:
+            return 0.0
+        return float((d > self.drift_threshold).mean())
+
+
+_KERNEL = None  # built on first use so the bare module import stays jax-free
+
+
+def _classify_kernel(x, mean, comps, cents, cent_labels):
+    global _KERNEL
+    if _KERNEL is None:
+        import jax
+        import jax.numpy as jnp
+
+        from scconsensus_tpu.ops.distance import _sq_dists_raw
+
+        @jax.jit
+        def _run(x, mean, comps, cents, cent_labels):
+            proj = (x - mean[None, :]) @ comps.T
+            d2 = _sq_dists_raw(proj, cents)
+            j = jnp.argmin(d2, axis=1)
+            d = jnp.sqrt(jnp.maximum(
+                jnp.take_along_axis(d2, j[:, None], axis=1)[:, 0], 0.0
+            ))
+            return cent_labels[j], d
+
+        _KERNEL = _run
+    return _KERNEL(x, mean, comps, cents, cent_labels)
+
+
+# --------------------------------------------------------------------------
+# export
+# --------------------------------------------------------------------------
+
+def freeze_model_arrays(
+    panel_idx: np.ndarray,
+    pca_mean: np.ndarray,
+    pca_components: np.ndarray,
+    emb: np.ndarray,
+    centroids: np.ndarray,
+    assign: np.ndarray,
+    cell_labels: np.ndarray,
+    tree,
+    n_genes: int,
+    drift_margin: float,
+    meta_extra: Optional[Dict[str, Any]] = None,
+) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """The ONE arrays+meta assembly behind every consensus-model writer
+    (``export_consensus_model`` and the soak's demo builder): majority
+    landmark labels, occupancy counts, drift calibration, schema stamp.
+    Shared so the artifact schema cannot drift between the real export
+    path and the chaos worker's model."""
+    from scconsensus_tpu.ops.pooling import centroid_majority_labels
+
+    k = int(centroids.shape[0])
+    counts = np.bincount(assign, minlength=k).astype(np.int64)
+    cent_labels = centroid_majority_labels(assign, cell_labels, k)
+    d = np.linalg.norm(emb.astype(np.float64) - centroids[assign], axis=1)
+    calib_q = (np.quantile(d, _CALIB_QS) if d.size
+               else np.zeros(len(_CALIB_QS)))
+    drift_threshold = float(calib_q[_CALIB_QS.index(0.99)] * drift_margin)
+    meta: Dict[str, Any] = {
+        "schema": MODEL_SCHEMA,
+        "version": MODEL_VERSION,
+        "created_unix": round(time.time(), 3),
+        "n_cells": int(emb.shape[0]),
+        "n_genes": int(n_genes),
+        "n_pcs": int(pca_components.shape[0]),
+        "k": k,
+        "drift_margin": float(drift_margin),
+        "drift_threshold": drift_threshold,
+        "label_values": sorted(int(v) for v in np.unique(cent_labels)),
+    }
+    meta.update(meta_extra or {})
+    arrays = {
+        "panel_idx": np.asarray(panel_idx, np.int64),
+        "pca_mean": np.asarray(pca_mean, np.float32),
+        "pca_components": np.asarray(pca_components, np.float32),
+        "centroids": np.asarray(centroids, np.float32),
+        "centroid_labels": cent_labels,
+        "centroid_counts": counts,
+        "tree_merge": np.asarray(tree.merge),
+        "tree_height": np.asarray(tree.height),
+        "tree_order": np.asarray(tree.order),
+        "calib_q": np.asarray(calib_q, np.float64),
+    }
+    return arrays, meta
+
+
+def export_consensus_model(
+    data,
+    result,
+    config,
+    model_dir: str,
+    deep_split: Optional[int] = None,
+    n_landmarks: Optional[int] = None,
+    drift_margin: Optional[float] = None,
+    seed: Optional[int] = None,
+) -> ConsensusModel:
+    """Freeze a finished refinement into a servable consensus model.
+
+    ``data`` is the training (G, N) matrix the pipeline ran on;
+    ``result`` its :class:`~scconsensus_tpu.models.pipeline
+    .ReclusterResult``; ``deep_split`` picks which cut's labels the model
+    serves (default: the deepest configured). The PCA basis is re-derived
+    with ``ops.pca.pca_basis`` (same algorithm + seed as the pipeline's
+    ``pca_scores``) and the landmark structure with
+    ``ops.pooling.landmark_ward_linkage`` over the basis-consistent
+    embedding, so model-internal geometry is exactly self-consistent:
+    a training cell replayed through ``classify`` lands on the landmark
+    it was calibrated against.
+    """
+    import jax.numpy as jnp
+
+    from scconsensus_tpu.config import env_flag
+    from scconsensus_tpu.io.sparsemat import rows_dense
+    from scconsensus_tpu.ops.pca import pca_basis
+    from scconsensus_tpu.ops.pooling import landmark_ward_linkage
+    from scconsensus_tpu.utils.artifacts import (
+        ArtifactStore,
+        config_fingerprint,
+    )
+
+    ds = int(deep_split if deep_split is not None
+             else config.deep_split_values[-1])
+    key = f"deepsplit: {ds}"
+    if key not in result.dynamic_labels:
+        raise ValueError(
+            f"result has no cut for deep_split={ds} "
+            f"(available: {sorted(result.dynamic_labels)})"
+        )
+    labels = np.asarray(result.dynamic_labels[key], np.int64)
+    panel = np.asarray(result.de_gene_union_idx, np.int64)
+    n_pcs = int(result.embedding.shape[1])
+    margin = float(drift_margin if drift_margin is not None
+                   else env_flag("SCC_SERVE_DRIFT_MARGIN"))
+
+    cells = rows_dense(data, panel).T            # (N, F), host or device
+    mean, comps = pca_basis(jnp.asarray(cells, jnp.float32), n_pcs)
+    mean = np.asarray(mean, np.float32)
+    comps = np.asarray(comps, np.float32)
+    emb = (np.asarray(cells, np.float32) - mean) @ comps.T
+
+    tree, assign, cents, info = landmark_ward_linkage(
+        emb,
+        n_landmarks=n_landmarks,
+        seed=int(seed if seed is not None else config.random_seed),
+    )
+    arrays, meta = freeze_model_arrays(
+        panel, mean, comps, emb, cents, assign, labels, tree,
+        n_genes=int(data.shape[0]), drift_margin=margin,
+        meta_extra={
+            "deep_split": ds,
+            "landmark_info": {kk: vv for kk, vv in info.items()
+                              if isinstance(vv, (int, float, str))},
+            "config_fp": config_fingerprint(json.loads(config.to_json())),
+        },
+    )
+    ArtifactStore(model_dir).save(MODEL_STAGE, arrays, meta)
+    return _assemble(arrays, meta)
+
+
+# --------------------------------------------------------------------------
+# load (the sha256/quarantine path + schema refusal)
+# --------------------------------------------------------------------------
+
+_REQUIRED_ARRAYS = (
+    "panel_idx", "pca_mean", "pca_components", "centroids",
+    "centroid_labels", "centroid_counts", "tree_merge", "tree_height",
+    "tree_order", "calib_q",
+)
+
+
+def _assemble(arrays: Dict[str, np.ndarray],
+              meta: Dict[str, Any]) -> ConsensusModel:
+    return ConsensusModel(
+        panel_idx=np.asarray(arrays["panel_idx"], np.int64),
+        pca_mean=np.asarray(arrays["pca_mean"], np.float32),
+        pca_components=np.asarray(arrays["pca_components"], np.float32),
+        centroids=np.asarray(arrays["centroids"], np.float32),
+        centroid_labels=np.asarray(arrays["centroid_labels"], np.int64),
+        centroid_counts=np.asarray(arrays["centroid_counts"], np.int64),
+        tree_merge=arrays["tree_merge"],
+        tree_height=arrays["tree_height"],
+        tree_order=arrays["tree_order"],
+        calib_q=np.asarray(arrays["calib_q"], np.float64),
+        drift_threshold=float(meta["drift_threshold"]),
+        meta={k: v for k, v in meta.items() if k != "_integrity"},
+    )
+
+
+def load_consensus_model(model_dir: str,
+                         readonly: bool = False) -> ConsensusModel:
+    """Load a frozen consensus model, or refuse with a typed error.
+
+    Refusal paths (all :class:`ModelLoadError`, never a served model):
+    missing artifact; failed sha256 / unparseable npz (the store has
+    QUARANTINED the files — ``quarantined=True``); wrong schema name or
+    version; incoherent shapes. ``robust.faults`` site ``serve_load``
+    fires here, so chaos plans can break the load the same way they
+    break pipeline stages."""
+    from scconsensus_tpu.robust import faults
+    from scconsensus_tpu.utils.artifacts import ArtifactCorrupt, ArtifactStore
+
+    faults.fault_point("serve_load")
+    store = ArtifactStore(model_dir, readonly=readonly)
+    if not store.has(MODEL_STAGE):
+        raise ModelLoadError(
+            f"no consensus model artifact at {model_dir!r} "
+            f"(expected {MODEL_STAGE}.npz)"
+        )
+    try:
+        arrays, meta = store.load(MODEL_STAGE)
+    except ArtifactCorrupt as e:
+        if readonly:
+            # the readonly store refuses WITHOUT renaming: say so, and
+            # don't claim a quarantine that never happened
+            raise ModelLoadError(
+                f"consensus model at {model_dir!r} failed verification; "
+                f"readonly store — files left in place, load refused: "
+                f"{e}", quarantined=False,
+            ) from e
+        raise ModelLoadError(
+            f"consensus model at {model_dir!r} failed verification and "
+            f"was quarantined: {e}", quarantined=True,
+        ) from e
+    if meta.get("schema") != MODEL_SCHEMA:
+        raise ModelLoadError(
+            f"artifact at {model_dir!r} is not a consensus model "
+            f"(schema={meta.get('schema')!r}, want {MODEL_SCHEMA!r})"
+        )
+    if meta.get("version") != MODEL_VERSION:
+        raise ModelLoadError(
+            f"consensus model version {meta.get('version')!r} unsupported "
+            f"(this build knows version {MODEL_VERSION})"
+        )
+    missing = [a for a in _REQUIRED_ARRAYS if a not in arrays]
+    if missing:
+        raise ModelLoadError(
+            f"consensus model at {model_dir!r} missing arrays: {missing}"
+        )
+    model = _assemble(arrays, meta)
+    f = model.pca_components.shape[1]
+    if (model.panel_idx.shape[0] != f
+            or model.pca_mean.shape[0] != f
+            or model.centroids.shape[1] != model.pca_components.shape[0]
+            or model.centroid_labels.shape[0] != model.centroids.shape[0]):
+        raise ModelLoadError(
+            f"consensus model at {model_dir!r} has incoherent shapes "
+            f"(panel {model.panel_idx.shape}, mean {model.pca_mean.shape}, "
+            f"components {model.pca_components.shape}, "
+            f"centroids {model.centroids.shape})"
+        )
+    return model

@@ -91,9 +91,16 @@ def config_fingerprint(obj: Any, n_hex: int = 12) -> str:
 
 
 class ArtifactStore:
-    def __init__(self, root: Optional[str]):
+    def __init__(self, root: Optional[str], readonly: bool = False):
+        """``readonly=True`` opens the store without touching the
+        filesystem (no mkdir, no stale-temp sweep): the serving path's
+        contract for a FROZEN model directory that may live on a
+        read-only mount. A readonly store refuses ``save`` and, on a
+        failed checksum, raises without quarantine-renaming the files
+        (it still never loads them)."""
         self.root = root
-        if root is not None:
+        self.readonly = bool(readonly)
+        if root is not None and not self.readonly:
             os.makedirs(root, exist_ok=True)
             self._sweep_stale_tmp()
 
@@ -213,6 +220,11 @@ class ArtifactStore:
         """
         if not self.enabled:
             return
+        if self.readonly:
+            raise RuntimeError(
+                f"artifact store {self.root!r} is readonly — a frozen "
+                "model directory is never written by the serving path"
+            )
         from scconsensus_tpu.robust import faults as _faults
         from scconsensus_tpu.robust import record as _robust_record
 
@@ -269,6 +281,18 @@ class ArtifactStore:
         from scconsensus_tpu.robust import record as _robust_record
         from scconsensus_tpu.utils.logging import get_logger
 
+        if self.readonly:
+            # refuse-without-rename: the load still raises ArtifactCorrupt
+            # (nothing gets served), but a read-only mount's files stay
+            # exactly where the operator put them
+            _robust_record.note_degradation(
+                f"artifact:{stage}", "quarantine", reason + " (readonly)"
+            )
+            get_logger().warning(
+                "artifact %r failed verification (%s); store is readonly, "
+                "files left in place and load refused", stage, reason,
+            )
+            return
         for path in self._paths(stage):
             if not os.path.exists(path):
                 continue

@@ -60,8 +60,11 @@ APPROVED = {
     "ops/pallas_kernels.py": {"np.asarray(": 6},
     # r7 landmark engine: +5 inside the landmark_assign_fetch boundary —
     # jnp staging of the embedding/sketch/init gathers (3) and the two
-    # intended d2h fetches ((k, d) centroids + (N,) assignment)
-    "ops/pooling.py": {"np.asarray(": 9},
+    # intended d2h fetches ((k, d) centroids + (N,) assignment).
+    # r15 serving: +2 host-only int conversions in
+    # centroid_majority_labels (assign/labels vote tally — no device
+    # arrays in scope)
+    "ops/pooling.py": {"np.asarray(": 11},
     "ops/silhouette.py": {"np.asarray(": 7},
     # r7 weighted cuts: +2 host-only conversions of the per-leaf weight
     # vector (treecut is a host algorithm; no device arrays in scope)

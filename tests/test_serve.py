@@ -1,0 +1,807 @@
+"""Guarded serving (round 15): frozen consensus-model artifact, the
+one-device-call classify, and the fault-tolerant micro-batching driver.
+
+The serving contract under test: a corrupt model is refused typed and
+quarantined, never served; every submitted request ends as exactly one
+typed outcome (success / flagged degraded / typed rejection / quarantine
+entry) and the validated ``serving`` section accounts for all of them; a
+SIGKILLed server restarted over the same frozen model replays a request
+set to IDENTICAL labels; and the whole guarded path adds <2% latency
+over a bare ``classify()`` when nothing is failing.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from scconsensus_tpu.config import ReclusterConfig
+from scconsensus_tpu.robust import faults, record as robust_record
+from scconsensus_tpu.serve.driver import (
+    CircuitBreaker,
+    ConsensusServer,
+    ServeConfig,
+)
+from scconsensus_tpu.serve.errors import (
+    DeadlineExceeded,
+    ModelLoadError,
+    QueueFull,
+    RequestInvalid,
+    ServerClosed,
+)
+from scconsensus_tpu.serve.metrics import ServingStats, validate_serving
+from scconsensus_tpu.serve.model import (
+    MODEL_STAGE,
+    export_consensus_model,
+    load_consensus_model,
+)
+from scconsensus_tpu.serve.soak import (
+    build_demo_model,
+    make_requests,
+    run_soak,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state(monkeypatch):
+    monkeypatch.delenv("SCC_FAULT_PLAN", raising=False)
+    faults.reset()
+    robust_record.begin_run()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("serve-model"))
+    build_demo_model(d, seed=7)
+    return d
+
+
+@pytest.fixture(scope="module")
+def model(model_dir):
+    return load_consensus_model(model_dir)
+
+
+def _fast_cfg(**kw):
+    base = dict(
+        max_batch_cells=256, queue_capacity=32, batch_window_s=0.001,
+        default_deadline_s=10.0, breaker_threshold=3,
+        breaker_cooldown_s=0.2, drift_quarantine_frac=0.5,
+    )
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+# --------------------------------------------------------------------------
+# frozen model artifact
+# --------------------------------------------------------------------------
+
+class TestModelArtifact:
+    def test_round_trip_preserves_decision_surface(self, model_dir, model):
+        m2 = load_consensus_model(model_dir)
+        assert m2.fingerprint() == model.fingerprint()
+        assert m2.k == model.k
+        np.testing.assert_array_equal(m2.centroid_labels,
+                                      model.centroid_labels)
+        # the dendrogram rides the artifact (ROADMAP item-1 follow-up:
+        # the landmark tree IS part of the frozen model)
+        assert m2.tree_merge.shape[0] == model.k - 1
+
+    def test_device_and_host_classify_agree(self, model):
+        reqs = make_requests(4, 12, 7)
+        for x in reqs:
+            lab_d, dist_d = model.classify(x)
+            lab_h, dist_h = model.classify_host(x)
+            np.testing.assert_array_equal(lab_d, lab_h)
+            # distances: device math is float32, host mirror float64 —
+            # identical labels, distances equal to float32 precision
+            np.testing.assert_allclose(dist_d, dist_h, rtol=1e-3,
+                                       atol=1e-3)
+            assert set(np.unique(lab_d)) <= set(
+                model.meta["label_values"]) | {0}
+
+    def test_export_from_pipeline_result(self, tmp_path):
+        from scconsensus_tpu.models.pipeline import refine
+        from scconsensus_tpu.utils.synthetic import (
+            noisy_labeling,
+            synthetic_scrna,
+        )
+
+        data, truth, _ = synthetic_scrna(
+            n_genes=60, n_cells=150, n_clusters=3,
+            n_markers_per_cluster=8, seed=11,
+        )
+        labels = noisy_labeling(truth, 0.05, seed=2)
+        result = refine(data, labels,
+                        ReclusterConfig(deep_split_values=(1, 2)),
+                        mesh=None)
+        m = export_consensus_model(
+            data, result, ReclusterConfig(deep_split_values=(1, 2)),
+            str(tmp_path / "model"), n_landmarks=64,
+        )
+        assert m.n_genes == 60
+        assert m.panel_idx.shape[0] == result.de_gene_union_idx.shape[0]
+        # training cells replayed through the frozen model land on the
+        # training cut's clusters (self-consistency of panel+basis+
+        # landmarks): ARI vs the served cut must be high
+        from scconsensus_tpu.obs.regress import adjusted_rand_index
+
+        served, _ = load_consensus_model(
+            str(tmp_path / "model")
+        ).classify(np.asarray(data.T, np.float32))
+        ref = result.dynamic_labels["deepsplit: 2"]
+        mask = (ref > 0) & (served > 0)
+        assert adjusted_rand_index(served[mask], ref[mask]) > 0.8
+
+    def test_pca_basis_reproduces_pca_scores_exactly(self):
+        import jax.numpy as jnp
+
+        from scconsensus_tpu.ops.pca import pca_basis, pca_scores
+
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.normal(size=(80, 40)).astype(np.float32))
+        scores = np.asarray(pca_scores(x, 8))
+        mean, comps = pca_basis(x, 8)
+        rebuilt = (np.asarray(x) - np.asarray(mean)) @ np.asarray(comps).T
+        # one shared subspace body: the serving projection must
+        # reproduce the pipeline embedding to float precision
+        np.testing.assert_allclose(rebuilt, scores, rtol=1e-5, atol=1e-5)
+
+    def test_missing_model_is_typed(self, tmp_path):
+        with pytest.raises(ModelLoadError, match="no consensus model"):
+            load_consensus_model(str(tmp_path / "empty"))
+
+    def test_corrupt_model_quarantined_and_refused(self, tmp_path):
+        d = str(tmp_path / "model")
+        build_demo_model(d, seed=3)
+        npz = os.path.join(d, f"{MODEL_STAGE}.npz")
+        size = os.path.getsize(npz)
+        with open(npz, "r+b") as f:  # bit-flip mid-file
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(ModelLoadError) as ei:
+            load_consensus_model(d)
+        assert ei.value.quarantined
+        # the store moved the files aside: nothing loadable remains, and
+        # the quarantined copies survive as post-mortems
+        assert not os.path.exists(npz)
+        assert any(n.startswith(f"{MODEL_STAGE}.npz.quarantined")
+                   for n in os.listdir(d))
+        # a server constructed on this dir refuses to start
+        with pytest.raises(ModelLoadError):
+            ConsensusServer(d, _fast_cfg())
+
+    def test_wrong_schema_refused(self, tmp_path):
+        from scconsensus_tpu.utils.artifacts import ArtifactStore
+
+        d = str(tmp_path / "model")
+        ArtifactStore(d).save(MODEL_STAGE,
+                              {"panel_idx": np.arange(3)},
+                              {"schema": "something-else", "version": 1})
+        with pytest.raises(ModelLoadError, match="not a consensus model"):
+            load_consensus_model(d)
+
+    def test_corrupt_plan_at_export_refused_at_load(self, tmp_path,
+                                                    monkeypatch):
+        # the chaos path: artifact:consensus_model corrupt rule fires on
+        # the save, the checksum catches it on the load
+        plan = tmp_path / "plan.json"
+        plan.write_text(json.dumps({"faults": [
+            {"site": "artifact:consensus_model", "class": "corrupt"}
+        ]}))
+        monkeypatch.setenv("SCC_FAULT_PLAN", str(plan))
+        faults.reset()
+        d = str(tmp_path / "model")
+        build_demo_model(d, seed=5)
+        monkeypatch.delenv("SCC_FAULT_PLAN")
+        faults.reset()
+        with pytest.raises(ModelLoadError) as ei:
+            load_consensus_model(d)
+        assert ei.value.quarantined
+
+    def test_readonly_store_refuses_save_and_leaves_corrupt_in_place(
+            self, tmp_path):
+        from scconsensus_tpu.utils.artifacts import (
+            ArtifactCorrupt,
+            ArtifactStore,
+        )
+
+        d = str(tmp_path / "model")
+        build_demo_model(d, seed=3)
+        npz = os.path.join(d, f"{MODEL_STAGE}.npz")
+        with open(npz, "r+b") as f:
+            f.truncate(os.path.getsize(npz) // 2)
+        ro = ArtifactStore(d, readonly=True)
+        with pytest.raises(RuntimeError, match="readonly"):
+            ro.save("x", {"a": np.zeros(1)})
+        with pytest.raises(ArtifactCorrupt):
+            ro.load(MODEL_STAGE)
+        assert os.path.exists(npz)  # refused but NOT renamed
+
+
+# --------------------------------------------------------------------------
+# driver: batching, deadlines, backpressure
+# --------------------------------------------------------------------------
+
+class TestDriver:
+    def test_responses_match_bare_classify(self, model):
+        reqs = make_requests(6, 10, 7)
+        with ConsensusServer(model, _fast_cfg()) as srv:
+            for x in reqs:
+                resp = srv.classify(x, timeout=30.0)
+                assert resp.outcome == "ok"
+                assert not resp.degraded
+                lab, _ = model.classify(x)
+                np.testing.assert_array_equal(resp.labels, lab)
+        sec = srv.serving_section()
+        validate_serving(sec)
+        assert sec["requests"]["submitted"] == 6
+        assert sec["requests"]["ok"] == 6
+
+    def test_concurrent_submits_coalesce_into_batches(self, model):
+        reqs = make_requests(12, 8, 7)
+        cfg = _fast_cfg(batch_window_s=0.05)
+        with ConsensusServer(model, cfg) as srv:
+            handles = [srv.submit(x) for x in reqs]
+            responses = [h.result(timeout=30.0) for h in handles]
+        assert all(r.outcome == "ok" for r in responses)
+        sec = srv.serving_section()
+        validate_serving(sec)
+        # micro-batching actually batched: fewer dispatches than requests
+        assert sec["batches"]["count"] < 12
+        assert sec["batches"]["max_cells"] > 8
+
+    def test_deadline_exceeded_is_typed_and_accounted(self, model,
+                                                      monkeypatch):
+        plan_stall = {"faults": [
+            {"site": "serve_batch", "class": "stall", "stall_s": 0.4}
+        ]}
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump(plan_stall, f)
+        monkeypatch.setenv("SCC_FAULT_PLAN", f.name)
+        faults.reset()
+        with ConsensusServer(model, _fast_cfg()) as srv:
+            h = srv.submit(make_requests(1, 8, 7)[0], deadline_s=0.1)
+            with pytest.raises(DeadlineExceeded) as ei:
+                h.result(timeout=30.0)
+            assert ei.value.late_by_s > 0
+        sec = srv.serving_section()
+        validate_serving(sec)
+        assert sec["requests"]["deadline_exceeded"] == 1
+
+    def test_queue_full_backpressure_with_retry_after(self, model,
+                                                      monkeypatch):
+        # stall the worker so the queue backs up deterministically
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump({"faults": [
+                {"site": "serve_batch", "class": "stall",
+                 "stall_s": 0.5, "times": 4}
+            ]}, f)
+        monkeypatch.setenv("SCC_FAULT_PLAN", f.name)
+        faults.reset()
+        cfg = _fast_cfg(queue_capacity=4, default_deadline_s=30.0)
+        reqs = make_requests(12, 4, 7)
+        with ConsensusServer(model, cfg) as srv:
+            handles, rejected = [], 0
+            retry_after = None
+            for x in reqs:
+                try:
+                    handles.append(srv.submit(x))
+                except QueueFull as e:
+                    rejected += 1
+                    retry_after = e.retry_after_s
+            assert rejected > 0, "queue never filled"
+            assert retry_after is not None and retry_after > 0
+            for h in handles:
+                h.result(timeout=60.0)
+        sec = srv.serving_section()
+        validate_serving(sec)
+        assert sec["requests"]["rejected_queue"] == rejected
+        assert sec["queue"]["depth_peak"] <= cfg.queue_capacity
+
+    def test_invalid_requests_rejected_typed(self, model):
+        with ConsensusServer(model, _fast_cfg()) as srv:
+            with pytest.raises(RequestInvalid, match="genes"):
+                srv.submit(np.zeros((3, 7), np.float32))
+            with pytest.raises(RequestInvalid, match="max batch"):
+                srv.submit(np.zeros((100000, model.n_genes), np.float32))
+            # non-finite cells ride the batch (the free guard: NaN in →
+            # NaN distance out) and reject typed at resolution
+            bad = make_requests(1, 4, 7)[0].copy()
+            bad[0, 0] = np.nan
+            h = srv.submit(bad)
+            with pytest.raises(RequestInvalid, match="non-finite"):
+                h.result(timeout=30.0)
+        sec = srv.serving_section()
+        validate_serving(sec)
+        assert sec["requests"]["rejected_invalid"] == 3
+
+    def test_submit_after_stop_is_typed(self, model):
+        srv = ConsensusServer(model, _fast_cfg()).start()
+        srv.stop()
+        with pytest.raises(ServerClosed):
+            srv.submit(make_requests(1, 4, 7)[0])
+
+    def test_stop_without_drain_refuses_backlog_typed(self, model,
+                                                      monkeypatch):
+        # stall the worker so a backlog builds, then stop(drain=False):
+        # the queued requests must resolve as typed ServerClosed (and be
+        # accounted), not be served after shutdown
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump({"faults": [
+                {"site": "serve_batch", "class": "stall",
+                 "stall_s": 0.3, "times": 6}
+            ]}, f)
+        monkeypatch.setenv("SCC_FAULT_PLAN", f.name)
+        faults.reset()
+        # one request per batch (requests fill max_batch), so a backlog
+        # actually exists in the queue when stop() lands
+        srv = ConsensusServer(model, _fast_cfg(max_batch_cells=16)).start()
+        handles = [srv.submit(x) for x in make_requests(6, 16, 7)]
+        time.sleep(0.05)  # worker is inside the stalled first batch
+        srv.stop(drain=False)
+        outcomes = []
+        for h in handles:
+            try:
+                outcomes.append(h.result(timeout=10.0).outcome)
+            except ServerClosed:
+                outcomes.append("closed")
+        assert "closed" in outcomes  # the backlog was refused, not served
+        sec = srv.serving_section()
+        validate_serving(sec)  # ...and every request is accounted
+
+
+# --------------------------------------------------------------------------
+# circuit breaker + degraded mode
+# --------------------------------------------------------------------------
+
+class TestBreakerAndDegradedMode:
+    def test_transient_blip_recovers_in_batch_without_degrading(
+            self, model, monkeypatch):
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump({"faults": [
+                {"site": "serve_device", "class": "transient", "times": 2}
+            ]}, f)
+        monkeypatch.setenv("SCC_FAULT_PLAN", f.name)
+        faults.reset()
+        with ConsensusServer(model, _fast_cfg()) as srv:
+            resp = srv.classify(make_requests(1, 8, 7)[0], timeout=30.0)
+        assert resp.outcome == "ok" and not resp.degraded
+        sec = srv.serving_section()
+        validate_serving(sec)
+        assert sec["breaker"]["trips"] == 0
+
+    def test_persistent_device_failure_trips_breaker_serves_degraded(
+            self, model, monkeypatch):
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump({"faults": [
+                {"site": "serve_device", "class": "oom", "times": 50}
+            ]}, f)
+        monkeypatch.setenv("SCC_FAULT_PLAN", f.name)
+        faults.reset()
+        cfg = _fast_cfg(breaker_cooldown_s=60.0)  # stays open once open
+        reqs = make_requests(5, 8, 7)
+        with ConsensusServer(model, cfg) as srv:
+            responses = [srv.classify(x, timeout=30.0) for x in reqs]
+        # every response served (host fallback), every one FLAGGED
+        assert all(r.outcome == "degraded" and r.degraded
+                   for r in responses)
+        # labels still correct — host math mirrors the device kernel
+        for x, r in zip(reqs, responses):
+            np.testing.assert_array_equal(r.labels,
+                                          model.classify_host(x)[0])
+        sec = srv.serving_section()
+        validate_serving(sec)
+        assert sec["breaker"]["state"] == "open"
+        assert sec["breaker"]["trips"] >= 1
+        assert sec["requests"]["degraded"] == 5
+
+    def test_breaker_half_open_probe_recloses_after_recovery(
+            self, model, monkeypatch):
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump({"faults": [
+                {"site": "serve_device", "class": "oom", "times": 3}
+            ]}, f)
+        monkeypatch.setenv("SCC_FAULT_PLAN", f.name)
+        faults.reset()
+        cfg = _fast_cfg(breaker_cooldown_s=0.05)
+        with ConsensusServer(model, cfg) as srv:
+            r1 = srv.classify(make_requests(1, 8, 7)[0], timeout=30.0)
+            assert r1.degraded  # 3 failures tripped it, batch 1 degraded
+            time.sleep(0.1)     # cooldown elapses; plan is exhausted
+            r2 = srv.classify(make_requests(1, 8, 7)[0], timeout=30.0)
+            assert r2.outcome == "ok" and not r2.degraded
+        sec = srv.serving_section()
+        validate_serving(sec)
+        assert sec["breaker"]["state"] == "closed"
+        assert sec["breaker"]["trips"] >= 1
+
+    def test_breaker_unit_transitions(self):
+        stats = ServingStats()
+        br = CircuitBreaker(threshold=2, cooldown_s=10.0, stats=stats)
+        assert br.route(now=0.0) == "device"
+        br.record_failure("transient", now=0.0)
+        assert br.state == "closed"  # below threshold
+        br.record_failure("resource", now=0.0)
+        assert br.state == "open" and br.trips == 1
+        assert br.route(now=1.0) == "fallback"      # inside cooldown
+        assert br.route(now=11.0) == "device"       # half-open probe
+        assert br.state == "half_open"
+        br.record_failure("transient", now=11.0)    # probe fails
+        assert br.state == "open" and br.trips == 2
+        assert br.route(now=22.0) == "device"
+        br.record_success()
+        assert br.state == "closed"
+
+
+# --------------------------------------------------------------------------
+# drift quarantine
+# --------------------------------------------------------------------------
+
+class TestDriftQuarantine:
+    def test_foreign_batch_quarantined_not_mislabeled(self, model,
+                                                      tmp_path):
+        qpath = str(tmp_path / "quarantine.jsonl")
+        cfg = _fast_cfg(quarantine_path=qpath)
+        ood = make_requests(3, 8, 7, n_ood=1)
+        with ConsensusServer(model, cfg) as srv:
+            ok_resp = srv.classify(ood[0], timeout=30.0)
+            q_resp = srv.classify(ood[-1], timeout=30.0)
+        assert ok_resp.outcome == "ok"
+        assert q_resp.outcome == "quarantined" and q_resp.quarantined
+        assert q_resp.labels is None  # refused, not confidently wrong
+        assert q_resp.drift_fraction >= 0.5
+        # the quarantine ledger carries the audit trail
+        with open(qpath) as f:
+            entries = [json.loads(ln) for ln in f if ln.strip()]
+        assert len(entries) == 1
+        e = entries[0]
+        assert e["n_cells"] == 8
+        assert e["drift_fraction"] >= 0.5
+        assert e["model_fp"] == model.fingerprint()
+        assert len(e["dist_q"]) == 4
+        sec = srv.serving_section()
+        validate_serving(sec)
+        assert sec["requests"]["quarantined"] == 1
+        assert sec["drift"]["quarantine_entries"] == 1
+
+    def test_drift_gate_disabled_by_fraction_above_one(self, model):
+        cfg = _fast_cfg(drift_quarantine_frac=2.0)
+        ood = make_requests(1, 8, 7, n_ood=1)
+        with ConsensusServer(model, cfg) as srv:
+            resp = srv.classify(ood[0], timeout=30.0)
+        assert resp.outcome == "ok"  # labeled despite drift: gate off
+
+
+# --------------------------------------------------------------------------
+# serving section schema
+# --------------------------------------------------------------------------
+
+class TestServingSchema:
+    def _clean(self):
+        st = ServingStats(queue_capacity=8)
+        st.note_submit(1)
+        st.note_outcome("ok", 0.005)
+        return st.section()
+
+    def test_clean_section_validates_and_rides_run_record(self):
+        from scconsensus_tpu.obs.export import (
+            build_run_record,
+            validate_run_record,
+        )
+
+        sec = self._clean()
+        validate_serving(sec)
+        rec = build_run_record(metric="serve test", value=1.0,
+                               unit="ms", serving=sec)
+        validate_run_record(rec)
+
+    def test_accounting_violation_rejected(self):
+        sec = self._clean()
+        sec["requests"]["submitted"] = 5  # outcomes sum to 1
+        with pytest.raises(ValueError, match="accounting"):
+            validate_serving(sec)
+
+    def test_degraded_without_trip_rejected(self):
+        sec = self._clean()
+        sec["requests"]["submitted"] = 2
+        sec["requests"]["degraded"] = 1
+        with pytest.raises(ValueError, match="tripped breaker"):
+            validate_serving(sec)
+
+    def test_quarantine_without_drift_evidence_rejected(self):
+        sec = self._clean()
+        sec["requests"]["submitted"] = 2
+        sec["requests"]["quarantined"] = 1
+        with pytest.raises(ValueError, match="drift evidence"):
+            validate_serving(sec)
+
+    def test_latency_ordering_enforced(self):
+        sec = self._clean()
+        sec["latency_ms"]["p50"] = 9.0
+        sec["latency_ms"]["p99"] = 5.0
+        with pytest.raises(ValueError, match="ordering"):
+            validate_serving(sec)
+
+    def test_queue_rejection_needs_bounded_queue(self):
+        sec = self._clean()
+        sec["requests"]["submitted"] = 2
+        sec["requests"]["rejected_queue"] = 1
+        sec["queue"]["capacity"] = 0
+        with pytest.raises(ValueError, match="bounded queue"):
+            validate_serving(sec)
+
+
+# --------------------------------------------------------------------------
+# kill-and-restart durability (subprocess, real SIGKILL)
+# --------------------------------------------------------------------------
+
+def _soak_worker(workdir, plan_path, n_requests=10):
+    env = dict(os.environ)
+    env.pop("SCC_FAULT_PLAN", None)
+    if plan_path:
+        env["SCC_FAULT_PLAN"] = plan_path
+    env["JAX_PLATFORMS"] = "cpu"
+    summary = os.path.join(workdir, "SOAK_SUMMARY.json")
+    try:
+        os.remove(summary)
+    except OSError:
+        pass
+    proc = subprocess.run(
+        [sys.executable, "-m", "scconsensus_tpu.serve.soak",
+         "--dir", workdir, "--requests", str(n_requests),
+         "--summary", summary],
+        env=env, capture_output=True, text=True, timeout=240, cwd=REPO,
+    )
+    try:
+        with open(summary) as f:
+            return proc.returncode, json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return proc.returncode, None
+
+
+class TestKillRestartDurability:
+    def test_sigkill_mid_batch_then_restart_identical_labels(
+            self, tmp_path):
+        workdir = str(tmp_path / "serve")
+        os.makedirs(workdir)
+        rc0, ref = _soak_worker(workdir, None)
+        assert rc0 == 0 and ref and ref["ok"], "reference run failed"
+        plan = tmp_path / "kill.json"
+        plan.write_text(json.dumps({"faults": [
+            {"site": "serve_batch", "class": "kill", "after": 1}
+        ]}))
+        rc1, dead = _soak_worker(workdir, str(plan))
+        assert rc1 != 0, "kill plan did not kill the worker"
+        assert dead is None, "a SIGKILLed worker cannot have summarized"
+        rc2, restart = _soak_worker(workdir, None)
+        assert rc2 == 0 and restart and restart["ok"]
+        # the restart LOADED the same frozen model (no rebuild) and the
+        # replayed request set produced byte-identical labels
+        assert restart["model_built"] is False
+        assert restart["model_fp"] == ref["model_fp"]
+        assert restart["labels_sha"] == ref["labels_sha"]
+        # the summary's record carries a validated serving section
+        from scconsensus_tpu.obs.export import validate_run_record
+
+        validate_run_record(restart["record"])
+
+
+# --------------------------------------------------------------------------
+# zero-fault overhead guard (<2%, r13/r14 pattern)
+# --------------------------------------------------------------------------
+
+def _production_shaped_model():
+    """Fabricated frozen model at serving scale (2000 genes, 1500-gene
+    panel, 32 PCs, 512 landmarks): the overhead guard must price the
+    guard layers against realistic per-batch device work, not against a
+    toy kernel whose dispatch cost IS the wall. Drift gate calibrated
+    unreachable — this model serves random data, the guard measures
+    machinery, not science."""
+    from scconsensus_tpu.serve.model import ConsensusModel
+
+    rng = np.random.default_rng(0)
+    G, F, P, K = 2000, 1500, 32, 512
+    return ConsensusModel(
+        panel_idx=np.sort(rng.choice(G, F, replace=False)).astype(
+            np.int64),
+        pca_mean=rng.normal(size=F).astype(np.float32),
+        pca_components=rng.normal(size=(P, F)).astype(np.float32),
+        centroids=rng.normal(size=(K, P)).astype(np.float32),
+        centroid_labels=rng.integers(1, 9, K).astype(np.int64),
+        centroid_counts=np.ones(K, np.int64),
+        tree_merge=np.zeros((K - 1, 2)), tree_height=np.zeros(K - 1),
+        tree_order=np.arange(K),
+        calib_q=np.array([1.0, 2.0, 3.0, 4.0]),
+        drift_threshold=float("inf"),
+        meta={"n_genes": G, "deep_split": 2},
+    ), G
+
+
+class TestOverheadGuard:
+    def test_guard_layers_under_two_percent_vs_bare_classify(self):
+        """r13/r14 guard pattern (best-of-3): the guard layers the
+        driver wraps around a bare ``classify()`` — admission checks,
+        fault points, breaker routing, deadline enforcement, drift
+        scoring, the free finiteness guard, per-request accounting and
+        span stamping — must add <2% over the classify call itself,
+        zero-fault and breaker-closed. Measured DIFFERENTIALLY on one
+        thread (the driver's own cumulative ``classify_wall_s`` vs the
+        wall of driving the full batch path): both sides of the ratio
+        come from the same executions, so box noise cancels instead of
+        flaking a 2% assertion on a contended 2-core CI host. The queue
+        handoff is the async transport, not a guard, and is exercised
+        (with its own latency accounting) everywhere else in this
+        file."""
+        from scconsensus_tpu.serve.driver import RequestHandle
+
+        # isolate from suite state: a stale tracer left by earlier tests
+        # would receive a serve_request span per request (lock + append
+        # on someone else's span tree) and bill ITS cost to the guard
+        import scconsensus_tpu.obs.trace as _trace_mod
+
+        _trace_mod._LAST_TRACER = None
+        import gc
+
+        gc.collect()
+
+        model, G = _production_shaped_model()
+        rng = np.random.default_rng(1)
+        # production-shaped batches (2048 cells): the fixed per-batch
+        # guard cost is priced against real device work, the way the
+        # micro-batching window amortizes it in deployment
+        reqs = [rng.normal(size=(2048, G)).astype(np.float32)
+                for _ in range(8)]
+        model.classify(reqs[0])  # warm the kernel
+        best_ratio = float("inf")
+        for _ in range(3):
+            srv = ConsensusServer(model, _fast_cfg(
+                max_batch_cells=2048, queue_capacity=64,
+                batch_window_s=0.0))  # not started: single-thread drive
+            t0 = time.perf_counter()
+            for i, x in enumerate(reqs):
+                r = RequestHandle(i, np.asarray(x, np.float32),
+                                  time.time() + 30.0)
+                srv._process([r])
+                assert r.result(0).outcome == "ok"
+            guarded = time.perf_counter() - t0
+            classify_wall = srv.stats.classify_wall_s
+            assert srv.stats.breaker_trips == 0
+            assert classify_wall > 0
+            best_ratio = min(best_ratio, guarded / classify_wall)
+        assert best_ratio < 1.02, (
+            f"zero-fault, breaker-closed guard layers added "
+            f"{(best_ratio - 1):+.1%} over the bare classify wall; "
+            "contract is < 2%"
+        )
+
+
+# --------------------------------------------------------------------------
+# tooling: heartbeat serving panel, ledger stamp, soak matrix
+# --------------------------------------------------------------------------
+
+class TestTooling:
+    def test_live_summary_feeds_heartbeat(self, model):
+        from scconsensus_tpu.serve import metrics as serve_metrics
+
+        with ConsensusServer(model, _fast_cfg()) as srv:
+            srv.classify(make_requests(1, 8, 7)[0], timeout=30.0)
+            live = serve_metrics.live_summary()
+            assert live is not None
+            assert live["breaker"] == "closed"
+            assert live["ok"] == 1
+            assert live["queue_cap"] == srv.config.queue_capacity
+        assert serve_metrics.live_summary() is None  # stop() detaches
+
+    def test_tail_run_renders_serving_panel_from_fixture(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import tail_run
+
+        stream = os.path.join(REPO, "tests", "fixtures", "heartbeat",
+                              "sample_serve_heartbeat.jsonl")
+        panel = tail_run.render(tail_run.read_stream(stream), {},
+                                now=1700000012.0)
+        assert "serving:" in panel
+        assert "queue 17/256" in panel
+        assert "p99 23.7ms" in panel
+        assert "BREAKER open (1 trip(s))" in panel
+        assert "DEGRADED 12" in panel
+        assert "QUARANTINED 2" in panel
+        assert "rejected 3" in panel
+
+    def test_ledger_ingest_stamps_serving_summary(self, tmp_path):
+        from scconsensus_tpu.obs.export import build_run_record
+        from scconsensus_tpu.obs.ledger import Ledger
+
+        st = ServingStats(queue_capacity=8)
+        for _ in range(4):
+            st.note_submit(1)
+            st.note_outcome("ok", 0.004)
+        rec = build_run_record(
+            metric="serve test", value=4.0, unit="ms",
+            extra={"config": "serve-test", "platform": "cpu"},
+            serving=st.section(),
+        )
+        entry = Ledger(str(tmp_path)).ingest(rec, source="test")
+        assert entry["serving"]["requests"] == 4
+        assert entry["serving"]["p99_ms"] is not None
+
+    def test_serving_baselines_and_gate(self):
+        from scconsensus_tpu.obs.regress import serving_baselines
+
+        hist = [
+            {"serving": {"p50_ms": 4.0, "p99_ms": 10.0}},
+            {"serving": {"p50_ms": 4.2, "p99_ms": 11.0}},
+            {"serving": {"p50_ms": 4.1, "p99_ms": 10.4}},
+        ]
+        base = serving_baselines(hist)
+        assert base["p99_ms"]["baseline_ms"] == 10.4
+        # band: max(spread=1.0, 25% of 10.4=2.6, 1ms) = 2.6
+        assert base["p99_ms"]["band_ms"] == pytest.approx(2.6)
+        # partials never anchor
+        hist.append({"serving": {"p99_ms": 99.0},
+                     "termination": "signal"})
+        assert serving_baselines(hist)["p99_ms"]["baseline_ms"] == 10.4
+
+    def test_serve_soak_accounting_with_mixed_outcomes(self, tmp_path):
+        # in-process soak: OOD requests quarantine, the rest label; the
+        # validated section accounts for every one
+        summary = run_soak(str(tmp_path / "m"), n_requests=8,
+                           cells_per=8, seed=7, n_ood=2)
+        assert summary["ok"]
+        assert summary["resolved"] == summary["requests"] == 8
+        counts = summary["outcome_counts"]
+        assert counts.get("quarantined", 0) == 2
+        assert counts.get("ok", 0) == 6
+        sv = summary["record"]["serving"]
+        assert sv["requests"]["submitted"] == 8
+        assert sv["drift"]["quarantine_entries"] == 2
+
+    def test_serve_soak_matrix_is_well_formed(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import chaos_run
+
+        names = [m[0] for m in chaos_run.SERVE_SOAK_MATRIX]
+        assert len(names) == len(set(names))
+        sites = {r["site"] for _, rules, _, _ in
+                 chaos_run.SERVE_SOAK_MATRIX for r in rules}
+        # the matrix covers every serve fault site + the model artifact
+        assert {"serve_device", "serve_batch",
+                "artifact:consensus_model"} <= sites
+        for _, rules, mode, _ in chaos_run.SERVE_SOAK_MATRIX:
+            assert mode in ("soak", "refusal", "kill-restart")
+            for r in rules:
+                assert r["class"] in chaos_run_fault_classes()
+
+
+def chaos_run_fault_classes():
+    from scconsensus_tpu.robust.faults import FAULT_CLASSES
+
+    return FAULT_CLASSES

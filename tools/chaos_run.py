@@ -16,14 +16,19 @@ regression baselines, and ingests it with ``source="chaos"``.
 ``--expect-recovery`` additionally fails unless the section claims (and
 evidences — validate_run_record enforces that) recovery.
 
-``--soak`` runs the NAMED matrix of fault plans (:data:`SOAK_MATRIX` —
-transient/oom/stall at the classic sites plus the elastic device-loss
-plans, which force an 8-virtual-device CPU mesh so the shrink ladder is
-exercised without hardware) back-to-back under ONE wall-clock budget
-(``--timeout`` covers the whole soak; a plan that would start past the
-budget is failed as budget-exhausted, never silently skipped) and emits
-a single pass/fail soak summary line. ``--soak-plans`` filters the
-matrix by name (comma-separated) for bounded CI runs.
+``--soak`` runs the NAMED matrices of fault plans back-to-back under ONE
+wall-clock budget (``--timeout`` covers the whole soak; a plan that
+would start past the budget is failed as budget-exhausted, never
+silently skipped) and emits a single pass/fail soak summary line:
+:data:`SOAK_MATRIX` (transient/oom/stall at the classic pipeline sites
+plus the elastic device-loss plans, which force an 8-virtual-device CPU
+mesh so the shrink ladder is exercised without hardware) and
+:data:`SERVE_SOAK_MATRIX` (the serving sites: kill mid-batch with a
+restart-and-replay identity check, corrupt model artifact with a typed
+quarantine refusal, stalled device calls against short deadlines, oom
+under load tripping the breaker into flagged degraded mode — each plan
+verifying the serve worker's request accounting). ``--soak-plans``
+filters both matrices by name (comma-separated) for bounded CI runs.
 
 Exit codes: 0 chaos contract held; 1 it did not; 2 usage/IO error.
 """
@@ -72,15 +77,173 @@ SOAK_MATRIX: List[Tuple[str, List[Dict[str, Any]], bool, bool]] = [
      True, True),
 ]
 
+# The serving fault-plan matrix (round 15): each plan drives the
+# replayable serve-soak worker (python -m scconsensus_tpu.serve.soak)
+# under injected faults at the serve sites. The contract every plan
+# checks: NO request is silently dropped or mislabeled — each ends as a
+# success, a flagged degraded response, a typed rejection, or a
+# quarantine entry, and the worker's validated `serving` section
+# accounts for all of them (that validation is what "ok" means).
+# Modes: "soak" (run under the plan, require accounting + any named
+# expectations), "refusal" (corrupt-model plan: the load must refuse
+# typed, with the artifact quarantined), "kill-restart" (SIGKILL
+# mid-batch, then a restart over the same frozen model must replay the
+# reference request set to IDENTICAL labels).
+SERVE_SOAK_MATRIX: List[Tuple[str, List[Dict[str, Any]], str,
+                              Dict[str, Any]]] = [
+    ("serve-transient-device",
+     [{"site": "serve_device", "class": "transient", "times": 2}],
+     "soak", {"expect_all_served": True}),
+    ("serve-oom-under-load",
+     [{"site": "serve_device", "class": "oom", "times": 6}],
+     "soak", {"expect_degraded": True}),
+    ("serve-stall-device",
+     [{"site": "serve_device", "class": "stall", "stall_s": 0.6,
+       "times": 2}],
+     "soak", {"deadline_s": 0.25, "expect_deadline": True}),
+    ("serve-corrupt-model",
+     [{"site": "artifact:consensus_model", "class": "corrupt"}],
+     "refusal", {}),
+    ("serve-kill-mid-batch",
+     [{"site": "serve_batch", "class": "kill", "after": 1}],
+     "kill-restart", {}),
+]
+
+
+def _serve_worker(workdir: str, plan_path: Optional[str],
+                  timeout_s: float, n_requests: int,
+                  extra_args: Optional[List[str]] = None
+                  ) -> Tuple[int, Optional[Dict[str, Any]]]:
+    """One serve-soak worker subprocess; returns (rc, summary|None).
+    rc -9 (SIGKILL) with no fresh summary is the kill-plan's expected
+    shape."""
+    summary_path = os.path.join(workdir, "SOAK_SUMMARY.json")
+    try:
+        os.remove(summary_path)
+    except OSError:
+        pass
+    env = dict(os.environ)
+    env.pop("SCC_FAULT_PLAN", None)
+    if plan_path:
+        env["SCC_FAULT_PLAN"] = os.path.abspath(plan_path)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, "-m", "scconsensus_tpu.serve.soak",
+           "--dir", workdir, "--requests", str(n_requests),
+           "--summary", summary_path] + list(extra_args or [])
+    try:
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=timeout_s, cwd=_REPO)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        return 124, None
+    if rc != 0 and proc.stderr:
+        for ln in proc.stderr.strip().splitlines()[-4:]:
+            print(f"[serve-soak] {ln}", file=sys.stderr)
+    try:
+        with open(summary_path) as f:
+            return rc, json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return rc, None
+
+
+def run_serve_plan(name: str, rules: List[Dict[str, Any]], mode: str,
+                   extra: Dict[str, Any], tmp: str,
+                   timeout_s: float, n_requests: int = 16) -> int:
+    """Run one serving fault plan; 0 = the serving chaos contract held."""
+    workdir = os.path.join(tmp, name)
+    os.makedirs(workdir, exist_ok=True)
+    plan_path = os.path.join(workdir, "plan.json")
+    with open(plan_path, "w") as f:
+        json.dump({"faults": rules}, f)
+    checks: List[Tuple[str, bool]] = []
+    # one DEADLINE for the whole plan: multi-run modes (kill-restart is
+    # three worker runs) share it, so the plan can never overrun the
+    # soak budget by stacking full timeouts per subprocess
+    deadline = time.monotonic() + timeout_s
+
+    def _left() -> float:
+        return max(deadline - time.monotonic(), 1.0)
+
+    if mode == "refusal":
+        rc, summary = _serve_worker(
+            workdir, plan_path, _left(), n_requests,
+            ["--fresh", "--expect-refusal"],
+        )
+        checks.append(("worker exited 0 (typed refusal observed)",
+                       rc == 0))
+        checks.append(("load refused", bool(summary
+                                            and summary.get("refused"))))
+        checks.append(("corrupt artifact quarantined",
+                       bool(summary and summary.get("quarantined"))))
+    elif mode == "kill-restart":
+        rc0, ref = _serve_worker(workdir, None, _left(), n_requests)
+        checks.append(("reference run clean", rc0 == 0 and bool(ref)
+                       and ref.get("ok")))
+        rc1, _ = _serve_worker(workdir, plan_path, _left(), n_requests)
+        checks.append(("kill plan killed the worker mid-batch",
+                       rc1 != 0))
+        rc2, restart = _serve_worker(workdir, None, _left(), n_requests)
+        checks.append(("restart run clean", rc2 == 0 and bool(restart)
+                       and restart.get("ok")))
+        checks.append((
+            "restart LOADED the frozen model (did not rebuild)",
+            bool(restart) and restart.get("model_built") is False,
+        ))
+        checks.append((
+            "replayed request set produced identical labels",
+            bool(ref) and bool(restart)
+            and ref.get("labels_sha") == restart.get("labels_sha"),
+        ))
+    else:  # "soak"
+        args: List[str] = []
+        if extra.get("deadline_s"):
+            args += ["--deadline", str(extra["deadline_s"])]
+        rc, summary = _serve_worker(workdir, plan_path, _left(),
+                                    n_requests, args)
+        counts = (summary or {}).get("outcome_counts") or {}
+        sv = ((summary or {}).get("record") or {}).get("serving") or {}
+        checks.append(("worker exited 0 (accounting held, serving "
+                       "section validated)", rc == 0))
+        checks.append(("every request resolved", bool(summary)
+                       and summary.get("resolved")
+                       == summary.get("requests")))
+        if extra.get("expect_all_served"):
+            checks.append((
+                "transient blip recovered in-batch (all ok, none "
+                "degraded)",
+                counts.get("ok", 0) == n_requests,
+            ))
+        if extra.get("expect_degraded"):
+            checks.append(("degraded responses served and flagged",
+                           counts.get("degraded", 0) > 0))
+            checks.append((
+                "breaker tripped",
+                int(((sv.get("breaker") or {}).get("trips")) or 0) >= 1,
+            ))
+        if extra.get("expect_deadline"):
+            checks.append(("stalled requests failed typed "
+                           "DeadlineExceeded",
+                           counts.get("DeadlineExceeded", 0) > 0))
+    ok = all(c for _, c in checks)
+    for label, c in checks:
+        print(f"[chaos:{name}] {'ok  ' if c else 'FAIL'} {label}",
+              file=sys.stderr)
+    return 0 if ok else 1
+
 
 def run_soak(config: str, evidence_dir: str, budget_s: float,
-             no_fork: bool, only: Optional[List[str]] = None) -> int:
-    """Run the soak matrix back-to-back under one wall-clock budget and
-    print a single pass/fail summary JSON line."""
+             no_fork: bool, only: Optional[List[str]] = None,
+             serve_requests: int = 16) -> int:
+    """Run the soak matrices (pipeline + serving) back-to-back under one
+    wall-clock budget and print a single pass/fail summary JSON line."""
     matrix = [m for m in SOAK_MATRIX if not only or m[0] in only]
-    if not matrix:
+    serve_matrix = [m for m in SERVE_SOAK_MATRIX
+                    if not only or m[0] in only]
+    if not matrix and not serve_matrix:
+        known = [m[0] for m in SOAK_MATRIX] + [m[0] for m
+                                               in SERVE_SOAK_MATRIX]
         print(f"chaos_run: --soak-plans matched nothing "
-              f"(known: {[m[0] for m in SOAK_MATRIX]})", file=sys.stderr)
+              f"(known: {known})", file=sys.stderr)
         return 2
     t0 = time.monotonic()
     results: List[Dict[str, Any]] = []
@@ -110,6 +273,21 @@ def run_soak(config: str, evidence_dir: str, budget_s: float,
                         os.environ.pop("XLA_FLAGS", None)
                     else:
                         os.environ["XLA_FLAGS"] = saved_xla
+            results.append({
+                "plan": name, "ok": rc == 0,
+                "outcome": "ok" if rc == 0 else f"rc={rc}",
+                "elapsed_s": round(time.monotonic() - t_plan, 1),
+            })
+        for name, rules, mode, extra in serve_matrix:
+            remaining = budget_s - (time.monotonic() - t0)
+            if remaining <= 0:
+                # budget exhaustion is a FAILURE, never a silent skip
+                results.append({"plan": name, "ok": False,
+                                "outcome": "budget-exhausted"})
+                continue
+            t_plan = time.monotonic()
+            rc = run_serve_plan(name, rules, mode, extra, tmp,
+                                remaining, n_requests=serve_requests)
             results.append({
                 "plan": name, "ok": rc == 0,
                 "outcome": "ok" if rc == 0 else f"rc={rc}",
@@ -239,7 +417,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "back-to-back under one budget")
     ap.add_argument("--soak-plans", default=None,
                     help="comma-separated soak plan names to run "
-                         "(default: the full matrix)")
+                         "(default: the full pipeline + serve matrices)")
+    ap.add_argument("--serve-requests", type=int, default=16,
+                    help="requests per serve-soak plan (default 16)")
     args = ap.parse_args(argv)
     evidence = args.evidence or default_evidence_dir(_REPO)
     os.makedirs(evidence, exist_ok=True)
@@ -247,7 +427,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         only = ([s.strip() for s in args.soak_plans.split(",") if s.strip()]
                 if args.soak_plans else None)
         return run_soak(args.config, evidence, args.timeout,
-                        args.no_fork, only)
+                        args.no_fork, only,
+                        serve_requests=args.serve_requests)
     if not args.plan:
         ap.error("--plan required (or --soak)")
     return run_chaos(args.plan, args.config, evidence, args.timeout,

@@ -179,6 +179,15 @@ def _report(verdict: regress.GateVerdict, drifts: List[Dict[str, Any]],
             if tv.regressed:
                 line += f"  (+{tv.excess_bytes:,}B past band)"
             print(line)
+        for sv in verdict.serving:
+            mark = "REGRESSED" if sv.regressed else (
+                "ok" if sv.metric == "p99_ms" else "info")
+            line = (f"  serve {sv.metric:<20} {sv.value_ms:>9.3f}ms "
+                    f"baseline {sv.baseline_ms:.3f}ms "
+                    f"± {sv.band_ms:.3f}ms  {mark}")
+            if sv.regressed:
+                line += f"  (+{sv.excess_ms:.3f}ms past band)"
+            print(line)
         for d in drifts:
             state = "acknowledged" if d["acknowledged"] else "UNACKNOWLEDGED"
             src = d.get("pins_source")
@@ -352,6 +361,55 @@ def _smoke(fixtures: str, as_json: bool) -> int:
     checks.append((
         "mesh transition with a non-shrinking device set rejected",
         el_rejected,
+    ))
+
+    # serving-latency gate (round 15, BASELINE.md serving-latency
+    # policy): the clean candidate's serving p99 sits inside the key's
+    # latency band...
+    checks.append((
+        "clean candidate's serving latency gated within band",
+        bool(verdict.serving)
+        and not any(s.regressed for s in verdict.serving),
+    ))
+    # ...while a candidate with CLEAN stage walls but a 3× p99 must fail
+    # on the serving verdict ALONE — tail latency is a first-class
+    # regression even when every batch-stage wall is green
+    verdict_sv, _ = run_gate(
+        os.path.join(fixtures, "candidate_serve_latency_regressed.json"),
+        evidence,
+    )
+    sreg = verdict_sv.serving_regressions
+    checks.append((
+        "serve-latency-regressed candidate fails on the serving verdict "
+        "alone (clean walls, clean transfers)",
+        (not verdict_sv.ok)
+        and any(s.metric == "p99_ms" for s in sreg)
+        and not any(s.regressed for s in verdict_sv.stages)
+        and not any(t.regressed for t in verdict_sv.transfers),
+    ))
+    # a serving section that lost a request is a SCHEMA violation, not a
+    # gateable record (the accounting rule is the serve contract);
+    # scratch file goes to a temp dir — the committed fixture tree may
+    # be a read-only checkout
+    import copy as _copy
+    import tempfile as _tempfile
+
+    bad = _copy.deepcopy(_load_json(
+        os.path.join(fixtures, "candidate_serve_latency_regressed.json")
+    ))
+    bad["serving"]["requests"]["ok"] -= 1  # one request vanishes
+    with _tempfile.TemporaryDirectory(prefix="scc-gate-smoke-") as tmp:
+        bad_path = os.path.join(tmp, "candidate_serve_bad.json")
+        with open(bad_path, "w") as f:
+            json.dump(bad, f)
+        try:
+            run_gate(bad_path, evidence)
+            acct_rejected = False
+        except ValueError as e:
+            acct_rejected = "accounting" in str(e)
+    checks.append((
+        "serving section that lost a request rejected by validation",
+        acct_rejected,
     ))
 
     for label, ok in checks:

@@ -9,7 +9,9 @@ panel (numeric-sentinel trips + the latest DE-funnel totals, so NaN
 storms and empty funnels are visible live), a transfer panel (cumulative
 host↔device bytes from the residency auditor plus a live byte rate
 differenced from consecutive ticks — a host-round-trip storm shows as
-MB/s mid-run), and — when
+MB/s mid-run), a serving panel (queue depth, live p99, breaker state,
+degraded/quarantined/rejected counters fed from serve.metrics via
+obs.live — an online driver's vitals tick by tick), and — when
 the evidence ledger holds baseline history for the run's key — a
 per-stage ETA from the noise-banded baselines
 (``obs.regress.stage_baselines``). The sibling ``*_partial.json`` record
@@ -280,6 +282,34 @@ def render(lines: List[Dict[str, Any]],
                 )
             if bits:
                 out.append("  robust: " + "   ".join(bits))
+        sv = hb.get("serving") or {}
+        if sv:
+            # serving heartbeat panel (obs.live ← serve.metrics): queue
+            # depth, live p99, breaker state, degraded/quarantined/
+            # rejected counters — the online path's vitals at a glance
+            bits = [f"queue {sv.get('queue_depth', 0)}"
+                    + (f"/{sv['queue_cap']}" if sv.get("queue_cap")
+                       else "")]
+            if sv.get("p99_ms") is not None:
+                bits.append(f"p99 {sv['p99_ms']:.1f}ms")
+            state = sv.get("breaker", "closed")
+            bits.append(
+                ("BREAKER " if state != "closed" else "breaker ") + state
+                + (f" ({sv['breaker_trips']} trip(s))"
+                   if sv.get("breaker_trips") else "")
+            )
+            bits.append(f"ok {sv.get('ok', 0)}")
+            if sv.get("degraded"):
+                bits.append(f"DEGRADED {sv['degraded']}")
+            if sv.get("quarantined"):
+                bits.append(f"QUARANTINED {sv['quarantined']}")
+            if sv.get("rejected"):
+                bits.append(f"rejected {sv['rejected']}")
+            if sv.get("deadline_exceeded"):
+                bits.append(f"deadline {sv['deadline_exceeded']}")
+            if sv.get("failed"):
+                bits.append(f"failed {sv['failed']}")
+            out.append("  serving: " + "   ".join(bits))
     if st["stall"]:
         sl = st["stall"]
         out.append(f"  STALL #{sl.get('stalls')} at +{_fmt_dur((sl.get('ts') or 0) - float((st['header'] or {}).get('ts') or 0))}"
