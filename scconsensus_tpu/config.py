@@ -210,6 +210,37 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "Export-time drift calibration margin: the foreign-cell "
                 "threshold is the training q99 nearest-landmark distance "
                 "times this factor (stored in the frozen model)."),
+        EnvFlag("SCC_SERVE_LEDGER_DIR", str, None,
+                "Writable sidecar directory for the drift quarantine "
+                "ledger (+ persisted quarantined-cell batches, the "
+                "reconsensus loop's material). Takes precedence over the "
+                "model-dir default — REQUIRED for drift evidence when the "
+                "model dir is a frozen read-only mount, where the r15 "
+                "default would silently leave no ledger at all."),
+        EnvFlag("SCC_SERVE_LEDGER_MAX_CELLS", int, 100_000,
+                "Cap on quarantined cells persisted to the ledger dir per "
+                "server lifetime (ledger LINES keep appending past it; "
+                "only the .npy cell payloads stop): the reconsensus "
+                "material stays bounded under a drift storm."),
+        # --- serving fleet (serve/fleet/) ---
+        EnvFlag("SCC_FLEET_REPLICAS", int, 2,
+                "Default replica count for serve.fleet.ReplicaPool: N "
+                "ConsensusServer workers behind one shared admission "
+                "layer with least-depth routing and per-replica circuit "
+                "breakers."),
+        EnvFlag("SCC_FLEET_WIRE_PORT", int, 0,
+                "TCP port for the serve.fleet.wire HTTP front "
+                "(0 = ephemeral; the bound port is WireFront.port)."),
+        EnvFlag("SCC_FLEET_SWAP_DRAIN_S", float, 30.0,
+                "Hot-swap drain budget: after the atomic cutover to the "
+                "new model's replicas, each outgoing replica gets this "
+                "long to finish its in-flight batches before its worker "
+                "join is abandoned (requests still resolve typed)."),
+        EnvFlag("SCC_FLEET_RECON_MIN_CELLS", int, 64,
+                "Minimum accumulated quarantined cells before "
+                "serve.fleet.reconsensus will run the mini-refine and "
+                "produce an updated model (below it the loop reports "
+                "insufficient evidence and leaves the ledger growing)."),
         # --- DE engine ---
         EnvFlag("SCC_WILCOX_PROBE", bool, False,
                 "Synced per-bucket occupancy DIAGNOSIS of the Wilcoxon "

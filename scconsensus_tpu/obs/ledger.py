@@ -391,6 +391,12 @@ class Ledger:
                 "throughput_rps": sv.get("throughput_rps"),
                 "requests": (sv.get("requests") or {}).get("submitted"),
             }
+            nrep = (sv.get("fleet") or {}).get("replicas")
+            if isinstance(nrep, int) and nrep >= 1:
+                # replica count on the index: the perf gate's replica-
+                # keyed baselines (p99@rN, throughput@rN) read it —
+                # absent means the bare r15 driver (keys as r1)
+                entry["serving"]["replicas"] = nrep
         fp = (rec.get("extra") or {}).get("numeric_fingerprint")
         if isinstance(fp, dict) and fp:
             # every ingested run is fingerprint-stamped on its manifest

@@ -174,7 +174,15 @@ def serving_baselines(history: Sequence[Dict[str, Any]]
     (the tail is the serving contract) with ``p50_ms`` carried for the
     report. Same median-of-≤3 machinery, SERVING floors (25 % / 1 ms),
     partials excluded. Entries without a serving stamp simply don't
-    anchor — absence of serving must not read as zero latency."""
+    anchor — absence of serving must not read as zero latency.
+
+    Fleet round: every metric anchors under a replica-count key
+    (``p99_ms@r<N>``, plus ``throughput_rps@r<N>`` — a 4-replica p99 is
+    not comparable to a 1-replica p99, and fleet throughput is gated in
+    its own right; entries without a replica stamp key as r1, the bare
+    r15 driver). The unkeyed p50/p99 series anchor ONLY on unstamped
+    (single-driver) entries — a fleet's pool-level tail must never drag
+    the single-driver baseline a non-fleet candidate gates against."""
     from scconsensus_tpu.obs.ledger import is_partial_entry
 
     series: Dict[str, List[float]] = {}
@@ -182,10 +190,20 @@ def serving_baselines(history: Sequence[Dict[str, Any]]
         if is_partial_entry(e):
             continue
         sv = e.get("serving") or {}
+        nrep = sv.get("replicas")
+        fleet_stamped = isinstance(nrep, int) and nrep >= 1
+        nrep = int(nrep) if fleet_stamped else 1
         for metric in ("p50_ms", "p99_ms"):
             v = sv.get(metric)
             if isinstance(v, (int, float)) and v >= 0:
-                series.setdefault(metric, []).append(float(v))
+                if not fleet_stamped:
+                    series.setdefault(metric, []).append(float(v))
+                series.setdefault(f"{metric}@r{nrep}",
+                                  []).append(float(v))
+        tp = sv.get("throughput_rps")
+        if isinstance(tp, (int, float)) and tp >= 0:
+            series.setdefault(f"throughput_rps@r{nrep}",
+                              []).append(float(tp))
     return {
         metric: {
             "baseline_ms": round(b["baseline"], 4),
@@ -289,17 +307,21 @@ class TransferVerdict:
 
 @dataclasses.dataclass
 class ServingVerdict:
-    """Serving-latency verdict (candidate serving section vs the key's
-    ledger-stamped latency baselines) — the tail-latency equivalent of a
+    """Serving verdict (candidate serving section vs the key's
+    ledger-stamped baselines) — the tail-latency equivalent of a
     stage-wall claim. A clean-walls candidate whose p99 blew out fails
-    on THIS verdict alone."""
+    on THIS verdict alone. Fleet candidates gate replica-count-keyed
+    metrics (``p99_ms@r<N>``) plus throughput (``throughput_rps@r<N>``,
+    ``unit="rps"``) — for throughput LOWER is the regression, so
+    ``excess_ms`` carries the shortfall below the band floor."""
 
-    metric: str                    # "p99_ms" | "p50_ms"
+    metric: str                    # "p99_ms" | "p50_ms" | "...@r<N>"
     value_ms: float
     baseline_ms: float
     band_ms: float
     regressed: bool
     excess_ms: float = 0.0
+    unit: str = "ms"
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -474,17 +496,29 @@ def gate_record(candidate: Dict[str, Any],
             if tv.regressed:
                 tv.excess_bytes = int(nbytes - limit_b)
             transfers.append(tv)
-    # serving-latency gate: the candidate's p50/p99 vs the key's ledger-
-    # stamped latency baselines (BASELINE.md serving-latency policy).
-    # Only the tail (p99) fails the gate; p50 is reported informationally
-    # — a p50 shift inside a clean p99 is tuning, not a regression.
+    # serving gate: the candidate's p50/p99 vs the key's ledger-stamped
+    # latency baselines (BASELINE.md serving-latency policy). Only the
+    # tail (p99) fails the gate; p50 is reported informationally — a p50
+    # shift inside a clean p99 is tuning, not a regression. A FLEET
+    # candidate (serving.fleet present) gates replica-count-keyed
+    # baselines instead — a 4-replica p99 must never be judged against
+    # 1-replica history — and additionally gates fleet THROUGHPUT, where
+    # lower is the regression: a fleet that kept its single-replica tail
+    # clean while losing aggregate throughput has still regressed.
     serving: List[ServingVerdict] = []
-    cand_lat = ((candidate.get("serving") or {}).get("latency_ms")
-                or {})
+    cand_sv = candidate.get("serving") or {}
+    cand_lat = cand_sv.get("latency_ms") or {}
+    cand_fleet = cand_sv.get("fleet") or {}
     if cand_lat.get("n"):
         sbase = serving_baselines(history)
-        for metric in ("p50_ms", "p99_ms"):
-            v = cand_lat.get(metric.split("_")[0])
+        if cand_fleet.get("replicas"):
+            nrep = int(cand_fleet["replicas"])
+            suffix = f"@r{nrep}"
+        else:
+            suffix = ""
+        for short in ("p50", "p99"):
+            metric = f"{short}_ms{suffix}"
+            v = cand_lat.get(short)
             base = sbase.get(metric)
             if v is None or base is None:
                 continue
@@ -492,11 +526,27 @@ def gate_record(candidate: Dict[str, Any],
             svv = ServingVerdict(
                 metric=metric, value_ms=round(float(v), 4),
                 baseline_ms=base["baseline_ms"], band_ms=base["band_ms"],
-                regressed=(metric == "p99_ms" and v > limit_ms),
+                regressed=(short == "p99" and v > limit_ms),
             )
             if svv.regressed:
                 svv.excess_ms = round(float(v) - limit_ms, 4)
             serving.append(svv)
+        if suffix:
+            tp = cand_sv.get("throughput_rps")
+            base = sbase.get(f"throughput_rps{suffix}")
+            if tp is not None and base is not None:
+                floor_rps = base["baseline_ms"] - base["band_ms"]
+                svv = ServingVerdict(
+                    metric=f"throughput_rps{suffix}",
+                    value_ms=round(float(tp), 4),
+                    baseline_ms=base["baseline_ms"],
+                    band_ms=base["band_ms"],
+                    regressed=float(tp) < floor_rps,
+                    unit="rps",
+                )
+                if svv.regressed:
+                    svv.excess_ms = round(floor_rps - float(tp), 4)
+                serving.append(svv)
     ok = (not any(s.regressed for s in stages)
           and not any(t.regressed for t in transfers)
           and not any(s.regressed for s in serving))

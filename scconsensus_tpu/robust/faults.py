@@ -25,7 +25,13 @@ and the serving driver's three sites (``serve_load`` at model load,
 ``serve_batch`` at micro-batch assembly, ``serve_device`` inside the
 device classify call), so ``tools/chaos_run.py`` soaks the online path
 the same way it soaks the pipeline; the serving model's write-time
-corruption rides the generic ``artifact:consensus_model`` site.
+corruption rides the generic ``artifact:consensus_model`` site. The
+serving FLEET (round 16) adds three more: ``wire_request`` (the HTTP
+front's classify handler, before admission), ``fleet_route`` (the
+pool's shared admission layer, before a replica is picked) and
+``fleet_swap`` (the start of a hot-swap, before v2 loads) — a fault
+there must surface as a typed, counted wire outcome, never a dead
+socket.
 
 Fault classes and what they do at a compute site:
 

@@ -59,4 +59,10 @@ def __getattr__(name):
         from scconsensus_tpu.serve import model
 
         return getattr(model, name)
+    if name in ("ReplicaPool", "WireFront", "run_reconsensus"):
+        # the serving fleet (round 16): wire front, replica hot-swap,
+        # drift-to-reconsensus loop
+        from scconsensus_tpu.serve import fleet
+
+        return getattr(fleet, name)
     raise AttributeError(name)

@@ -71,9 +71,14 @@ __all__ = [
     "CircuitBreaker",
     "ConsensusServer",
     "QUARANTINE_LEDGER_NAME",
+    "QUARANTINE_CELLS_DIR",
 ]
 
 QUARANTINE_LEDGER_NAME = "QUARANTINE_LEDGER.jsonl"
+# sibling dir for the persisted quarantined-cell payloads — the writer
+# (this driver) and the reader (serve.fleet.reconsensus) share this ONE
+# name
+QUARANTINE_CELLS_DIR = "quarantine_cells"
 
 
 @dataclasses.dataclass
@@ -89,6 +94,7 @@ class ServeConfig:
     breaker_cooldown_s: Optional[float] = None  # SCC_SERVE_BREAKER_COOLDOWN_S
     drift_quarantine_frac: Optional[float] = None  # SCC_SERVE_DRIFT_FRAC
     quarantine_path: Optional[str] = None     # default <model_dir>/ledger
+    ledger_dir: Optional[str] = None          # SCC_SERVE_LEDGER_DIR
 
     def resolved(self) -> "ServeConfig":
         def _r(v, flag):
@@ -110,6 +116,7 @@ class ServeConfig:
             drift_quarantine_frac=float(_r(self.drift_quarantine_frac,
                                            "SCC_SERVE_DRIFT_FRAC")),
             quarantine_path=self.quarantine_path,
+            ledger_dir=_r(self.ledger_dir, "SCC_SERVE_LEDGER_DIR"),
         )
 
 
@@ -127,6 +134,10 @@ class ServeResponse:
     drift_fraction: float
     latency_s: float
     batch_seq: int
+    # fingerprint of the model that answered — the fleet's hot-swap
+    # purity check reads it off every response (a request is never split
+    # across models, and this proves WHICH model served it)
+    model_fp: Optional[str] = None
 
 
 class RequestHandle:
@@ -228,7 +239,8 @@ class ConsensusServer:
 
     def __init__(self, model: Union[ConsensusModel, str],
                  config: Optional[ServeConfig] = None,
-                 readonly: bool = False):
+                 readonly: bool = False,
+                 register_live: bool = True):
         if isinstance(model, str):
             # typed refusal path: ModelLoadError propagates — a server
             # must not come up on a model it cannot prove intact. The
@@ -251,14 +263,24 @@ class ConsensusServer:
             self.stats,
         )
         qp = self.config.quarantine_path
+        if qp is None and self.config.ledger_dir:
+            # the writable sidecar dir (SCC_SERVE_LEDGER_DIR): the ONLY
+            # way a server on a frozen read-only model dir accumulates
+            # drift evidence — and where the reconsensus loop finds its
+            # material (the ledger lines AND the quarantined cells)
+            qp = os.path.join(self.config.ledger_dir,
+                              QUARANTINE_LEDGER_NAME)
         if qp is None and self.model_dir is not None and not readonly:
             # never default the ledger INTO a readonly model dir: the
             # appends would all fail silently against the promise that a
             # frozen mount is never written — a readonly server needs an
-            # explicit quarantine_path, else the response flag alone is
-            # the signal
+            # explicit quarantine_path or ledger_dir, else the response
+            # flag alone is the signal
             qp = os.path.join(self.model_dir, QUARANTINE_LEDGER_NAME)
         self.quarantine_path = qp
+        self._register_live = bool(register_live)
+        self._q_cells_saved = 0
+        self._q_seq = 0
         self._queue: List[RequestHandle] = []
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
@@ -276,17 +298,22 @@ class ConsensusServer:
             return self
         self._closed = False
         self._draining = False
-        serve_metrics.set_active(self.stats)
+        if self._register_live:
+            # fleet replicas pass register_live=False: the pool feeds the
+            # heartbeat with ONE aggregated fleet summary instead of N
+            # replicas last-write-wins clobbering each other
+            serve_metrics.set_active(self.stats)
         self._thread = threading.Thread(
             target=self._worker, name="scc-serve", daemon=True
         )
         self._thread.start()
         return self
 
-    def stop(self, drain: bool = True) -> None:
+    def stop(self, drain: bool = True, timeout_s: float = 60.0) -> None:
         """Close admission, optionally drain the queue, stop the worker.
         With ``drain=False`` queued requests resolve as ServerClosed —
-        still typed, still accounted."""
+        still typed, still accounted. ``timeout_s`` bounds the worker
+        join (the fleet's hot-swap drain budget flows through here)."""
         with self._lock:
             if self._closed and self._thread is None:
                 return
@@ -295,7 +322,7 @@ class ConsensusServer:
             self._not_empty.notify_all()
         t = self._thread
         if t is not None:
-            t.join(timeout=60.0)
+            t.join(timeout=max(float(timeout_s), 0.1))
         self._thread = None
         with self._lock:
             leftovers = self._queue
@@ -315,6 +342,12 @@ class ConsensusServer:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    @property
+    def closed(self) -> bool:
+        """True when the driver is not accepting requests (never started,
+        stopped, or draining) — the wire front's /healthz signal."""
+        return self._closed
 
     # -- admission ---------------------------------------------------------
     def submit(self, cells: np.ndarray,
@@ -606,6 +639,7 @@ class ConsensusServer:
                         quarantined=True, drift_fraction=frac,
                         latency_s=now2 - r.enqueued_mono,
                         batch_seq=self._batch_seq,
+                        model_fp=self.model.fingerprint(),
                     ), outcome="quarantined")
                     continue
                 self._finish(r, response=ServeResponse(
@@ -615,6 +649,7 @@ class ConsensusServer:
                     quarantined=False, drift_fraction=frac,
                     latency_s=now2 - r.enqueued_mono,
                     batch_seq=self._batch_seq,
+                    model_fp=self.model.fingerprint(),
                 ), outcome="degraded" if degraded else "ok")
             if any_drift:
                 self.stats.note_drift_batch(quarantined=quarantined_n)
@@ -666,11 +701,41 @@ class ConsensusServer:
             )] if d.size else [],
             "model_fp": self.model.fingerprint(),
         }
+        # Persist the quarantined CELLS beside the ledger (bounded by
+        # SCC_SERVE_LEDGER_MAX_CELLS): the r15 ledger recorded only the
+        # distance fingerprint, which starves the reconsensus loop — the
+        # loop needs the actual expression rows to mini-refine. Ledger
+        # lines keep appending past the cap; only the payloads stop.
+        cells_file = self._save_quarantined_cells(r)
+        if cells_file:
+            entry["cells_file"] = cells_file
         try:
             with open(self.quarantine_path, "a") as f:
                 f.write(json.dumps(entry) + "\n")
         except OSError:
             pass
+
+    QUARANTINE_CELLS_DIR = QUARANTINE_CELLS_DIR  # module constant
+
+    def _save_quarantined_cells(self, r: RequestHandle) -> Optional[str]:
+        """Write one ``qcells_*.npy`` payload into the ledger dir's cells
+        subdir; returns the ledger-relative path, or None (cap reached /
+        write failed — the response flag and ledger line still stand)."""
+        cap = int(env_flag("SCC_SERVE_LEDGER_MAX_CELLS"))
+        if self._q_cells_saved + r.n > cap:
+            return None
+        base = os.path.dirname(os.path.abspath(self.quarantine_path))
+        cdir = os.path.join(base, self.QUARANTINE_CELLS_DIR)
+        self._q_seq += 1
+        name = f"qcells_{os.getpid()}_{self._q_seq:06d}.npy"
+        try:
+            os.makedirs(cdir, exist_ok=True)
+            with open(os.path.join(cdir, name), "wb") as f:
+                np.save(f, np.asarray(r.cells, np.float32))
+        except OSError:
+            return None
+        self._q_cells_saved += r.n
+        return os.path.join(self.QUARANTINE_CELLS_DIR, name)
 
     # -- record ------------------------------------------------------------
     def serving_section(self) -> Dict[str, Any]:

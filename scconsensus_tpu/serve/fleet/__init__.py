@@ -1,0 +1,45 @@
+"""Serving fleet: wire front, multi-replica hot-swap, reconsensus loop.
+
+The round-15 ``ConsensusServer`` is an in-process driver; this package is
+what stands between it and real traffic (ROADMAP item 3):
+
+* ``fleet.pool`` — :class:`ReplicaPool`: N ``ConsensusServer`` workers
+  behind ONE shared admission layer with least-depth routing, per-replica
+  circuit breakers, **model hot-swap by artifact fingerprint** (load v2
+  through the readonly sha256 path, atomic cutover, drain v1's in-flight
+  batches — a request is never split across models), and multi-model
+  routing keyed on model fingerprint for atlas-per-tissue deployments.
+* ``fleet.wire`` — :class:`WireFront`: a stdlib-only threaded HTTP front
+  where every wire request resolves to exactly one typed outcome mapped
+  to exactly one status code, plus ``/healthz`` and ``/metrics`` fed from
+  ``serve.metrics.live_summary``. The r15 accounting rule (submitted ==
+  Σ outcomes) holds at the wire layer and is validated in the run record.
+* ``fleet.reconsensus`` — the drift-to-reconsensus loop: accumulated
+  quarantine-ledger cells → classify against the frozen landmarks →
+  spill non-conforming cells into a landmark mini-refine → merge via the
+  paper's contingency heuristic → export → hot-swap back into the fleet.
+  Closes the loop the r15 quarantine ledger opened.
+
+Import discipline: this module is import-light; the heavy pieces load
+lazily (the chaos harness imports the package root without jax).
+"""
+
+__all__ = ["ReplicaPool", "WireFront", "run_reconsensus",
+           "reconsensus_update", "read_quarantine_batch"]
+
+
+def __getattr__(name):
+    if name == "ReplicaPool":
+        from scconsensus_tpu.serve.fleet.pool import ReplicaPool
+
+        return ReplicaPool
+    if name == "WireFront":
+        from scconsensus_tpu.serve.fleet.wire import WireFront
+
+        return WireFront
+    if name in ("run_reconsensus", "reconsensus_update",
+                "read_quarantine_batch"):
+        from scconsensus_tpu.serve.fleet import reconsensus
+
+        return getattr(reconsensus, name)
+    raise AttributeError(name)

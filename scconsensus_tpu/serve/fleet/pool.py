@@ -1,0 +1,477 @@
+"""Replica fleet: N guarded servers behind one admission layer.
+
+:class:`ReplicaPool` owns a set of :class:`ConsensusServer` replicas
+grouped by model fingerprint. The design invariants, in the order they
+matter:
+
+* **One owner per request.** Admission routes each request to exactly
+  one replica (least queue depth among the target model's replicas,
+  preferring closed breakers); from there the r15 driver's accounting
+  covers it. Requests the pool itself refuses (unknown model, closed
+  fleet) ride the pool's own boundary stats, so the merged section's
+  ``submitted_by_owner`` split always sums — a request the fleet cannot
+  attribute to an owner is a lost request wearing a disguise
+  (``serve.metrics.validate_serving`` rejects it).
+* **Hot-swap by fingerprint, never a half-loaded model.** ``hot_swap``
+  loads v2 through the readonly sha256 path, builds AND starts v2's
+  replicas first, then performs the atomic cutover under the routing
+  lock, then drains v1's in-flight batches (bounded by
+  ``SCC_FLEET_SWAP_DRAIN_S``). Because admission holds the same lock the
+  cutover takes, every request either enqueued on v1 before the flip
+  (and drains to completion there) or routes to v2 after it — no request
+  is ever split across models, and no request ever reaches a model whose
+  replicas are not fully up. Retired replicas' stats are snapshotted
+  into the pool's lifetime accounting: a swap loses zero requests AND
+  zero evidence.
+* **Multi-model routing.** ``add_model`` registers additional frozen
+  models (atlas-per-tissue deployments) addressable per request by
+  fingerprint; the active fingerprint serves unaddressed requests.
+
+Fault sites (``robust.faults``): ``fleet_route`` fires at admission,
+``fleet_swap`` at the start of a hot-swap — the chaos soak matrix drives
+both.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from scconsensus_tpu.config import env_flag
+from scconsensus_tpu.serve import metrics as serve_metrics
+from scconsensus_tpu.serve.driver import (
+    ConsensusServer,
+    RequestHandle,
+    ServeConfig,
+    ServeResponse,
+)
+from scconsensus_tpu.serve.errors import RequestInvalid, ServerClosed
+from scconsensus_tpu.serve.model import ConsensusModel, load_consensus_model
+
+__all__ = ["Replica", "ReplicaPool"]
+
+_BREAKER_RANK = serve_metrics.BREAKER_SEVERITY
+
+
+@dataclasses.dataclass
+class Replica:
+    index: int
+    model_fp: str
+    server: ConsensusServer
+
+
+class ReplicaPool:
+    """N ``ConsensusServer`` replicas behind one shared admission layer.
+    Use as a context manager or call :meth:`start`/:meth:`stop`."""
+
+    def __init__(self, model: Union[ConsensusModel, str],
+                 n_replicas: Optional[int] = None,
+                 config: Optional[ServeConfig] = None,
+                 readonly: bool = False,
+                 register_live: bool = True):
+        self.config = (config or ServeConfig()).resolved()
+        self.n_default = int(n_replicas if n_replicas is not None
+                             else env_flag("SCC_FLEET_REPLICAS"))
+        if self.n_default < 1:
+            raise ValueError("a fleet needs at least one replica")
+        self._register_live = bool(register_live)
+        self._lock = threading.Lock()
+        self._closed = True
+        self._rep_seq = 0
+        # pool-boundary accounting: refusals that never reach a replica
+        self._pool_stats = serve_metrics.ServingStats(queue_capacity=0)
+        self._retired_sections: List[Dict[str, Any]] = []
+        self._retired_samples: List[List[float]] = []
+        self._swaps: List[Dict[str, Any]] = []
+        self._started_unix = time.time()
+        first = self._load(model, readonly)
+        self._models: Dict[str, ConsensusModel] = {
+            first.fingerprint(): first
+        }
+        self._active_fp = first.fingerprint()
+        self._groups: Dict[str, List[Replica]] = {
+            first.fingerprint(): self._build_group(first, self.n_default)
+        }
+
+    # -- construction ------------------------------------------------------
+    def _load(self, model: Union[ConsensusModel, str],
+              readonly: bool) -> ConsensusModel:
+        if isinstance(model, str):
+            # the readonly sha256 path: every model entering the fleet is
+            # verified intact, and a frozen mount is never written
+            return load_consensus_model(model, readonly=readonly)
+        return model
+
+    def _build_group(self, model: ConsensusModel,
+                     n: int) -> List[Replica]:
+        group = []
+        for _ in range(max(int(n), 1)):
+            srv = ConsensusServer(model, self.config, register_live=False)
+            group.append(Replica(index=self._rep_seq,
+                                 model_fp=model.fingerprint(),
+                                 server=srv))
+            self._rep_seq += 1
+        return group
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ReplicaPool":
+        with self._lock:
+            if not self._closed:
+                return self
+            self._closed = False
+            self._started_unix = time.time()
+            reps = [r for g in self._groups.values() for r in g]
+        for rep in reps:
+            rep.server.start()
+        if self._register_live:
+            serve_metrics.set_active_fleet(self._live_summary)
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        with self._lock:
+            if self._closed and not any(self._groups.values()):
+                return
+            self._closed = True
+            groups = self._groups
+            self._groups = {fp: [] for fp in groups}
+        for group in groups.values():
+            self._retire_group(group, drain=drain)
+        if self._register_live:
+            serve_metrics.set_active_fleet(None)
+
+    def __enter__(self) -> "ReplicaPool":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- admission ---------------------------------------------------------
+    def _pool_refuse(self, outcome: str) -> None:
+        # keep the boundary stats internally consistent: one submit, one
+        # outcome — the merged section's accounting rule depends on it
+        self._pool_stats.note_submit(0)
+        self._pool_stats.note_outcome(outcome)
+
+    def submit(self, cells: np.ndarray,
+               deadline_s: Optional[float] = None,
+               model_fp: Optional[str] = None) -> RequestHandle:
+        """Route one request to exactly one replica of the addressed
+        model (default: the active fingerprint). Typed refusals:
+        ServerClosed (fleet closed), RequestInvalid (unknown model),
+        plus everything the replica's own admission can raise."""
+        from scconsensus_tpu.robust import faults
+
+        faults.fault_point("fleet_route")
+        with self._lock:
+            if self._closed:
+                self._pool_refuse("rejected_closed")
+                raise ServerClosed("fleet is not accepting requests")
+            fp = model_fp or self._active_fp
+            group = self._groups.get(fp)
+            if not group:
+                self._pool_refuse("rejected_invalid")
+                raise RequestInvalid(
+                    f"no model {fp!r} in the fleet "
+                    f"(have {sorted(self._groups)})"
+                )
+            rep = self._least_depth(group)
+            # enqueue UNDER the pool lock: hot_swap's cutover takes the
+            # same lock, so a request either lands on v1 before the flip
+            # (the drain serves it) or routes to v2 after — never to a
+            # replica already marked for draining
+            return rep.server.submit(cells, deadline_s=deadline_s)
+
+    @staticmethod
+    def _least_depth(group: List[Replica]) -> Replica:
+        """Least-depth routing, preferring replicas whose breaker is
+        closest to closed: a healthy shallow queue beats a degraded
+        one — but a fully-open fleet still serves (degraded beats
+        down)."""
+        return min(
+            group,
+            key=lambda rep: (
+                _BREAKER_RANK.get(rep.server.breaker.state, 0),
+                len(rep.server._queue),
+            ),
+        )
+
+    def classify(self, cells: np.ndarray,
+                 deadline_s: Optional[float] = None,
+                 model_fp: Optional[str] = None,
+                 timeout: Optional[float] = None) -> ServeResponse:
+        return self.submit(cells, deadline_s=deadline_s,
+                           model_fp=model_fp).result(timeout=timeout)
+
+    # -- hot-swap + multi-model routing ------------------------------------
+    def hot_swap(self, model: Union[ConsensusModel, str],
+                 readonly: bool = False,
+                 n_replicas: Optional[int] = None,
+                 drain_timeout_s: Optional[float] = None) -> str:
+        """Atomic cutover of the ACTIVE model: load v2 (sha256-verified),
+        start its replicas, flip the routing pointer under the admission
+        lock, then drain v1. Returns the new active fingerprint.
+        Swapping to the already-active fingerprint is a no-op (idempotent
+        — a retried swap must not restart the fleet); swapping to a
+        model already routed via ``add_model`` PROMOTES its running
+        group rather than replacing it (its replicas and their
+        accounting survive)."""
+        from scconsensus_tpu.robust import faults
+
+        faults.fault_point("fleet_swap")
+        new_model = self._load(model, readonly)
+        new_fp = new_model.fingerprint()
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("fleet is not accepting a swap")
+            if new_fp == self._active_fp:
+                return new_fp
+            build = new_fp not in self._groups
+        group: List[Replica] = []
+        if build:
+            # build AND start v2 before any routing change: no request
+            # is ever admitted toward a half-loaded model
+            group = self._build_group(new_model,
+                                      n_replicas or self.n_default)
+            for rep in group:
+                rep.server.start()
+        redundant: List[Replica] = []
+        with self._lock:
+            if self._closed:
+                # a stop() raced the swap: the new group never routed
+                for rep in group:
+                    rep.server.stop(drain=False)
+                raise ServerClosed("fleet stopped during hot-swap")
+            # re-read EVERYTHING under the cutover lock: a concurrent
+            # swap may have flipped the pointer (or installed this very
+            # fingerprint) since the first check
+            old_fp = self._active_fp
+            if old_fp == new_fp:
+                redundant, group = group, []  # lost a race to an
+                old_group: List[Replica] = []  # identical swap — done
+                swap = None
+            else:
+                if new_fp in self._groups:
+                    # promote the already-routed group (add_model, or a
+                    # racing swap's install): a freshly built twin group
+                    # must not overwrite live replicas
+                    redundant, group = group, []
+                else:
+                    self._groups[new_fp] = group
+                    self._models[new_fp] = new_model
+                old_group = self._groups.pop(old_fp, [])
+                self._active_fp = new_fp
+                swap = {"from_fp": old_fp, "to_fp": new_fp,
+                        "ts": round(time.time(), 3)}
+        if redundant:
+            # never-routed servers: stop without banking (zero traffic)
+            for rep in redundant:
+                rep.server.stop(drain=False)
+        if swap is None:
+            return new_fp
+        # v1 drains OUTSIDE the lock: in-flight batches finish on v1 (a
+        # request is never split across models), new traffic is already
+        # routing to v2
+        drained = self._retire_group(old_group, drain=True,
+                                     timeout_s=drain_timeout_s)
+        swap["drained_requests"] = drained
+        with self._lock:
+            self._swaps.append(swap)
+            self._models.pop(old_fp, None)
+        return new_fp
+
+    def add_model(self, model: Union[ConsensusModel, str],
+                  n_replicas: int = 1,
+                  readonly: bool = False) -> str:
+        """Register an additional routed model (atlas-per-tissue):
+        requests addressed to its fingerprint route to its replicas; the
+        active model keeps serving unaddressed traffic."""
+        m = self._load(model, readonly)
+        fp = m.fingerprint()
+        group = self._build_group(m, n_replicas)
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("fleet is not accepting models")
+            if fp in self._groups:
+                raise ValueError(f"model {fp!r} is already in the fleet")
+            self._groups[fp] = group
+            self._models[fp] = m
+        for rep in group:
+            rep.server.start()
+        return fp
+
+    def retire_model(self, fp: str,
+                     drain_timeout_s: Optional[float] = None) -> None:
+        """Drain and remove a routed model (refuses the active one —
+        hot-swap first)."""
+        with self._lock:
+            if fp == self._active_fp:
+                raise ValueError(
+                    f"cannot retire the active model {fp!r}; hot_swap a "
+                    "replacement first"
+                )
+            group = self._groups.pop(fp, None)
+            self._models.pop(fp, None)
+        if group:
+            self._retire_group(group, drain=True,
+                               timeout_s=drain_timeout_s)
+
+    def _retire_group(self, group: List[Replica], drain: bool,
+                      timeout_s: Optional[float] = None) -> int:
+        """Stop a group's servers and bank their stats into the pool's
+        lifetime accounting (a swap loses zero evidence). Returns the
+        group's total submitted count."""
+        budget = float(timeout_s if timeout_s is not None
+                       else env_flag("SCC_FLEET_SWAP_DRAIN_S"))
+        deadline = time.monotonic() + max(budget, 0.1)
+        total = 0
+        for rep in group:
+            left = max(deadline - time.monotonic(), 0.1)
+            rep.server.stop(drain=drain, timeout_s=left)
+            sec = rep.server.stats.section()
+            samples = rep.server.stats.latency_samples()
+            total += int(sec["requests"]["submitted"])
+            with self._lock:
+                self._retired_sections.append(sec)
+                self._retired_samples.append(samples)
+        return total
+
+    # -- introspection -----------------------------------------------------
+    def active_fingerprint(self) -> str:
+        return self._active_fp
+
+    def active_model(self) -> ConsensusModel:
+        with self._lock:
+            return self._models[self._active_fp]
+
+    def fingerprints(self) -> List[str]:
+        with self._lock:
+            return sorted(self._groups)
+
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return [r for g in self._groups.values() for r in g]
+
+    # -- the validated section + the heartbeat feed ------------------------
+    def serving_section(self) -> Dict[str, Any]:
+        """The pool-level ``serving`` run-record section: per-replica
+        sections (live + retired + pool boundary) merged so the
+        accounting rule holds fleet-wide, plus the ``fleet`` subsection
+        (replica table, swap history, submitted-by-owner split). Like the
+        r15 driver, read it quiescent — mid-flight requests are counted
+        submitted but not yet resolved."""
+        with self._lock:
+            live = [r for g in self._groups.values() for r in g]
+            retired_secs = list(self._retired_sections)
+            retired_samps = list(self._retired_samples)
+            swaps = [dict(s) for s in self._swaps]
+            active = self._active_fp
+            models = {fp: len(g) for fp, g in self._groups.items() if g}
+        live_secs = [rep.server.stats.section() for rep in live]
+        live_samps = [rep.server.stats.latency_samples() for rep in live]
+        pool_sec = self._pool_stats.section()
+        sec = serve_metrics.merge_serving_sections(
+            live_secs + retired_secs + [pool_sec],
+            live_samps + retired_samps
+            + [self._pool_stats.latency_samples()],
+            window_s=time.time() - self._started_unix,
+        )
+        sec["fleet"] = {
+            # configured fleet width — the replica-keyed baseline key (a
+            # workload property, stable across stop/drain)...
+            "replicas": self.n_default,
+            # ...vs the replicas alive RIGHT NOW (0 after stop; the
+            # per_replica table below describes exactly these)
+            "live_replicas": len(live),
+            "active_fp": active,
+            "models": models,
+            "swaps": swaps,
+            "submitted_by_owner": {
+                "replicas": sum(s["requests"]["submitted"]
+                                for s in live_secs),
+                "retired": sum(s["requests"]["submitted"]
+                               for s in retired_secs),
+                "pool": pool_sec["requests"]["submitted"],
+            },
+            "per_replica": [
+                {
+                    "replica": rep.index,
+                    "model_fp": rep.model_fp,
+                    "submitted": s["requests"]["submitted"],
+                    "ok": s["requests"]["ok"],
+                    "breaker": s["breaker"]["state"],
+                    "trips": s["breaker"]["trips"],
+                    "queue_depth_peak": s["queue"]["depth_peak"],
+                    "p99_ms": (s["latency_ms"] or {}).get("p99"),
+                }
+                for rep, s in zip(live, live_secs)
+            ],
+        }
+        return sec
+
+    def _live_summary(self) -> Dict[str, Any]:
+        """One heartbeat tick (``serve.metrics.live_summary`` delegates
+        here while the pool is registered): aggregated vitals plus the
+        per-replica fleet panel tail_run renders."""
+        with self._lock:
+            live = [r for g in self._groups.values() for r in g]
+            active = self._active_fp
+        out: Dict[str, Any] = {"queue_depth": 0, "queue_cap": 0,
+                               "breaker": "closed", "ok": 0}
+        agg: Dict[str, int] = {}
+        trips_total = 0
+        merged: List[float] = []
+        reps: List[Dict[str, Any]] = []
+        for rep in live:
+            st = rep.server.stats
+            lat = st.latency_ms()
+            with st._lock:
+                depth = st.queue_depth
+                cap = st.queue_capacity
+                counts = dict(st.counts)
+                bstate = st.breaker_state
+                trips = st.breaker_trips
+            out["queue_depth"] += depth
+            out["queue_cap"] += cap
+            out["ok"] += counts["ok"]
+            if (_BREAKER_RANK.get(bstate, 0)
+                    > _BREAKER_RANK[out["breaker"]]):
+                out["breaker"] = bstate
+            trips_total += trips
+            for key in ("degraded", "quarantined", "deadline_exceeded",
+                        "failed"):
+                agg[key] = agg.get(key, 0) + counts[key]
+            agg["rejected"] = (agg.get("rejected", 0)
+                               + counts["rejected_queue"]
+                               + counts["rejected_invalid"]
+                               + counts["rejected_closed"])
+            merged.extend(st.latency_samples())
+            entry: Dict[str, Any] = {
+                "replica": rep.index,
+                "model_fp": rep.model_fp[:8],
+                "queue_depth": depth,
+                "breaker": bstate,
+            }
+            if trips:
+                entry["trips"] = trips
+            if lat.get("p99") is not None:
+                entry["p99_ms"] = lat["p99"]
+            reps.append(entry)
+        for key, v in agg.items():
+            if v:
+                out[key] = v
+        if trips_total:
+            out["breaker_trips"] = trips_total
+        if merged:
+            s = sorted(merged)
+            out["p99_ms"] = round(s[min(int(0.99 * len(s)),
+                                        len(s) - 1)], 4)
+        out["fleet"] = {"active_fp": active[:8], "replicas": reps}
+        return out

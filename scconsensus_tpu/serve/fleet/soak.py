@@ -1,0 +1,373 @@
+"""Runnable fleet-soak worker: the chaos harness's fleet workload.
+
+    python -m scconsensus_tpu.serve.fleet.soak --dir DIR [--replicas N]
+        [--requests N] [--cells M] [--seed S] [--swap-after K]
+        [--ood-requests K] [--genes G] [--clusters C] [--train T]
+        [--summary PATH] [--fresh] [--no-wire]
+
+Builds (or loads) a deterministic demo atlas model under ``DIR/model_v1``
+(and, with ``--swap-after``, a same-distribution variant under
+``DIR/model_v2`` — same training data, reseeded landmarks, different
+fingerprint), drives a replayable request set through the WIRE front
+over a :class:`ReplicaPool`, optionally hot-swaps v1→v2 mid-traffic, and
+writes one summary JSON. The exit code IS the chaos contract:
+
+  0  every wire request ended as exactly one typed outcome, the serving
+     section (wire + fleet accounting included) validates, and — in swap
+     mode — every post-swap response was served by v2 only;
+  1  the contract broke (a request vanished, validation failed, a
+     response crossed models).
+
+Because the atlas build, the request set, and classify are all seeded,
+the per-request labels are a pure function of (model, request): the
+``replay-across-replicas`` chaos plan runs the same set through 1 and N
+replicas and pins ``sha(labels)`` equal — routing must never change an
+answer.
+
+This module also owns the **atlas→query generator** the ``atlas_query``
+bench config drives (a bench config with a ledger baseline, not a
+one-off script): :func:`build_atlas_model` / :func:`make_query_batches`
+scale the same seeded gaussian-atlas shape to bench sizes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "build_atlas_model",
+    "make_query_batches",
+    "run_fleet_soak",
+    "main",
+]
+
+
+# --------------------------------------------------------------------------
+# the atlas→query generator (bench + soak share it)
+# --------------------------------------------------------------------------
+
+def _gaussian_atlas(n_genes: int, n_clusters: int, n_train: int,
+                    seed: int):
+    """Seeded well-separated gaussian atlas: (N, G) training cells,
+    per-cell labels 1..K, and the (K, G) centers queries draw from."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0.0, 4.0, size=(n_clusters, n_genes))
+    per = max(n_train // n_clusters, 1)
+    cells = np.concatenate([
+        centers[c] + rng.normal(0.0, 0.6, size=(per, n_genes))
+        for c in range(n_clusters)
+    ]).astype(np.float32)
+    labels = np.repeat(np.arange(1, n_clusters + 1), per)
+    return cells, labels, centers
+
+
+def build_atlas_model(model_dir: str, n_genes: int = 120,
+                      n_clusters: int = 4, n_train: int = 360,
+                      n_landmarks: Optional[int] = None, n_pcs: int = 8,
+                      seed: int = 7,
+                      landmark_seed: Optional[int] = None):
+    """Freeze a seeded gaussian atlas into a servable consensus model
+    through the REAL export pieces (pca_basis → landmark_ward_linkage →
+    the shared ``freeze_model_arrays`` assembly → ArtifactStore save).
+    ``landmark_seed`` reseeds only the landmark fit: same distribution,
+    different fingerprint — the hot-swap soak's v2."""
+    import jax.numpy as jnp
+
+    from scconsensus_tpu.ops.pca import pca_basis
+    from scconsensus_tpu.ops.pooling import landmark_ward_linkage
+    from scconsensus_tpu.serve.model import (
+        MODEL_STAGE,
+        _assemble,
+        freeze_model_arrays,
+    )
+    from scconsensus_tpu.utils.artifacts import ArtifactStore
+
+    cells, labels, _ = _gaussian_atlas(n_genes, n_clusters, n_train, seed)
+    panel = np.arange(n_genes, dtype=np.int64)
+    mean, comps = pca_basis(jnp.asarray(cells), min(n_pcs, n_genes))
+    mean = np.asarray(mean, np.float32)
+    comps = np.asarray(comps, np.float32)
+    emb = (cells - mean) @ comps.T
+    k = int(n_landmarks if n_landmarks
+            else np.clip(round(2.0 * np.sqrt(cells.shape[0])), 16, 512))
+    tree, assign, cents, _info = landmark_ward_linkage(
+        emb, n_landmarks=min(k, cells.shape[0]),
+        seed=seed if landmark_seed is None else int(landmark_seed),
+    )
+    arrays, meta = freeze_model_arrays(
+        panel, mean, comps, emb, cents, assign, labels, tree,
+        n_genes=n_genes, drift_margin=1.5,
+        meta_extra={"deep_split": 2, "config_fp": "fleet-atlas",
+                    "atlas": {"n_clusters": int(n_clusters),
+                              "n_train": int(cells.shape[0]),
+                              "seed": int(seed)}},
+    )
+    ArtifactStore(model_dir).save(MODEL_STAGE, arrays, meta)
+    return _assemble(arrays, meta)
+
+
+def make_query_batches(n_requests: int, cells_per: int, seed: int,
+                       n_genes: int = 120, n_clusters: int = 4,
+                       n_ood: int = 0) -> List[np.ndarray]:
+    """Replayable query workload: batches drawn around the atlas centers
+    (label transfer), the last ``n_ood`` drawn far outside (drift
+    targets). Each batch also returns with a planted majority cluster so
+    the bench can score transfer accuracy."""
+    rng = np.random.default_rng(seed + 1)
+    _, _, centers = _gaussian_atlas(n_genes, n_clusters, 4, seed)
+    out: List[np.ndarray] = []
+    for i in range(n_requests):
+        if i >= n_requests - n_ood:
+            x = rng.normal(40.0, 1.0, size=(cells_per, n_genes))
+        else:
+            c = centers[rng.integers(0, n_clusters)]
+            x = c + rng.normal(0.0, 0.6, size=(cells_per, n_genes))
+        out.append(np.asarray(x, np.float32))
+    return out
+
+
+# --------------------------------------------------------------------------
+# the soak
+# --------------------------------------------------------------------------
+
+def _fast_cfg(deadline_s: Optional[float], ledger_dir: Optional[str]):
+    from scconsensus_tpu.serve.driver import ServeConfig
+
+    return ServeConfig(
+        batch_window_s=0.001,
+        default_deadline_s=deadline_s,
+        ledger_dir=ledger_dir,
+    )
+
+
+def run_fleet_soak(workdir: str, n_requests: int = 24,
+                   cells_per: int = 16, seed: int = 7,
+                   replicas: Optional[int] = None,
+                   swap_after: Optional[int] = None,
+                   n_ood: int = 0, n_genes: int = 120,
+                   n_clusters: int = 4, n_train: int = 360,
+                   fresh: bool = False, concurrency: int = 4,
+                   deadline_s: Optional[float] = None) -> Dict[str, Any]:
+    """Drive the request set through the wire front over a replica pool;
+    returns the summary dict (see module doc). With ``swap_after``, the
+    fleet hot-swaps to the v2 model once that many requests have
+    resolved — mid-traffic, while the pumps keep pumping."""
+    import http.client
+
+    from scconsensus_tpu.obs.export import (
+        build_run_record,
+        validate_run_record,
+    )
+    from scconsensus_tpu.serve.fleet.pool import ReplicaPool
+    from scconsensus_tpu.serve.fleet.wire import WireFront
+    from scconsensus_tpu.serve.model import MODEL_STAGE
+    from scconsensus_tpu.utils.artifacts import ArtifactStore
+
+    v1_dir = os.path.join(workdir, "model_v1")
+    v2_dir = os.path.join(workdir, "model_v2")
+    built = False
+    if fresh or not ArtifactStore(v1_dir).has(MODEL_STAGE):
+        build_atlas_model(v1_dir, n_genes=n_genes, n_clusters=n_clusters,
+                          n_train=n_train, seed=seed)
+        built = True
+    if swap_after is not None and (
+            fresh or not ArtifactStore(v2_dir).has(MODEL_STAGE)):
+        build_atlas_model(v2_dir, n_genes=n_genes, n_clusters=n_clusters,
+                          n_train=n_train, seed=seed,
+                          landmark_seed=seed + 1000)
+
+    requests = make_query_batches(n_requests, cells_per, seed,
+                                  n_genes=n_genes, n_clusters=n_clusters,
+                                  n_ood=n_ood)
+    outcomes: List[Optional[Dict[str, Any]]] = [None] * len(requests)
+    label_blobs: List[bytes] = [b""] * len(requests)
+    resolved = [0]
+    swap_state: Dict[str, Any] = {"done": False, "to_fp": None}
+    lock = threading.Lock()
+    next_i = [0]
+    # swap mode reserves a TAIL of the request set until the cutover
+    # lands: "hot-swap mid-traffic" must actually observe post-swap
+    # traffic, not just in-flight survivors (the swap can outlast a small
+    # request set on a fast box)
+    swap_gate = (max(min(swap_after, len(requests)),
+                     len(requests) - max(len(requests) // 3, 2))
+                 if swap_after is not None else None)
+
+    pool = ReplicaPool(v1_dir, n_replicas=replicas,
+                       config=_fast_cfg(deadline_s, None))
+    fp1 = pool.active_fingerprint()
+    front = WireFront(pool)
+    with pool, front:
+        port = front.port
+
+        def _pump():
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=60)
+            while True:
+                with lock:
+                    if next_i[0] >= len(requests):
+                        conn.close()
+                        return
+                    i = next_i[0]
+                    if (swap_gate is not None and i >= swap_gate
+                            and not swap_state["done"]):
+                        i = None  # tail held back until the swap lands
+                    else:
+                        next_i[0] += 1
+                if i is None:
+                    time.sleep(0.002)
+                    continue
+                post_swap = bool(swap_state["done"])
+                body = json.dumps({"cells": requests[i].tolist()})
+                try:
+                    conn.request("POST", "/classify", body=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+                    r = conn.getresponse()
+                    doc = json.loads(r.read())
+                    outcomes[i] = {
+                        "i": i, "status": r.status,
+                        "outcome": doc.get("outcome"),
+                        "model_fp": doc.get("model_fp"),
+                        "post_swap": post_swap,
+                    }
+                    if doc.get("labels") is not None:
+                        label_blobs[i] = np.asarray(
+                            doc["labels"], np.int64
+                        ).tobytes()
+                except (OSError, http.client.HTTPException,
+                        json.JSONDecodeError) as e:
+                    outcomes[i] = {"i": i, "status": None,
+                                   "outcome": "wire-error",
+                                   "error": str(e)[:200],
+                                   "post_swap": post_swap}
+                    conn.close()
+                    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                      timeout=60)
+                with lock:
+                    resolved[0] += 1
+
+        threads = [threading.Thread(target=_pump, daemon=True)
+                   for _ in range(max(1, concurrency))]
+        for t in threads:
+            t.start()
+        if swap_after is not None:
+            # mid-traffic hot-swap: wait for the trigger count, cut over
+            # while the pumps keep pumping
+            while True:
+                with lock:
+                    if resolved[0] >= min(swap_after, len(requests)):
+                        break
+                time.sleep(0.002)
+            to_fp = pool.hot_swap(v2_dir)
+            swap_state["to_fp"] = to_fp
+            swap_state["done"] = True
+        for t in threads:
+            t.join(timeout=180.0)
+        section = front.serving_section()
+
+    rec = build_run_record(
+        metric="fleet soak wire p99 latency",
+        value=(section.get("latency_ms") or {}).get("p99"),
+        unit="ms",
+        extra={"config": "fleet-soak", "platform": "cpu"},
+        serving=section,
+    )
+    accounting_ok = True
+    try:
+        validate_run_record(rec)
+    except ValueError as e:
+        accounting_ok = False
+        rec = {"invalid": str(e)}
+
+    done = [o for o in outcomes if o is not None]
+    fps_seen = sorted({o["model_fp"] for o in done if o.get("model_fp")})
+    post = [o for o in done
+            if o.get("post_swap") and o.get("model_fp")]
+    post_swap_pure = all(o["model_fp"] == swap_state["to_fp"]
+                         for o in post) if swap_state["done"] else None
+    h = hashlib.sha256()
+    for blob in label_blobs:
+        h.update(blob)
+    counts: Dict[str, int] = {}
+    for o in done:
+        counts[str(o["outcome"])] = counts.get(str(o["outcome"]), 0) + 1
+    ok = (len(done) == len(requests)
+          and accounting_ok
+          and not any(o["outcome"] == "wire-error" for o in done)
+          and (post_swap_pure is not False))
+    return {
+        "ok": ok,
+        "requests": len(requests),
+        "resolved": len(done),
+        "replicas": pool.n_default,
+        "model_built": built,
+        "fp_v1": fp1,
+        "fp_v2": swap_state["to_fp"],
+        "swapped": bool(swap_state["done"]),
+        "post_swap_pure": post_swap_pure,
+        "post_swap_responses": len(post),
+        "fps_seen": fps_seen,
+        "labels_sha": h.hexdigest(),
+        "outcome_counts": counts,
+        "accounting_ok": accounting_ok,
+        "outcomes": done,
+        "record": rec,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description="fleet soak worker")
+    ap.add_argument("--dir", required=True, help="work directory")
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--cells", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--replicas", type=int, default=None)
+    ap.add_argument("--swap-after", type=int, default=None,
+                    help="hot-swap to the v2 model once this many "
+                         "requests resolved (mid-traffic)")
+    ap.add_argument("--ood-requests", type=int, default=0)
+    ap.add_argument("--genes", type=int, default=120)
+    ap.add_argument("--clusters", type=int, default=4)
+    ap.add_argument("--train", type=int, default=360)
+    ap.add_argument("--summary", default=None)
+    ap.add_argument("--fresh", action="store_true")
+    ap.add_argument("--deadline", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    summary_path = args.summary or os.path.join(args.dir,
+                                                "FLEET_SOAK_SUMMARY.json")
+    os.makedirs(args.dir, exist_ok=True)
+    summary = run_fleet_soak(
+        args.dir, n_requests=args.requests, cells_per=args.cells,
+        seed=args.seed, replicas=args.replicas,
+        swap_after=args.swap_after, n_ood=args.ood_requests,
+        n_genes=args.genes, n_clusters=args.clusters, n_train=args.train,
+        fresh=args.fresh, deadline_s=args.deadline,
+    )
+    with open(summary_path, "w") as f:
+        json.dump(summary, f, indent=1, default=str)
+    print(json.dumps({
+        "ok": summary["ok"],
+        "requests": summary["requests"],
+        "resolved": summary["resolved"],
+        "replicas": summary["replicas"],
+        "swapped": summary["swapped"],
+        "post_swap_pure": summary["post_swap_pure"],
+        "outcome_counts": summary["outcome_counts"],
+        "labels_sha": summary["labels_sha"][:16],
+    }))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,320 @@
+"""Wire front: a stdlib-only threaded HTTP server over the fleet.
+
+Every wire request resolves to **exactly one typed outcome mapped to
+exactly one status code** — the r15 accounting contract raised to the
+network layer (``serve.metrics.WireStats`` counts both sides; the run
+record's ``serving.wire`` subsection is validated):
+
+    ==================  ======  =======================================
+    outcome             status  meaning
+    ==================  ======  =======================================
+    ok                  200     labels returned (device path)
+    degraded            200     labels returned, ``degraded: true``
+                                (host fallback behind a tripped breaker)
+    quarantined         409     drift gate refused confident labels;
+                                ledgered for the reconsensus loop
+    rejected_queue      429     bounded-admission backpressure;
+                                ``Retry-After`` carries the EWMA hint
+    rejected_invalid    422     malformed body / wrong gene dimension /
+                                oversized / non-finite cells / unknown
+                                model fingerprint
+    rejected_closed     503     fleet closed or draining
+    deadline_exceeded   504     queue wait or compute overran the
+                                request deadline
+    failed              500     fatal batch error (typed RequestFailed)
+    ==================  ======  =======================================
+
+``GET /healthz`` answers 200 while the backend accepts traffic and 503
+once it is closed/unhealthy; ``GET /metrics`` returns the live summary
+(``serve.metrics.live_summary`` — the same feed the heartbeat panel
+reads, fleet panel included).
+
+``POST /classify`` accepts two bodies:
+
+* ``application/json`` — ``{"cells": [[...], ...], "deadline_s"?: s,
+  "model_fp"?: fp}`` (fp addresses a routed model in a multi-model
+  fleet);
+* ``application/x-npy`` — a raw ``.npy`` float matrix (the bulk path:
+  no JSON float inflation on big batches), with ``X-SCC-Deadline-S`` /
+  ``X-SCC-Model-FP`` headers for the extras.
+
+Responses are JSON either way; every served response carries
+``model_fp`` — the fingerprint of the model that answered, the hot-swap
+purity check's evidence.
+
+Fault site (``robust.faults``): ``wire_request`` fires on every classify
+request before admission.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import math
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from scconsensus_tpu.config import env_flag
+from scconsensus_tpu.serve import metrics as serve_metrics
+from scconsensus_tpu.serve.driver import ServeResponse
+from scconsensus_tpu.serve.errors import (
+    DeadlineExceeded,
+    QueueFull,
+    RequestFailed,
+    RequestInvalid,
+    ServerClosed,
+)
+
+__all__ = ["OUTCOME_STATUS", "WireFront"]
+
+# THE mapping (BASELINE.md "Fleet policy"): one outcome, one status code.
+OUTCOME_STATUS: Dict[str, int] = {
+    "ok": 200,
+    "degraded": 200,
+    "quarantined": 409,
+    "rejected_queue": 429,
+    "rejected_invalid": 422,
+    "rejected_closed": 503,
+    "deadline_exceeded": 504,
+    "failed": 500,
+}
+
+# Extra margin past the request deadline before the wire gives up on the
+# handle: the backend resolves typed DeadlineExceeded itself; this only
+# bounds a driver-bug hang so the socket never waits forever.
+_RESULT_SLACK_S = 30.0
+
+
+class WireFront:
+    """Threaded HTTP front over a ``ReplicaPool`` or a bare
+    ``ConsensusServer``. Use as a context manager or
+    :meth:`start`/:meth:`stop`."""
+
+    def __init__(self, backend, host: str = "127.0.0.1",
+                 port: Optional[int] = None):
+        self.backend = backend
+        self.host = host
+        self.port_requested = int(port if port is not None
+                                  else env_flag("SCC_FLEET_WIRE_PORT"))
+        self.wire_stats = serve_metrics.WireStats()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "WireFront":
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer((self.host, self.port_requested),
+                                    _WireHandler)
+        httpd.daemon_threads = True
+        httpd.front = self  # type: ignore[attr-defined]
+        self._httpd = httpd
+        self._thread = threading.Thread(target=httpd.serve_forever,
+                                        name="scc-wire", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+
+    def __enter__(self) -> "WireFront":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("wire front is not started")
+        return int(self._httpd.server_address[1])
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- record ------------------------------------------------------------
+    def serving_section(self) -> Dict[str, Any]:
+        """The backend's validated serving section with the wire-layer
+        accounting attached (``serving.wire`` — submitted == Σ outcomes
+        == Σ status codes, enforced by ``validate_serving``)."""
+        sec = self.backend.serving_section()
+        sec["wire"] = self.wire_stats.section()
+        return sec
+
+    # -- backend adapter ---------------------------------------------------
+    def _submit(self, cells: np.ndarray, deadline_s: Optional[float],
+                model_fp: Optional[str]):
+        b = self.backend
+        if hasattr(b, "hot_swap"):  # a ReplicaPool routes by fingerprint
+            return b.submit(cells, deadline_s=deadline_s,
+                            model_fp=model_fp)
+        if model_fp and model_fp != b.model.fingerprint():
+            raise RequestInvalid(
+                f"this server holds model {b.model.fingerprint()!r}, "
+                f"not {model_fp!r}"
+            )
+        return b.submit(cells, deadline_s=deadline_s)
+
+
+def _parse_deadline(dl) -> Optional[float]:
+    """A malformed deadline is a malformed REQUEST (422), not a driver
+    failure (500) — parse errors must stay in the rejected_invalid
+    bucket the status table promises."""
+    if dl is None or dl == "":
+        return None
+    try:
+        return float(dl)
+    except (TypeError, ValueError):
+        raise RequestInvalid(f"deadline_s is not a number: {dl!r}")
+
+
+def _response_body(resp: ServeResponse) -> Dict[str, Any]:
+    return {
+        "req_id": resp.req_id,
+        "outcome": resp.outcome,
+        "labels": (None if resp.labels is None
+                   else [int(v) for v in resp.labels]),
+        "degraded": bool(resp.degraded),
+        "quarantined": bool(resp.quarantined),
+        "drift_fraction": round(float(resp.drift_fraction), 6),
+        "latency_s": round(float(resp.latency_s), 6),
+        "model_fp": resp.model_fp,
+    }
+
+
+class _WireHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"  # keep-alive: bulk clients reuse sockets
+    server: ThreadingHTTPServer
+
+    # one request, one accounting entry — never stderr spam per hit
+    def log_message(self, fmt: str, *args) -> None:  # noqa: A003
+        pass
+
+    @property
+    def front(self) -> WireFront:
+        return self.server.front  # type: ignore[attr-defined]
+
+    def _send_json(self, status: int, body: Dict[str, Any],
+                   headers: Optional[Dict[str, str]] = None) -> None:
+        payload = json.dumps(body).encode()
+        self.send_response(int(status))
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        try:
+            self.wfile.write(payload)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client gone; the outcome is already accounted
+
+    # -- GET: health + metrics ---------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        path = self.path.split("?")[0]
+        if path == "/healthz":
+            closed = bool(getattr(self.front.backend, "closed", False))
+            live = serve_metrics.live_summary() or {}
+            body = {"status": "unhealthy" if closed else "ok",
+                    "breaker": live.get("breaker"),
+                    "queue_depth": live.get("queue_depth")}
+            self._send_json(503 if closed else 200, body)
+        elif path == "/metrics":
+            live = serve_metrics.live_summary()
+            self._send_json(200, live if live is not None
+                            else {"serving": "idle"})
+        else:
+            self._send_json(404, {"error": f"unknown path {path!r}"})
+
+    # -- POST: classify ----------------------------------------------------
+    def _finish_wire(self, outcome: str, status: int,
+                     body: Dict[str, Any],
+                     headers: Optional[Dict[str, str]] = None) -> None:
+        self.front.wire_stats.note(outcome, status)
+        body.setdefault("outcome", outcome)
+        self._send_json(status, body, headers)
+
+    def _parse_body(self) -> Tuple[np.ndarray, Optional[float],
+                                   Optional[str]]:
+        n = int(self.headers.get("Content-Length") or 0)
+        if n <= 0:
+            raise RequestInvalid("empty request body")
+        raw = self.rfile.read(n)
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+        if ctype == "application/x-npy":
+            try:
+                cells = np.load(io.BytesIO(raw), allow_pickle=False)
+            except ValueError as e:
+                raise RequestInvalid(f"unparseable npy payload: {e}")
+            dl = self.headers.get("X-SCC-Deadline-S")
+            fp = self.headers.get("X-SCC-Model-FP")
+            return cells, _parse_deadline(dl), (fp or None)
+        try:
+            doc = json.loads(raw)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise RequestInvalid(f"unparseable JSON body: {e}")
+        if not isinstance(doc, dict) or "cells" not in doc:
+            raise RequestInvalid('body must be {"cells": [[...], ...]}')
+        try:
+            cells = np.asarray(doc["cells"], np.float32)
+        except (TypeError, ValueError) as e:
+            raise RequestInvalid(f"cells is not a numeric matrix: {e}")
+        return cells, _parse_deadline(doc.get("deadline_s")), (
+            doc.get("model_fp") or None
+        )
+
+    def do_POST(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        path = self.path.split("?")[0]
+        if path != "/classify":
+            self._send_json(404, {"error": f"unknown path {path!r}"})
+            return
+        from scconsensus_tpu.robust import faults
+
+        front = self.front
+        try:
+            faults.fault_point("wire_request")
+            cells, deadline_s, model_fp = self._parse_body()
+            handle = front._submit(cells, deadline_s, model_fp)
+            wait = ((deadline_s
+                     if deadline_s is not None
+                     else getattr(front.backend, "config", None)
+                     and front.backend.config.default_deadline_s) or 30.0)
+            resp = handle.result(timeout=float(wait) + _RESULT_SLACK_S)
+            self._finish_wire(resp.outcome, OUTCOME_STATUS[resp.outcome],
+                              _response_body(resp))
+        except QueueFull as e:
+            self._finish_wire(
+                "rejected_queue", 429,
+                {"error": str(e),
+                 "retry_after_s": round(e.retry_after_s, 4)},
+                headers={"Retry-After":
+                         str(max(1, math.ceil(e.retry_after_s)))},
+            )
+        except RequestInvalid as e:
+            self._finish_wire("rejected_invalid", 422, {"error": str(e)})
+        except ServerClosed as e:
+            self._finish_wire("rejected_closed", 503, {"error": str(e)})
+        except DeadlineExceeded as e:
+            self._finish_wire(
+                "deadline_exceeded", 504,
+                {"error": str(e), "late_by_s": round(e.late_by_s, 4)},
+            )
+        except RequestFailed as e:
+            self._finish_wire("failed", 500,
+                              {"error": str(e),
+                               "error_class": e.error_class})
+        except Exception as e:  # noqa: BLE001
+            # the last-ditch guard: even a wire/driver bug resolves as a
+            # counted typed outcome — a socket that dies uncounted is the
+            # dropped-request failure mode one layer up
+            self._finish_wire("failed", 500,
+                              {"error": f"{type(e).__name__}: {e}"})

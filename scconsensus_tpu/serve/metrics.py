@@ -23,8 +23,12 @@ from typing import Any, Dict, List, Optional
 __all__ = [
     "OUTCOMES",
     "BREAKER_STATES",
+    "BREAKER_SEVERITY",
     "ServingStats",
+    "WireStats",
+    "merge_serving_sections",
     "active_stats",
+    "set_active_fleet",
     "live_summary",
     "validate_serving",
 ]
@@ -132,6 +136,13 @@ class ServingStats:
         with self._lock:
             self.classify_wall_s += max(float(dt), 0.0)
 
+    def latency_samples(self) -> List[float]:
+        """Copy of the raw latency ring (ms) — the fleet aggregator merges
+        per-replica rings so pool quantiles come from real samples, not
+        from averaging quantiles (which is statistically meaningless)."""
+        with self._lock:
+            return list(self._lat_ms)
+
     # -- reads -------------------------------------------------------------
     def latency_ms(self) -> Dict[str, Any]:
         with self._lock:
@@ -181,9 +192,135 @@ class ServingStats:
             }
 
 
+# -- wire-front accounting --------------------------------------------------
+
+class WireStats:
+    """HTTP-layer accounting for the fleet's wire front: every wire
+    request resolves to exactly ONE typed outcome (the same OUTCOMES
+    vocabulary the driver uses) mapped to exactly one status code. The
+    r15 accounting rule holds at the wire layer too — a wire request
+    that got a socket but no counted outcome is the dropped-request
+    failure mode all over again, one layer up."""
+
+    def __init__(self):
+        self.submitted = 0
+        self.counts: Dict[str, int] = {o: 0 for o in OUTCOMES}
+        self.status_codes: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def note(self, outcome: str, status: int) -> None:
+        if outcome not in OUTCOMES:
+            raise ValueError(f"unknown wire outcome {outcome!r}")
+        with self._lock:
+            self.submitted += 1
+            self.counts[outcome] += 1
+            key = str(int(status))
+            self.status_codes[key] = self.status_codes.get(key, 0) + 1
+
+    def section(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "requests": {"submitted": self.submitted,
+                             **dict(self.counts)},
+                "status_codes": dict(self.status_codes),
+            }
+
+
+# -- fleet aggregation ------------------------------------------------------
+
+# one severity order for every consumer (pool routing, live-panel
+# worst-state fold, merged-section breaker) — two copies of this map
+BREAKER_SEVERITY = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def _quantile_summary(samples: List[float], n_total: int,
+                      total_sum: float, mx: float) -> Dict[str, Any]:
+    if not samples or n_total <= 0:
+        return {"n": 0}
+    s = sorted(samples)
+    return {
+        "n": int(n_total),
+        "p50": round(s[min(int(0.50 * len(s)), len(s) - 1)], 4),
+        "p99": round(s[min(int(0.99 * len(s)), len(s) - 1)], 4),
+        "max": round(mx, 4),
+        "mean": round(total_sum / n_total, 4),
+    }
+
+
+def merge_serving_sections(
+    sections: List[Dict[str, Any]],
+    latency_samples: List[List[float]],
+    window_s: float,
+) -> Dict[str, Any]:
+    """Fold per-replica serving sections (live + retired + the pool's own
+    boundary stats) into ONE pool-level section the accounting rule still
+    holds over: counters sum, latency quantiles come from the merged raw
+    sample rings, the breaker reports the worst live state, and drift /
+    batch / queue evidence aggregates. Sum-of-valid-sections is valid by
+    construction: submitted and the outcome counters sum on both sides of
+    the accounting equation."""
+    req: Dict[str, int] = {"submitted": 0, **{o: 0 for o in OUTCOMES}}
+    batches = {"count": 0, "cells": 0, "max_cells": 0}
+    queue = {"depth_peak": 0, "capacity": 0}
+    breaker = {"state": "closed", "trips": 0}
+    drift = {"batches_flagged": 0, "quarantine_entries": 0}
+    consumed = classify_wall = 0.0
+    lat_n = 0
+    lat_sum = 0.0
+    lat_max = 0.0
+    for sec in sections:
+        r = sec.get("requests") or {}
+        req["submitted"] += int(r.get("submitted", 0))
+        for o in OUTCOMES:
+            req[o] += int(r.get(o, 0))
+        b = sec.get("batches") or {}
+        batches["count"] += int(b.get("count", 0))
+        batches["cells"] += int(b.get("cells", 0))
+        batches["max_cells"] = max(batches["max_cells"],
+                                   int(b.get("max_cells", 0)))
+        q = sec.get("queue") or {}
+        queue["depth_peak"] = max(queue["depth_peak"],
+                                  int(q.get("depth_peak", 0)))
+        queue["capacity"] += int(q.get("capacity", 0))
+        br = sec.get("breaker") or {}
+        if (BREAKER_SEVERITY.get(br.get("state"), 0)
+                > BREAKER_SEVERITY[breaker["state"]]):
+            breaker["state"] = br.get("state")
+        breaker["trips"] += int(br.get("trips", 0))
+        d = sec.get("drift") or {}
+        drift["batches_flagged"] += int(d.get("batches_flagged", 0))
+        drift["quarantine_entries"] += int(d.get("quarantine_entries", 0))
+        consumed += float(sec.get("consumed_s", 0.0))
+        classify_wall += float(sec.get("classify_wall_s", 0.0))
+        lat = sec.get("latency_ms") or {}
+        n = int(lat.get("n", 0))
+        lat_n += n
+        lat_sum += float(lat.get("mean", 0.0)) * n
+        lat_max = max(lat_max, float(lat.get("max", 0.0)))
+    merged = [ms for ring in latency_samples for ms in ring]
+    served = sum(req[o] for o in ("ok", "degraded", "quarantined"))
+    window_s = max(float(window_s), 0.0)
+    batches["mean_cells"] = (round(batches["cells"] / batches["count"], 2)
+                             if batches["count"] else 0.0)
+    return {
+        "requests": req,
+        "latency_ms": _quantile_summary(merged, lat_n, lat_sum, lat_max),
+        "throughput_rps": (round(served / window_s, 4)
+                           if window_s else 0.0),
+        "batches": batches,
+        "queue": queue,
+        "breaker": breaker,
+        "drift": drift,
+        "consumed_s": round(consumed, 4),
+        "classify_wall_s": round(classify_wall, 4),
+        "window_s": round(window_s, 4),
+    }
+
+
 # -- the process's active stats (heartbeat feed) ----------------------------
 
 _ACTIVE: Optional[ServingStats] = None
+_ACTIVE_FLEET = None  # () -> live-summary dict; a ReplicaPool registers it
 _ACTIVE_LOCK = threading.Lock()
 
 
@@ -193,6 +330,16 @@ def set_active(stats: Optional[ServingStats]) -> None:
         _ACTIVE = stats
 
 
+def set_active_fleet(summary_fn) -> None:
+    """Register (or clear, with None) the process's fleet live feed: a
+    zero-arg callable returning the pool-aggregated live summary. A fleet
+    wins over a single active driver in :func:`live_summary` — with a
+    pool running, per-replica stats are panel rows, not the headline."""
+    global _ACTIVE_FLEET
+    with _ACTIVE_LOCK:
+        _ACTIVE_FLEET = summary_fn
+
+
 def active_stats() -> Optional[ServingStats]:
     return _ACTIVE
 
@@ -200,7 +347,15 @@ def active_stats() -> Optional[ServingStats]:
 def live_summary() -> Optional[Dict[str, Any]]:
     """Compact serving counters for one heartbeat tick (None = no driver
     running) — queue depth, rolling p99, breaker state, and the
-    degraded/quarantined/rejected tallies tail_run's panel renders."""
+    degraded/quarantined/rejected tallies tail_run's panel renders. With
+    a fleet registered, the pool's aggregated summary (plus its
+    per-replica ``fleet`` panel) is the tick."""
+    fleet = _ACTIVE_FLEET
+    if fleet is not None:
+        try:
+            return fleet()
+        except Exception:
+            return None
     st = _ACTIVE
     if st is None:
         return None
@@ -245,7 +400,16 @@ def validate_serving(sv: Dict[str, Any]) -> None:
       were measured;
     * evidence coupling — degraded responses require a tripped breaker,
       quarantined responses require drift-flagged batches, queue
-      rejections require a bounded queue (capacity > 0).
+      rejections require a bounded queue (capacity > 0);
+    * wire accounting (fleet round, when a ``wire`` subsection is
+      present) — the SAME rule one layer up: every wire request must
+      end as exactly one typed outcome, and every outcome must have
+      produced exactly one status code;
+    * fleet coherence (when a ``fleet`` subsection is present) —
+      replicas >= 1, an active fingerprint, and the submitted-by-owner
+      split (live replicas + retired replicas + pool boundary) must sum
+      to ``requests.submitted``: a request the fleet cannot attribute to
+      an owner is a lost request wearing a disguise.
     """
     _require(isinstance(sv, dict), "must be an object")
     req = sv.get("requests")
@@ -310,3 +474,78 @@ def validate_serving(sv: Dict[str, Any]) -> None:
     if tp is not None:
         _require(isinstance(tp, (int, float)) and tp >= 0,
                  "throughput_rps must be a number >= 0")
+    wire = sv.get("wire")
+    if wire is not None:
+        _require(isinstance(wire, dict), "wire must be an object")
+        wreq = wire.get("requests") or {}
+        wsub = wreq.get("submitted")
+        _require(isinstance(wsub, int) and wsub >= 0,
+                 "wire.requests.submitted must be an int >= 0")
+        wtotal = 0
+        for o in OUTCOMES:
+            v = wreq.get(o, 0)
+            _require(isinstance(v, int) and v >= 0,
+                     f"wire.requests.{o} must be an int >= 0")
+            wtotal += v
+        _require(
+            wtotal == wsub,
+            f"wire accounting broken: submitted={wsub} but outcomes sum "
+            f"to {wtotal} — every wire request must end as exactly one "
+            f"typed outcome",
+        )
+        codes = wire.get("status_codes") or {}
+        _require(isinstance(codes, dict),
+                 "wire.status_codes must be an object")
+        ctotal = sum(int(v) for v in codes.values())
+        _require(
+            ctotal == wsub,
+            f"wire status-code accounting broken: submitted={wsub} but "
+            f"status codes sum to {ctotal} — every typed outcome maps to "
+            f"exactly one status code",
+        )
+        # NOTE: wire submitted may legitimately EXCEED serving
+        # submitted — a malformed body (422) is refused before it can
+        # reach admission accounting; both layers stay internally
+        # consistent, which is the rule that matters.
+    fleet = sv.get("fleet")
+    if fleet is not None:
+        _require(isinstance(fleet, dict), "fleet must be an object")
+        nrep = fleet.get("replicas")
+        _require(isinstance(nrep, int) and nrep >= 1,
+                 "fleet.replicas (configured width) must be an "
+                 "int >= 1")
+        _require(isinstance(fleet.get("active_fp"), str)
+                 and fleet["active_fp"],
+                 "fleet.active_fp must be a non-empty string")
+        live = fleet.get("live_replicas")
+        _require(isinstance(live, int) and live >= 0,
+                 "fleet.live_replicas must be an int >= 0")
+        per = fleet.get("per_replica")
+        _require(isinstance(per, list) and len(per) == live,
+                 "fleet.per_replica must list exactly "
+                 "fleet.live_replicas entries")
+        owners = fleet.get("submitted_by_owner")
+        _require(isinstance(owners, dict),
+                 "fleet.submitted_by_owner must be an object")
+        osum = 0
+        for part in ("replicas", "retired", "pool"):
+            v = owners.get(part, 0)
+            _require(isinstance(v, int) and v >= 0,
+                     f"fleet.submitted_by_owner.{part} must be an "
+                     f"int >= 0")
+            osum += v
+        _require(
+            osum == sub,
+            f"fleet ownership accounting broken: submitted={sub} but "
+            f"owners (replicas+retired+pool) sum to {osum} — every "
+            f"request must be attributable to exactly one owner",
+        )
+        swaps = fleet.get("swaps", [])
+        _require(isinstance(swaps, list), "fleet.swaps must be a list")
+        for i, sw in enumerate(swaps):
+            _require(isinstance(sw, dict) and sw.get("from_fp")
+                     and sw.get("to_fp"),
+                     f"fleet.swaps[{i}] must carry from_fp and to_fp")
+            _require(sw.get("from_fp") != sw.get("to_fp"),
+                     f"fleet.swaps[{i}]: a swap onto the SAME "
+                     f"fingerprint is not a swap")

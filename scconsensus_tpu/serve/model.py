@@ -76,6 +76,7 @@ class ConsensusModel:
     drift_threshold: float         # distance beyond which a cell is foreign
     meta: Dict[str, Any]
     _dev: Optional[tuple] = dataclasses.field(default=None, repr=False)
+    _fp: Optional[str] = dataclasses.field(default=None, repr=False)
 
     # -- derived -----------------------------------------------------------
     @property
@@ -94,14 +95,17 @@ class ConsensusModel:
         """Short content hash of the decision surface (panel + basis +
         centroids + labels): two servers answering from the same
         fingerprint answer identically — the kill-and-restart durability
-        test pins this."""
-        import hashlib
+        test pins this. Memoized: the fleet stamps it on every response
+        (the hot-swap purity check), and the arrays are frozen."""
+        if self._fp is None:
+            import hashlib
 
-        h = hashlib.sha256()
-        for a in (self.panel_idx, self.pca_mean, self.pca_components,
-                  self.centroids, self.centroid_labels):
-            h.update(np.ascontiguousarray(a).tobytes())
-        return h.hexdigest()[:16]
+            h = hashlib.sha256()
+            for a in (self.panel_idx, self.pca_mean, self.pca_components,
+                      self.centroids, self.centroid_labels):
+                h.update(np.ascontiguousarray(a).tobytes())
+            self._fp = h.hexdigest()[:16]
+        return self._fp
 
     # -- classify ----------------------------------------------------------
     def _gather_panel(self, cells: np.ndarray) -> np.ndarray:

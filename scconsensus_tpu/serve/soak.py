@@ -59,13 +59,20 @@ def _demo_training_set(seed: int):
     return np.asarray(cells.T, np.float32), labels, centers
 
 
-def build_demo_model(model_dir: str, seed: int = 7):
+def build_demo_model(model_dir: str, seed: int = 7,
+                     landmark_seed: Optional[int] = None):
     """Deterministic demo model through the REAL export path pieces
     (pca_basis → landmark_ward_linkage → the shared
     ``freeze_model_arrays`` assembly → ArtifactStore save), without
     running the full DE pipeline — the soak exercises the serving
     layer, not DE, and the shared freezer keeps the artifact schema
-    from drifting between this and ``export_consensus_model``."""
+    from drifting between this and ``export_consensus_model``.
+
+    ``landmark_seed`` reseeds ONLY the landmark fit: same training
+    distribution, different centroids → a different fingerprint that
+    still classifies the same request set — the fleet hot-swap soak's
+    "v2" (swapping to a different-distribution model would read every
+    in-flight request as drift)."""
     import jax.numpy as jnp
 
     from scconsensus_tpu.ops.pca import pca_basis
@@ -85,7 +92,8 @@ def build_demo_model(model_dir: str, seed: int = 7):
     comps = np.asarray(comps, np.float32)
     emb = (cells - mean) @ comps.T
     tree, assign, cents, _info = landmark_ward_linkage(
-        emb, n_landmarks=_LANDMARKS, seed=seed
+        emb, n_landmarks=_LANDMARKS,
+        seed=seed if landmark_seed is None else int(landmark_seed),
     )
     arrays, meta = freeze_model_arrays(
         panel, mean, comps, emb, cents, assign, labels, tree,

@@ -796,7 +796,8 @@ class TestTooling:
         assert {"serve_device", "serve_batch",
                 "artifact:consensus_model"} <= sites
         for _, rules, mode, _ in chaos_run.SERVE_SOAK_MATRIX:
-            assert mode in ("soak", "refusal", "kill-restart")
+            assert mode in ("soak", "refusal", "kill-restart",
+                            "fleet-swap", "fleet-replay")
             for r in rules:
                 assert r["class"] in chaos_run_fault_classes()
 

@@ -88,7 +88,13 @@ SOAK_MATRIX: List[Tuple[str, List[Dict[str, Any]], bool, bool]] = [
 # expectations), "refusal" (corrupt-model plan: the load must refuse
 # typed, with the artifact quarantined), "kill-restart" (SIGKILL
 # mid-batch, then a restart over the same frozen model must replay the
-# reference request set to IDENTICAL labels).
+# reference request set to IDENTICAL labels), "fleet-swap" (round 16:
+# hot-swap mid-traffic through the wire front — zero accounting loss
+# and no request served by a half-loaded model: every post-swap
+# response carries the v2 fingerprint, every response carries exactly
+# one known fingerprint), "fleet-replay" (round 16: the same request
+# set through 1 vs N replicas must produce the IDENTICAL label sha —
+# routing must never change an answer).
 SERVE_SOAK_MATRIX: List[Tuple[str, List[Dict[str, Any]], str,
                               Dict[str, Any]]] = [
     ("serve-transient-device",
@@ -107,7 +113,42 @@ SERVE_SOAK_MATRIX: List[Tuple[str, List[Dict[str, Any]], str,
     ("serve-kill-mid-batch",
      [{"site": "serve_batch", "class": "kill", "after": 1}],
      "kill-restart", {}),
+    ("swap-under-load", [], "fleet-swap",
+     {"replicas": 3, "swap_after_frac": 0.33}),
+    ("replay-across-replicas", [], "fleet-replay", {"replicas": 3}),
 ]
+
+
+def _fleet_worker(workdir: str, timeout_s: float, n_requests: int,
+                  extra_args: Optional[List[str]] = None,
+                  summary_name: str = "FLEET_SOAK_SUMMARY.json",
+                  ) -> Tuple[int, Optional[Dict[str, Any]]]:
+    """One fleet-soak worker subprocess; returns (rc, summary|None)."""
+    summary_path = os.path.join(workdir, summary_name)
+    try:
+        os.remove(summary_path)
+    except OSError:
+        pass
+    env = dict(os.environ)
+    env.pop("SCC_FAULT_PLAN", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, "-m", "scconsensus_tpu.serve.fleet.soak",
+           "--dir", workdir, "--requests", str(n_requests),
+           "--summary", summary_path] + list(extra_args or [])
+    try:
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=timeout_s, cwd=_REPO)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        return 124, None
+    if rc != 0 and proc.stderr:
+        for ln in proc.stderr.strip().splitlines()[-4:]:
+            print(f"[fleet-soak] {ln}", file=sys.stderr)
+    try:
+        with open(summary_path) as f:
+            return rc, json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return rc, None
 
 
 def _serve_worker(workdir: str, plan_path: Optional[str],
@@ -164,7 +205,73 @@ def run_serve_plan(name: str, rules: List[Dict[str, Any]], mode: str,
     def _left() -> float:
         return max(deadline - time.monotonic(), 1.0)
 
-    if mode == "refusal":
+    if mode == "fleet-swap":
+        # hot-swap mid-traffic through the wire front: the swap IS the
+        # chaos — no fault plan needed
+        n_fleet = max(int(n_requests), 12)
+        swap_after = max(int(n_fleet * float(
+            extra.get("swap_after_frac", 0.33))), 1)
+        rc, summary = _fleet_worker(
+            workdir, _left(), n_fleet,
+            ["--fresh", "--replicas", str(extra.get("replicas", 3)),
+             "--swap-after", str(swap_after)],
+        )
+        sv = ((summary or {}).get("record") or {}).get("serving") or {}
+        fps = set((summary or {}).get("fps_seen") or [])
+        known = {(summary or {}).get("fp_v1"),
+                 (summary or {}).get("fp_v2")}
+        checks.append(("worker exited 0 (wire+fleet accounting held, "
+                       "serving section validated)", rc == 0))
+        checks.append(("zero accounting loss across the swap",
+                       bool(summary) and summary.get("resolved")
+                       == summary.get("requests")
+                       and summary.get("accounting_ok") is True))
+        checks.append(("hot-swap actually happened mid-traffic",
+                       bool(summary and summary.get("swapped")
+                            and summary.get("post_swap_responses"))))
+        checks.append((
+            "no request served by a half-loaded model (every response "
+            "carries exactly one known fingerprint)",
+            bool(fps) and fps <= known,
+        ))
+        checks.append(("post-swap requests served by v2 only",
+                       bool(summary)
+                       and summary.get("post_swap_pure") is True))
+        checks.append((
+            "swap recorded in the fleet section",
+            len((sv.get("fleet") or {}).get("swaps") or []) >= 1,
+        ))
+    elif mode == "fleet-replay":
+        # same request set through 1 vs N replicas: identical label sha
+        reps = int(extra.get("replicas", 3))
+        rc1, s1 = _fleet_worker(
+            workdir, _left(), n_requests,
+            ["--fresh", "--replicas", "1", "--summary",
+             os.path.join(workdir, "REPLAY_R1.json")],
+            summary_name="REPLAY_R1.json",
+        )
+        rc2, s2 = _fleet_worker(
+            workdir, _left(), n_requests,
+            ["--replicas", str(reps), "--summary",
+             os.path.join(workdir, f"REPLAY_R{reps}.json")],
+            summary_name=f"REPLAY_R{reps}.json",
+        )
+        checks.append(("1-replica run clean", rc1 == 0 and bool(s1)
+                       and s1.get("ok")))
+        checks.append((f"{reps}-replica run clean",
+                       rc2 == 0 and bool(s2) and s2.get("ok")))
+        checks.append((
+            f"replayed request set through 1 vs {reps} replicas "
+            "produced identical label sha",
+            bool(s1) and bool(s2)
+            and s1.get("labels_sha") == s2.get("labels_sha"),
+        ))
+        checks.append((
+            "both runs answered from the SAME frozen model",
+            bool(s1) and bool(s2)
+            and s1.get("fp_v1") == s2.get("fp_v1"),
+        ))
+    elif mode == "refusal":
         rc, summary = _serve_worker(
             workdir, plan_path, _left(), n_requests,
             ["--fresh", "--expect-refusal"],

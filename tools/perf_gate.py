@@ -180,13 +180,16 @@ def _report(verdict: regress.GateVerdict, drifts: List[Dict[str, Any]],
                 line += f"  (+{tv.excess_bytes:,}B past band)"
             print(line)
         for sv in verdict.serving:
+            gated = sv.metric.startswith(("p99_ms", "throughput_rps"))
             mark = "REGRESSED" if sv.regressed else (
-                "ok" if sv.metric == "p99_ms" else "info")
-            line = (f"  serve {sv.metric:<20} {sv.value_ms:>9.3f}ms "
-                    f"baseline {sv.baseline_ms:.3f}ms "
-                    f"± {sv.band_ms:.3f}ms  {mark}")
+                "ok" if gated else "info")
+            u = getattr(sv, "unit", "ms")
+            line = (f"  serve {sv.metric:<20} {sv.value_ms:>9.3f}{u} "
+                    f"baseline {sv.baseline_ms:.3f}{u} "
+                    f"± {sv.band_ms:.3f}{u}  {mark}")
             if sv.regressed:
-                line += f"  (+{sv.excess_ms:.3f}ms past band)"
+                sign = "-" if u == "rps" else "+"
+                line += f"  ({sign}{sv.excess_ms:.3f}{u} past band)"
             print(line)
         for d in drifts:
             state = "acknowledged" if d["acknowledged"] else "UNACKNOWLEDGED"
@@ -387,6 +390,37 @@ def _smoke(fixtures: str, as_json: bool) -> int:
         and not any(s.regressed for s in verdict_sv.stages)
         and not any(t.regressed for t in verdict_sv.transfers),
     ))
+    # fleet gate (round 16, replica-count-keyed baselines): a fleet
+    # candidate whose single-replica p99 is CLEAN but whose aggregate
+    # throughput collapsed must fail on the fleet throughput verdict
+    # alone — tail latency and fleet throughput are independent
+    # regressions
+    verdict_fl, _ = run_gate(
+        os.path.join(fixtures, "candidate_fleet_regressed.json"),
+        evidence,
+    )
+    flreg = verdict_fl.serving_regressions
+    checks.append((
+        "fleet candidate with regressed throughput and clean p99 fails "
+        "on the replica-keyed fleet verdict alone",
+        (not verdict_fl.ok)
+        and any(s.metric.startswith("throughput_rps@r") for s in flreg)
+        and not any(s.metric.startswith("p99") for s in flreg)
+        and not any(s.regressed for s in verdict_fl.stages)
+        and not any(t.regressed for t in verdict_fl.transfers),
+    ))
+    # ...and its serving section carries validated wire + fleet
+    # accounting (wire submitted == Σ outcomes == Σ status codes; the
+    # submitted-by-owner split sums) — run_gate's validation enforced it
+    fl = _load_json(
+        os.path.join(fixtures, "candidate_fleet_regressed.json")
+    ).get("serving") or {}
+    checks.append((
+        "fleet candidate carries wire + fleet accounting",
+        bool((fl.get("wire") or {}).get("status_codes"))
+        and bool((fl.get("fleet") or {}).get("submitted_by_owner")),
+    ))
+
     # a serving section that lost a request is a SCHEMA violation, not a
     # gateable record (the accounting rule is the serve contract);
     # scratch file goes to a temp dir — the committed fixture tree may

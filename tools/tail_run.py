@@ -310,6 +310,30 @@ def render(lines: List[Dict[str, Any]],
             if sv.get("failed"):
                 bits.append(f"failed {sv['failed']}")
             out.append("  serving: " + "   ".join(bits))
+            fl = sv.get("fleet") or {}
+            if fl:
+                # fleet heartbeat panel (round 16): per-replica queue
+                # depth / rolling p99 / breaker state plus the ACTIVE
+                # model fingerprint — which model is answering, and
+                # which replica is drowning, at a glance
+                reps = fl.get("replicas") or []
+                out.append(f"  fleet: active model "
+                           f"{fl.get('active_fp', '?')}"
+                           f"   {len(reps)} replica(s)")
+                for r in reps:
+                    rbits = [f"r{r.get('replica', '?')}",
+                             f"model {r.get('model_fp', '?')}",
+                             f"queue {r.get('queue_depth', 0)}"]
+                    if r.get("p99_ms") is not None:
+                        rbits.append(f"p99 {r['p99_ms']:.1f}ms")
+                    rstate = r.get("breaker", "closed")
+                    rbits.append(
+                        ("BREAKER " if rstate != "closed"
+                         else "breaker ") + rstate
+                        + (f" ({r['trips']} trip(s))"
+                           if r.get("trips") else "")
+                    )
+                    out.append("    " + "   ".join(rbits))
     if st["stall"]:
         sl = st["stall"]
         out.append(f"  STALL #{sl.get('stalls')} at +{_fmt_dur((sl.get('ts') or 0) - float((st['header'] or {}).get('ts') or 0))}"
