@@ -45,7 +45,8 @@ from scconsensus_tpu.ops.multipletests import bh_adjust, bh_adjust_masked
 from scconsensus_tpu.ops.seurat_tests import bimod_lrt_pairs, welch_t_pairs
 from scconsensus_tpu.ops.wilcoxon import EXACT_N_LIMIT, wilcoxon_exact_host
 
-__all__ = ["PairwiseDEResult", "pairwise_de", "filter_clusters", "de_gene_union"]
+__all__ = ["PairwiseDEResult", "pairwise_de", "filter_clusters",
+           "de_gene_union", "streaming_wilcox_block"]
 
 
 @dataclasses.dataclass
@@ -1139,6 +1140,38 @@ def _run_wilcox_device(
                         )
                 log_p = log_p.at[rows].set(jnp.asarray(lp_small))
     return log_p, u_stat
+
+
+def streaming_wilcox_block(
+    block,
+    cell_idx_of: List[np.ndarray],
+    pair_i: np.ndarray,
+    pair_j: np.ndarray,
+    mesh=None,
+    probe_out: Optional[Dict] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Rank-sum log-p / U for ONE disk chunk's gene rows — the
+    out-of-core runner's per-shard entry (stream.runner, round 17).
+
+    ``block`` is a (Gb, N) CSR slab holding ALL cells for a gene window
+    (exactly what a ChunkedCSRStore chunk is), so the full window ladder
+    — nnz-compacted windows, zero-block decomposition, R's exact branch
+    for small pairs — runs per chunk with the SAME per-gene outputs the
+    in-memory engine produces for those rows: rank tests are per-gene,
+    so chunking the gene axis changes nothing but peak memory. Returns
+    DEVICE arrays (log_p (P, Gb), u (P, Gb)); the caller owns the
+    single batched fetch (its declared ``stream_block_fetch`` crossing)
+    and the durable per-chunk store.
+
+    Exists as a named seam (rather than the runner poking
+    ``_run_wilcox_device`` directly) so the streaming layer's contract
+    with the engine is one auditable function whose signature the
+    engine owns.
+    """
+    return _run_wilcox_device(
+        block, cell_idx_of, pair_i, pair_j, exact="auto", mesh=mesh,
+        jdata=None, probe_out=probe_out,
+    )
 
 
 def _run_wilcox(

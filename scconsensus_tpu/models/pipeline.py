@@ -100,6 +100,21 @@ def refine(
     from scconsensus_tpu.obs import residency as obs_residency
     from scconsensus_tpu.obs.kernels import KernelCapture
 
+    # Out-of-core routing (round 17): a disk-resident ChunkedCSRStore is
+    # a first-class input — the full pipeline runs chunk-at-a-time under
+    # the host-memory budget (stream.runner), with per-shard durable
+    # progress instead of whole-stage artifacts. One entry point, two
+    # residency regimes.
+    from scconsensus_tpu.stream.store import ChunkedCSRStore
+
+    if isinstance(data, ChunkedCSRStore):
+        from scconsensus_tpu.stream.runner import streaming_refine
+
+        return streaming_refine(
+            data, labels, config, gene_names=gene_names,
+            stage_dir=config.artifact_dir, timer=timer,
+        )
+
     # fresh robustness trail for this run (robust.record): stage-boundary
     # retries, ladder degradations, mid-stage resume points, and any
     # SCC_FAULT_PLAN injections all land on result.metrics["robustness"]

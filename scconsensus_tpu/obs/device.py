@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
     "memory_snapshot",
+    "host_rss_bytes",
     "host_peak_rss_bytes",
     "install_compile_listener",
     "compile_mark",
@@ -80,8 +81,55 @@ def memory_snapshot(device=None) -> Optional[Dict[str, int]]:
         return None
 
 
+# cached /proc/self/statm fd (+ owning pid, so a fork re-opens) and page
+# size: the heartbeat sampler reads this EVERY tick while the run thread
+# may be hogging the GIL — a naive open/read/close is ~8 GIL bounces,
+# each costing a switch-interval wait under contention (measured ~50 ms
+# wall per call next to a busy Python loop); one pread is one bounce.
+# Lock-guarded: the sampler thread and the budget accountant's charge()
+# path race here, and an unguarded cache could close an fd the other
+# thread is mid-pread on.
+_STATM = {"fd": None, "pid": None, "page": None}
+_STATM_LOCK = threading.Lock()
+
+
+def host_rss_bytes() -> Optional[int]:
+    """CURRENT resident set size of this process (``/proc/self/statm`` on
+    Linux; falls back to the peak where /proc is unavailable). The
+    instantaneous twin of :func:`host_peak_rss_bytes` — the heartbeat
+    stream carries both, so a live view shows where RSS *is* while the
+    streaming budget assertion and the run record read the same
+    peak-since-start number."""
+    try:
+        import os
+
+        with _STATM_LOCK:
+            pid = os.getpid()
+            if _STATM["fd"] is None or _STATM["pid"] != pid:
+                fd = os.open("/proc/self/statm", os.O_RDONLY)
+                old = _STATM["fd"]
+                _STATM["fd"], _STATM["pid"] = fd, pid
+                if old is not None:
+                    try:
+                        os.close(old)
+                    except OSError:
+                        pass
+            if _STATM["page"] is None:
+                _STATM["page"] = os.sysconf("SC_PAGE_SIZE")
+            # procfs regenerates content per read; pread needs no seek
+            return int(os.pread(_STATM["fd"], 128, 0).split()[1]) \
+                * _STATM["page"]
+    except Exception:
+        return host_peak_rss_bytes()
+
+
 def host_peak_rss_bytes() -> Optional[int]:
-    """Peak resident set size of this process (ru_maxrss is KiB on Linux)."""
+    """Peak resident set size of this process since start (ru_maxrss is
+    KiB on Linux). This — not the instantaneous RSS — is the number a
+    bounded-memory claim must be judged by: a spike between two heartbeat
+    ticks is invisible to sampling but not to the kernel's high-water
+    mark, so the streaming budget evidence (stream.budget) and the
+    tail_run panel both read THIS accessor."""
     try:
         import resource
         import sys

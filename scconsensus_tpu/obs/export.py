@@ -66,6 +66,16 @@ schema ``scc-run-record`` version 1 — top-level keys:
                     serve.metrics.validate_serving — a section whose
                     outcome counters do not sum to its submissions
                     (a lost request) is rejected.
+  streaming         OPTIONAL (still schema version 1 — additive): the
+                    out-of-core trail (stream.record) — chunk counters
+                    (planned/fresh/resumed/recomputed/quarantined), the
+                    window-halving and checkpoint-granularity ladders,
+                    and the host-memory budget evidence (peak RSS vs
+                    SCC_STREAM_HOST_BUDGET_MB). Validated by
+                    stream.record.validate_streaming — a section
+                    claiming within_budget without peak-RSS evidence
+                    (or with the peak over the budget), or whose chunk
+                    counts do not sum, is rejected.
 
 The Chrome trace export (:func:`chrome_trace`) converts the span tree to
 ``traceEvents`` complete ("X") events — open the file in Perfetto
@@ -137,6 +147,7 @@ def build_run_record(
     kernels: Optional[Dict[str, Any]] = None,
     robustness: Optional[Dict[str, Any]] = None,
     serving: Optional[Dict[str, Any]] = None,
+    streaming: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One schema-v1 run record. Pass ``tracer`` to take spans + compile
     stats from it; or pre-built ``spans`` (e.g. a resumed pipeline's
@@ -147,7 +158,8 @@ def build_run_record(
     obs.residency transfer audit and the obs.kernels device-op
     timeline; ``robustness`` (optional) attaches the robust.record
     fault/retry/resume trail; ``serving`` (optional) attaches the
-    serve.metrics online-serving section."""
+    serve.metrics online-serving section; ``streaming`` (optional)
+    attaches the stream.record out-of-core section."""
     if spans is None:
         spans = tracer.span_records() if tracer is not None else []
     extra = dict(extra or {})
@@ -185,6 +197,8 @@ def build_run_record(
         rec["robustness"] = robustness
     if serving is not None:
         rec["serving"] = serving
+    if streaming is not None:
+        rec["streaming"] = streaming
     return rec
 
 
@@ -291,6 +305,12 @@ def validate_run_record(rec: Dict[str, Any]) -> None:
         from scconsensus_tpu.serve.metrics import validate_serving
 
         validate_serving(sv)
+    sm = rec.get("streaming")
+    if sm is not None:
+        # jax-free import (stream.record is stdlib-only by contract)
+        from scconsensus_tpu.stream.record import validate_streaming
+
+        validate_streaming(sm)
 
 
 # --------------------------------------------------------------------------

@@ -397,6 +397,21 @@ class Ledger:
                 # keyed baselines (p99@rN, throughput@rN) read it —
                 # absent means the bare r15 driver (keys as r1)
                 entry["serving"]["replicas"] = nrep
+        sm = rec.get("streaming")
+        if isinstance(sm, dict) and sm:
+            # out-of-core summary on the index (round 17): the perf
+            # gate's peak-RSS baselines (regress.streaming_baselines)
+            # read the manifest, not N record files — like stage_walls
+            ch = sm.get("chunks") or {}
+            bud = sm.get("budget") or {}
+            entry["streaming"] = {
+                "chunks_planned": ch.get("planned"),
+                "chunks_completed": ch.get("completed"),
+                "chunks_resumed": ch.get("resumed"),
+                "peak_rss_mb": bud.get("peak_rss_mb"),
+                "limit_mb": bud.get("limit_mb"),
+                "within_budget": bool(bud.get("within_budget")),
+            }
         fp = (rec.get("extra") or {}).get("numeric_fingerprint")
         if isinstance(fp, dict) and fp:
             # every ingested run is fingerprint-stamped on its manifest

@@ -63,6 +63,10 @@ from scconsensus_tpu.obs.export import (
     build_run_record,
     write_json_atomic,
 )
+# stdlib-only by contract (like robust.record): imported at module level
+# so the sampler's per-tick streaming-panel check is one attribute read,
+# not per-tick import machinery under a contended GIL
+from scconsensus_tpu.stream import record as stream_record
 
 __all__ = [
     "LiveRecorder",
@@ -230,6 +234,19 @@ class LiveRecorder:
         global _ACTIVE
         if not self.enabled or self._thread is not None:
             return self
+        # warm the per-tick panel modules NOW, on the caller's thread:
+        # a first-tick lazy-import storm on the sampler thread costs
+        # ~0.9 s of GIL-contended wall next to a busy run thread
+        # (measured), which is a missed tick and a fat CPU bill charged
+        # to the sampler's own overhead budget
+        for mod in ("scconsensus_tpu.obs.quality",
+                    "scconsensus_tpu.obs.residency",
+                    "scconsensus_tpu.robust.record",
+                    "scconsensus_tpu.serve.metrics"):
+            try:
+                __import__(mod)
+            except Exception:
+                pass
         os.makedirs(os.path.dirname(os.path.abspath(self.hb_path)) or ".",
                     exist_ok=True)
         self._f = open(self.hb_path, "a", buffering=1)
@@ -434,7 +451,16 @@ class LiveRecorder:
             "open_spans": open_spans,
             "spans_done": spans_done,
             "stalls": self.stall_count,
-            "rss_bytes": obs_device.host_peak_rss_bytes(),
+            # BOTH gauges ride every tick: rss_bytes is the instantaneous
+            # value (where memory is NOW), rss_peak_bytes the kernel
+            # high-water mark since process start — the number the
+            # streaming budget assertion (stream.budget) and the run
+            # record's bounded-memory evidence are judged by, so the
+            # tail_run panel and the gate read the SAME quantity. (The
+            # pre-r17 stream carried ru_maxrss under the rss_bytes name —
+            # a spike-blind live view and a mislabeled peak at once.)
+            "rss_bytes": obs_device.host_rss_bytes(),
+            "rss_peak_bytes": obs_device.host_peak_rss_bytes(),
         }
         if metrics:
             hb["metrics"] = metrics
@@ -470,6 +496,16 @@ class LiveRecorder:
             rs = robust_record.live_summary()
             if rs:
                 hb["robust"] = rs
+        except Exception:
+            pass
+        try:
+            # streaming panel: chunks completed/planned, staged bytes,
+            # window halvings, peak RSS vs the host budget — an
+            # out-of-core run's vitals tick by tick, and a SIGKILLed
+            # ingest's LAST heartbeat says which chunk was durable
+            sm = stream_record.live_summary()
+            if sm:
+                hb["streaming"] = sm
         except Exception:
             pass
         try:

@@ -39,10 +39,12 @@ __all__ = [
     "StageVerdict",
     "TransferVerdict",
     "ServingVerdict",
+    "StreamingVerdict",
     "GateVerdict",
     "stage_baselines",
     "stage_transfer_baselines",
     "serving_baselines",
+    "streaming_baselines",
     "diff_span_trees",
     "gate_record",
     "DRIFT_LEDGER_NAME",
@@ -73,6 +75,14 @@ ABS_NOISE_FLOOR_BYTES = 64 << 10
 # hide — with a 1 ms absolute floor for sub-ms baselines.
 SERVE_REL_NOISE_FLOOR = 0.25
 ABS_NOISE_FLOOR_MS = 1.0
+# Streaming peak-RSS bands (BASELINE.md streaming policy, round 17):
+# the kernel high-water mark moves with allocator/page-cache luck, so
+# 15 % relative / 64 MB absolute floors — wide enough that GC timing
+# can't false-fail, narrow enough that a leaked chunk window (2× peak)
+# cannot hide. A peak-RSS regression is a MEMORY regression: the
+# quantity the whole out-of-core design exists to bound.
+STREAM_REL_NOISE_FLOOR = 0.15
+ABS_NOISE_FLOOR_MB = 64.0
 
 
 # --------------------------------------------------------------------------
@@ -217,6 +227,34 @@ def serving_baselines(history: Sequence[Dict[str, Any]]
     }
 
 
+def streaming_baselines(history: Sequence[Dict[str, Any]]
+                        ) -> Dict[str, Dict[str, float]]:
+    """Peak-RSS baselines from manifest entries' ledger-stamped
+    ``streaming`` summaries (obs.ledger ingest). Same median-of-≤3
+    machinery, STREAMING floors (15 % / 64 MB), partials excluded;
+    entries without a streaming stamp simply don't anchor."""
+    from scconsensus_tpu.obs.ledger import is_partial_entry
+
+    series: Dict[str, List[float]] = {}
+    for e in history:
+        if is_partial_entry(e):
+            continue
+        v = (e.get("streaming") or {}).get("peak_rss_mb")
+        if isinstance(v, (int, float)) and v >= 0:
+            series.setdefault("peak_rss_mb", []).append(float(v))
+    return {
+        metric: {
+            "baseline_mb": round(b["baseline"], 3),
+            "band_mb": round(b["band"], 3),
+            "spread_mb": round(b["spread"], 3),
+            "n": b["n"],
+        }
+        for metric, b in _banded_baselines(
+            series, ABS_NOISE_FLOOR_MB, rel_floor=STREAM_REL_NOISE_FLOOR
+        ).items()
+    }
+
+
 # --------------------------------------------------------------------------
 # span-tree diff (name the offender)
 # --------------------------------------------------------------------------
@@ -328,6 +366,24 @@ class ServingVerdict:
 
 
 @dataclasses.dataclass
+class StreamingVerdict:
+    """Out-of-core memory verdict (candidate streaming section's peak
+    RSS vs the key's ledger-stamped baselines) — a peak-RSS blowout is
+    a first-class regression even when every wall is green, because
+    bounded memory IS the streaming contract."""
+
+    metric: str                    # "peak_rss_mb"
+    value_mb: float
+    baseline_mb: float
+    band_mb: float
+    regressed: bool
+    excess_mb: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
 class GateVerdict:
     ok: bool
     key: Dict[str, str]
@@ -349,6 +405,11 @@ class GateVerdict:
     serving: List[ServingVerdict] = dataclasses.field(
         default_factory=list
     )
+    # out-of-core peak-RSS verdicts (empty when the candidate carried no
+    # streaming section or the key has no streaming history)
+    streaming: List[StreamingVerdict] = dataclasses.field(
+        default_factory=list
+    )
 
     @property
     def regressions(self) -> List[StageVerdict]:
@@ -361,6 +422,10 @@ class GateVerdict:
     @property
     def serving_regressions(self) -> List[ServingVerdict]:
         return [s for s in self.serving if s.regressed]
+
+    @property
+    def streaming_regressions(self) -> List[StreamingVerdict]:
+        return [s for s in self.streaming if s.regressed]
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -379,6 +444,10 @@ class GateVerdict:
             "serving": [s.to_dict() for s in self.serving],
             "serving_regressions": [
                 s.to_dict() for s in self.serving_regressions
+            ],
+            "streaming": [s.to_dict() for s in self.streaming],
+            "streaming_regressions": [
+                s.to_dict() for s in self.streaming_regressions
             ],
         }
 
@@ -547,14 +616,35 @@ def gate_record(candidate: Dict[str, Any],
                 if svv.regressed:
                     svv.excess_ms = round(floor_rps - float(tp), 4)
                 serving.append(svv)
+    # streaming gate (round 17): the candidate's peak RSS vs the key's
+    # ledger-stamped streaming baselines — bounded memory is the
+    # out-of-core contract, so a 2× peak with clean walls still fails.
+    streaming: List[StreamingVerdict] = []
+    cand_sm = candidate.get("streaming") or {}
+    peak = (cand_sm.get("budget") or {}).get("peak_rss_mb")
+    if isinstance(peak, (int, float)):
+        smbase = streaming_baselines(history).get("peak_rss_mb")
+        if smbase is not None:
+            limit_mb = smbase["baseline_mb"] + smbase["band_mb"]
+            smv = StreamingVerdict(
+                metric="peak_rss_mb", value_mb=round(float(peak), 3),
+                baseline_mb=smbase["baseline_mb"],
+                band_mb=smbase["band_mb"],
+                regressed=float(peak) > limit_mb,
+            )
+            if smv.regressed:
+                smv.excess_mb = round(float(peak) - limit_mb, 3)
+            streaming.append(smv)
     ok = (not any(s.regressed for s in stages)
           and not any(t.regressed for t in transfers)
-          and not any(s.regressed for s in serving))
+          and not any(s.regressed for s in serving)
+          and not any(s.regressed for s in streaming))
     return GateVerdict(ok=ok, key=key, n_history=len(history),
                        stages=stages, note=note,
                        n_partial_excluded=n_partial,
                        candidate_termination=cand_term,
-                       transfers=transfers, serving=serving)
+                       transfers=transfers, serving=serving,
+                       streaming=streaming)
 
 
 # --------------------------------------------------------------------------

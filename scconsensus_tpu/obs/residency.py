@@ -86,6 +86,8 @@ __all__ = [
     "validate_residency",
     "consumed_cpu_s",
     "reset_cpu",
+    "add_transfer_listener",
+    "remove_transfer_listener",
 ]
 
 MODES = ("off", "audit", "enforce")
@@ -171,6 +173,15 @@ BOUNDARIES: Dict[str, str] = {
         "SCC_ROBUST_DE_CKPT — durability bought with a declared, "
         "store-gated crossing, never a silent one."
     ),
+    "stream_block_fetch": (
+        "Out-of-core streaming (round 17, stream.runner): each disk "
+        "chunk's per-shard results — the (P, Gc) rank-sum block, the "
+        "(Gc, K) aggregate slab — fetch to host for the resumable "
+        "stage store, and each chunk's compacted windows stage h2d "
+        "through the shared input_staging path. Load → device → drop "
+        "is the streaming contract; this boundary is the declared "
+        "drop side, sized per-chunk by construction."
+    ),
     "obs_internal": (
         "Measurement infrastructure's own O(1) transfers: tracer drain "
         "sentinels, sentinel-count fetches. Auto-attributed when the "
@@ -185,6 +196,23 @@ _CPU = {"s": 0.0}
 _LOCK = threading.Lock()
 _ACTIVE: "Optional[ResidencyAuditor]" = None
 _TLS = threading.local()
+# transfer listeners: fn(direction, nbytes, boundary) called on every
+# recorded event (stream.budget's host-budget accountant registers one)
+_LISTENERS: List[Any] = []
+
+
+def add_transfer_listener(fn) -> None:
+    """Register ``fn(direction, nbytes, boundary)`` to observe every
+    transfer the active auditor records. Idempotent per function."""
+    if fn not in _LISTENERS:
+        _LISTENERS.append(fn)
+
+
+def remove_transfer_listener(fn) -> None:
+    try:
+        _LISTENERS.remove(fn)
+    except ValueError:
+        pass
 
 
 def consumed_cpu_s() -> float:
@@ -473,6 +501,19 @@ class ResidencyAuditor:
                     })
                 else:
                     self.events_dropped += 1
+            # transfer listeners (round 17): the streaming budget
+            # accountant subscribes here, so the SAME events the audit
+            # records also feed the host-budget ledger — staged bytes the
+            # auditor saw cross at input_staging are bytes the accountant
+            # can prove left the host side. Listener errors never kill a
+            # transfer (budget breaches raise from the accountant's own
+            # charge() calls, where the caller can recover — not from
+            # inside arbitrary third-party staging).
+            for fn in tuple(_LISTENERS):
+                try:
+                    fn(direction, int(nbytes), bound)
+                except Exception:
+                    pass
             if self.mode == "enforce" and bound is None:
                 bad = (direction == "d2h"
                        or nbytes >= self.enforce_h2d_bytes)

@@ -254,6 +254,7 @@ def landmark_pool(
     c: float = 2.0,
     k_min: int = 512,
     k_max: int = 4096,
+    charge=None,
 ) -> Tuple[np.ndarray, np.ndarray, Dict]:
     """Pool rows of x (N, d) onto k ≪ N landmarks: sketch-fitted device
     Lloyd + one full blocked assignment pass.
@@ -265,6 +266,13 @@ def landmark_pool(
     A device-resident input stays resident: padding/reshaping and the
     sketch/init gathers are jnp ops, so the only crossings are the one h2d
     staging of a HOST input and the (k, d) + (N,) results coming back.
+
+    ``charge(nbytes, what)`` (optional): the out-of-core runner's budget
+    accountant hook — called with the staging footprint BEFORE the
+    device upload, so a streaming run's host-memory ledger prices the
+    landmark fit's (N, d) staging like every other buffer (a breach
+    raises typed HostBudgetExceeded here, before the allocation, rather
+    than OOMing mid-Lloyd).
     """
     n, d = x.shape
     k = int(n_landmarks) if n_landmarks else landmark_k_policy(
@@ -281,6 +289,10 @@ def landmark_pool(
     from scconsensus_tpu.obs.trace import span as obs_span
 
     _note_pool_build()
+    if charge is not None:
+        # (N, d) f32 staging + the padded block view: the dominant host
+        # cost of the fit/assign pass, priced before it exists
+        charge(int(n) * int(d) * 4, "landmark_staging")
     nb = (n + _LLOYD_BLOCK - 1) // _LLOYD_BLOCK
     pad = nb * _LLOYD_BLOCK - n
     snb = (s + _LLOYD_BLOCK - 1) // _LLOYD_BLOCK
@@ -329,6 +341,7 @@ def landmark_ward_linkage(
     linkage: str = "exact",
     knn_k: int = 15,
     mesh=None,
+    charge=None,
 ) -> Tuple[HClustTree, np.ndarray, np.ndarray, Dict]:
     """Landmark recluster tree: occupancy-weighted Ward.D2 over the
     landmark centroids of :func:`landmark_pool`.
@@ -349,7 +362,7 @@ def landmark_ward_linkage(
         )
     cent, assign, info = landmark_pool(
         x, n_landmarks=n_landmarks, sketch=sketch, n_iter=n_iter,
-        seed=seed, c=c, k_min=k_min, k_max=k_max,
+        seed=seed, c=c, k_min=k_min, k_max=k_max, charge=charge,
     )
     counts = np.bincount(assign, minlength=cent.shape[0]).astype(np.float64)
     with obs_span("landmark_linkage", k=int(cent.shape[0])):

@@ -31,7 +31,15 @@ front's classify handler, before admission), ``fleet_route`` (the
 pool's shared admission layer, before a replica is picked) and
 ``fleet_swap`` (the start of a hot-swap, before v2 loads) — a fault
 there must surface as a typed, counted wire outcome, never a dead
-socket.
+socket. The out-of-core STREAMING layer (round 17) registers three
+disk-axis sites: ``stream_chunk_write`` (each chunk/stage write of the
+ChunkedCSRStore — where ``kill`` plans prove mid-ingest durability and
+``disk`` plans prove the ENOSPC degradation ladder),
+``stream_chunk_read`` (each chunk load — torn-chunk plans ride the
+generic ``artifact:stream_chunk`` corrupt site instead, since the
+corruption happens post-write), and ``stream_stage`` (the streaming
+runner's per-stage boundary, the ``stage:<name>`` analog of the
+out-of-core pipeline).
 
 Fault classes and what they do at a compute site:
 
@@ -52,6 +60,10 @@ Fault classes and what they do at a compute site:
              store calls :func:`corrupt_artifact` after a successful
              write, which truncates or bit-flips the file on disk — the
              checksum/quarantine path's test vector
+  disk       raise :class:`InjectedDiskFault` (message carries the exact
+             ``ENOSPC``/``No space left on device`` text a full
+             filesystem raises) — the streaming layer's disk-class
+             test vector (stream.store, robust.retry "disk")
 
 With ``SCC_FAULT_PLAN`` unset every entry point is a single registry
 lookup returning immediately — the zero-fault overhead contract.
@@ -72,6 +84,7 @@ __all__ = [
     "InjectedResourceExhausted",
     "InjectedTransientError",
     "InjectedDeviceLoss",
+    "InjectedDiskFault",
     "fault_point",
     "corrupt_artifact",
     "active",
@@ -79,7 +92,7 @@ __all__ = [
 ]
 
 FAULT_CLASSES = ("oom", "transient", "kill", "stall", "corrupt",
-                 "device_loss")
+                 "device_loss", "disk")
 
 
 class InjectedFault(Exception):
@@ -100,6 +113,13 @@ class InjectedDeviceLoss(InjectedFault):
     """Mimics a lost/preempted accelerator device (the XLA runtime
     stringifies these as FAILED_PRECONDITION/INTERNAL errors naming the
     device)."""
+
+
+class InjectedDiskFault(InjectedFault):
+    """Mimics a disk fault (ENOSPC by default — the message carries the
+    exact ``No space left on device`` strerror text a real full
+    filesystem raises, so the classifier sees what the OS would say).
+    The out-of-core streaming layer's test vector (stream.store)."""
 
 
 # plan cache: (path, mtime) -> parsed plan; hit counters reset on reload
@@ -215,6 +235,11 @@ def fault_point(site: str) -> None:
             raise InjectedDeviceLoss(
                 f"FAILED_PRECONDITION: device lost: injected device "
                 f"preemption at {site} (SCC_FAULT_PLAN)"
+            )
+        if fclass == "disk":
+            raise InjectedDiskFault(
+                f"ENOSPC: No space left on device: injected disk fault "
+                f"at {site} (SCC_FAULT_PLAN)"
             )
         if fclass == "kill":
             import signal

@@ -7,7 +7,12 @@ embed stage, the pipeline's stage-boundary recovery — runs through
 
   1. classify the exception: ``transient`` (backend/RPC hiccup — retry
      as-is), ``resource`` (allocation failure — run the caller's
-     ``degrade`` hook, then retry), ``device_lost`` (a lost/preempted
+     ``degrade`` hook, then retry), ``disk`` (round 17 — ENOSPC/EIO,
+     torn or checksum-failed chunks/artifacts: the ``degrade`` hook runs
+     too, because the right retry is a *different* write — sweep
+     reclaimable files, shrink checkpoint granularity — while the
+     quarantine machinery has already isolated anything torn),
+     ``device_lost`` (a lost/preempted
      device or a mesh whose device set no longer exists — run the
      caller's ``on_device_loss`` hook, which rebuilds the mesh on
      survivors (robust.elastic), then retry; without a hook the class
@@ -46,7 +51,7 @@ __all__ = [
     "default_policy",
 ]
 
-ERROR_CLASSES = ("transient", "resource", "device_lost", "fatal")
+ERROR_CLASSES = ("transient", "resource", "disk", "device_lost", "fatal")
 
 # Message fragments, lowercase. Matched against str(exc) / raw text; the
 # XLA runtime stringifies device failures with their gRPC-style status
@@ -61,6 +66,21 @@ _TRANSIENT_PAT = (
     "unavailable", "deadline_exceeded", "deadline exceeded", "aborted",
     "connection reset", "connection refused", "broken pipe", "timed out",
     "transient", "socket closed", "internal: failed to connect",
+)
+# Disk-fault signatures (round 17, the out-of-core streaming layer):
+# what the OS and the artifact layer actually say when the DISK — not the
+# device, not the allocator — failed: ENOSPC/EIO strerror text, and the
+# artifact/chunk checksum layer's torn-write diagnoses. Classified as
+# their own class because the right adaptation is disk-shaped (sweep
+# reclaimable files, shrink checkpoint granularity, quarantine-and-
+# recompute the torn chunk) — neither a mesh rebuild nor an HBM degrade
+# helps a full filesystem.
+_DISK_PAT = (
+    "enospc", "no space left on device",
+    "input/output error", "disk i/o error",
+    "read-only file system",
+    "checksum mismatch", "torn chunk", "unparseable npz",
+    "sidecar unreadable",
 )
 # Device-loss signatures: what the XLA/PJRT runtime actually prints when
 # a chip dies or is preempted mid-program, plus the JAX-level errors a
@@ -83,10 +103,12 @@ _DEVICE_LOST_PAT = (
 
 
 def classify_text(text: Optional[str]) -> Optional[str]:
-    """'device_lost' | 'resource' | 'transient' | None (no signature
-    recognized) for raw text — stderr tails, TUNNEL_LOG probe errors,
-    heartbeat post-mortems. Device-loss wins over everything (a dead chip
-    often also prints UNAVAILABLE, and only a mesh rebuild helps);
+    """'device_lost' | 'disk' | 'resource' | 'transient' | None (no
+    signature recognized) for raw text — stderr tails, TUNNEL_LOG probe
+    errors, heartbeat post-mortems. Device-loss wins over everything (a
+    dead chip often also prints UNAVAILABLE, and only a mesh rebuild
+    helps); disk wins over resource/transient (an ENOSPC strerror also
+    says "error", and retrying a full filesystem unchanged loops);
     resource wins over transient (degrading is the safer adaptation — a
     transient retry of a genuinely too-big shape loops)."""
     if not text:
@@ -94,6 +116,8 @@ def classify_text(text: Optional[str]) -> Optional[str]:
     low = str(text).lower()
     if any(p in low for p in _DEVICE_LOST_PAT):
         return "device_lost"
+    if any(p in low for p in _DISK_PAT):
+        return "disk"
     if any(p in low for p in _RESOURCE_PAT):
         return "resource"
     if any(p in low for p in _TRANSIENT_PAT):
@@ -103,13 +127,19 @@ def classify_text(text: Optional[str]) -> Optional[str]:
 
 def classify_exception(exc: BaseException) -> str:
     """Error class of an exception: type first (MemoryError, the injected
-    fault types), then message text, else fatal."""
+    fault types, OSError errno for the disk family), then message text,
+    else fatal."""
     if isinstance(exc, faults.InjectedDeviceLoss):
         return "device_lost"
+    if isinstance(exc, faults.InjectedDiskFault):
+        return "disk"
     if isinstance(exc, (MemoryError, faults.InjectedResourceExhausted)):
         return "resource"
     if isinstance(exc, faults.InjectedTransientError):
         return "transient"
+    if isinstance(exc, OSError) and getattr(exc, "errno", None) in (
+            28, 5, 30):  # ENOSPC, EIO, EROFS — the disk family by number
+        return "disk"
     if isinstance(exc, (ConnectionError, TimeoutError)):
         return "transient"
     return classify_text(f"{type(exc).__name__}: {exc}") or "fatal"
@@ -201,7 +231,12 @@ class RetryPolicy:
                         # the adaptation IS the recovery here: shrink the
                         # mesh onto survivors before re-entering the stage
                         on_device_loss(attempt)
-                    elif degrade is not None and err_class == "resource":
+                    elif degrade is not None and err_class in ("resource",
+                                                               "disk"):
+                        # both classes demand a DIFFERENT retry: resource
+                        # frees memory, disk frees/shrinks what it writes
+                        # (sweep reclaimable files, coarsen checkpoint
+                        # granularity) — the caller's hook knows which
                         degrade(attempt)
                     time.sleep(backoff)
                 attempt += 1

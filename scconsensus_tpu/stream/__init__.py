@@ -1,0 +1,48 @@
+"""Out-of-core streaming execution (round 17, ROADMAP item 5).
+
+The 10M-cell layer: disk-resident chunked CSR input
+(:class:`~scconsensus_tpu.stream.store.ChunkedCSRStore`), a hard
+host-memory budget (:class:`~scconsensus_tpu.stream.budget.
+HostBudgetAccountant`), and a per-shard refine pipeline
+(:func:`~scconsensus_tpu.stream.runner.streaming_refine`) whose every
+stage operates chunk-at-a-time with durable, checksummed progress — a
+SIGKILL mid-run resumes from the last fsynced chunk to byte-identical
+labels, a torn chunk quarantines and recomputes, ENOSPC degrades
+checkpoint granularity before failing typed, and a budget breach halves
+the streaming window.
+
+Import discipline: this ``__init__`` re-exports lazily so jax-free
+consumers (``validate_run_record`` → ``stream.record``) never pull the
+compute stack in.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "ChunkedCSRStore",
+    "ChunkCorrupt",
+    "HostBudgetAccountant",
+    "HostBudgetExceeded",
+    "streaming_refine",
+    "validate_streaming",
+]
+
+
+def __getattr__(name):
+    if name in ("ChunkedCSRStore", "ChunkCorrupt"):
+        from scconsensus_tpu.stream import store as _m
+
+        return getattr(_m, name)
+    if name in ("HostBudgetAccountant", "HostBudgetExceeded"):
+        from scconsensus_tpu.stream import budget as _m
+
+        return getattr(_m, name)
+    if name == "streaming_refine":
+        from scconsensus_tpu.stream.runner import streaming_refine
+
+        return streaming_refine
+    if name == "validate_streaming":
+        from scconsensus_tpu.stream.record import validate_streaming
+
+        return validate_streaming
+    raise AttributeError(name)

@@ -1,0 +1,830 @@
+"""Out-of-core streaming refine: the full pipeline over disk chunks.
+
+``streaming_refine(store, labels, config)`` runs DE → union → embed →
+tree → cuts → silhouette → nodg against a :class:`ChunkedCSRStore`
+under a hard host-memory budget (stream.budget): chunks load → compute
+→ drop, every per-shard result lands in a resumable ArtifactStore stage
+keyed by content, and a SIGKILL at ANY point resumes from the last
+durable chunk to byte-identical labels.
+
+Per-shard strategy (why chunking the GENE axis is exact, not
+approximate):
+
+  * **DE** — rank tests, gates, and BH are per-gene: each chunk's
+    (Gb, N) CSR slab runs the SAME window ladder as the in-memory
+    engine (de.engine.streaming_wilcox_block) and produces the same
+    per-gene columns; per-cluster aggregates are gene-sliced sums
+    accumulated chunk-at-a-time. The (P, G) statistics are small (P
+    pairs, not N cells) and assemble on host.
+  * **embed** — two regimes under one budget. When the dense (N, |U|)
+    cell matrix fits the staged budget, the SAME randomized subspace
+    iteration as the in-memory pipeline runs on the same bytes — the
+    embedding, and therefore every downstream label, is BIT-identical
+    to ``refine()``'s (the mid-size ARI==1.0 pin measures exactly
+    this). Past the budget the run degrades (recorded) to the
+    (|U|, |U|) gene-space Gram eigenbasis computed from the union
+    rows' sparse slab: no dense (N, |U|) ever exists, the scores come
+    from one sparse-times-dense product, and the result is
+    deterministic per seed (resumes and reruns reproduce bit-for-bit)
+    though its noise-subspace basis differs from the randomized one.
+  * **tree / cuts / silhouette** — the r12 landmark engine already
+    splits sketch-fit from full assign; above the landmark threshold
+    the fit sees a budget-priced sketch and cut labels propagate via
+    the blocked device assign. Below the thresholds the exact branches
+    run unchanged (identity with ``refine()`` by construction).
+  * **nodg** — per-cell detected-gene counts accumulate over chunks.
+
+Recovery ladders (all typed, all recorded on the robustness trail):
+a ``HostBudgetExceeded`` halves the streaming gene window (floor 1 row,
+then the typed error propagates); a disk-class stage-checkpoint write
+failure doubles the checkpoint granularity (fewer, coarser durability
+points — trading resume granularity for disk) before failing typed; a
+torn chunk quarantines and recomputes through the store's generator.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from scconsensus_tpu.config import ReclusterConfig
+from scconsensus_tpu.stream.budget import (
+    HostBudgetAccountant,
+    HostBudgetExceeded,
+)
+from scconsensus_tpu.stream.store import ChunkedCSRStore
+from scconsensus_tpu.stream import record as stream_record
+from scconsensus_tpu.utils.artifacts import ArtifactStore
+from scconsensus_tpu.utils.logging import StageTimer, get_logger
+
+__all__ = ["streaming_refine"]
+
+
+def _labels_sha(labels) -> str:
+    # hash the unicode array's raw buffer (dtype stamped, since the
+    # UCS4 width depends on the longest label): one O(N) pass, no
+    # per-cell Python strings — a .tolist()+join here would spike
+    # hundreds of MB of host objects inside the bounded-memory layer
+    lab = np.ascontiguousarray(np.asarray(labels).astype(str))
+    h = hashlib.sha256(str(lab.dtype).encode())
+    h.update(lab.tobytes())
+    return h.hexdigest()[:16]
+
+
+def _chunk_key(i: int, g0: int, g1: int, n_cells: int, groups_sha: str
+               ) -> str:
+    """Content-addressed per-chunk DE stage name: rows + cell-group
+    fingerprint, so a resume with different labels/subsampling can never
+    adopt the wrong block."""
+    h = hashlib.sha256(
+        f"{i}:{g0}:{g1}:{n_cells}:{groups_sha}".encode()
+    ).hexdigest()[:16]
+    return f"stream_de_{h}"
+
+
+class _StreamState:
+    """One run's mutable streaming bookkeeping (window ladder, checkpoint
+    granularity, resume counts) — what the validated section is built
+    from at the end."""
+
+    def __init__(self, window_rows: int):
+        self.window_initial = int(window_rows)
+        self.window_rows = int(window_rows)
+        self.halvings = 0
+        self.ckpt_initial = 1
+        self.ckpt_every = 1
+        self.de_resumed = 0
+
+    def halve_window(self, why: str) -> None:
+        from scconsensus_tpu.robust import record as robust_record
+
+        if self.window_rows <= 1:
+            raise HostBudgetExceeded(
+                "staged", 0, 0, 0,
+                f"window ladder floor reached (1 row) — {why}",
+            )
+        self.window_rows = max(self.window_rows // 2, 1)
+        self.halvings += 1
+        robust_record.note_degradation(
+            "stream_stage", "halve-window",
+            f"{why}; streaming window now {self.window_rows} rows",
+        )
+
+    def coarsen_ckpt(self, why: str) -> None:
+        from scconsensus_tpu.robust import record as robust_record
+
+        self.ckpt_every *= 2
+        robust_record.note_degradation(
+            "stream_stage", "shrink-ckpt-granularity",
+            f"{why}; per-chunk checkpoints now every "
+            f"{self.ckpt_every} chunk(s)",
+        )
+
+
+def streaming_refine(
+    store: ChunkedCSRStore,
+    labels: Sequence,
+    config: ReclusterConfig,
+    gene_names: Optional[Sequence[str]] = None,
+    stage_dir: Optional[str] = None,
+    accountant: Optional[HostBudgetAccountant] = None,
+    regen: Optional[Callable[[int, int], Any]] = None,
+    timer: Optional[StageTimer] = None,
+):
+    """Run the refine pipeline out-of-core against ``store``.
+
+    ``stage_dir`` (default ``<store.root>/stages``) holds the resumable
+    per-shard progress; ``regen(g0, g1)`` regenerates quarantined
+    chunks (the synthetic benches pass their seeded generator; real
+    ingested data without one fails typed on a torn chunk).
+    Returns a :class:`~scconsensus_tpu.models.pipeline.ReclusterResult`
+    whose ``metrics`` additionally carry the validated ``streaming``
+    section. Only the fast wilcox DE path is supported out-of-core
+    (``config.method`` must be ``wilcox``) — the NB/edgeR family holds
+    per-pair cell slabs the disk format does not shard yet.
+    """
+    import jax.numpy as jnp
+
+    from scconsensus_tpu.robust import record as robust_record
+    from scconsensus_tpu.robust import retry as robust_retry
+
+    if config.method.lower() not in ("wilcox",):
+        raise NotImplementedError(
+            f"streaming_refine supports method='wilcox' only (got "
+            f"{config.method!r}) — the NB/edgeR path is not sharded "
+            "out-of-core yet"
+        )
+    robust_record.begin_run()
+    logger = get_logger()
+    timer = timer or StageTimer(logger)
+    G, N = store.shape
+    lab = np.asarray(labels).astype(str)
+    if lab.size != N:
+        raise ValueError(
+            f"labels have {lab.size} entries for a {N}-cell chunk store"
+        )
+
+    stages = ArtifactStore(stage_dir or f"{store.root.rstrip('/')}/stages")
+    state = _StreamState(store.row_window)
+    acct = accountant or HostBudgetAccountant()
+    run_log = robust_record.current_run()
+
+    groups_sha = _labels_sha(lab) + f":{config.min_cluster_size}" \
+        f":{config.min_cells_group}:{config.max_cells_per_ident}" \
+        f":{config.random_seed}"
+    stages.check_config(config.to_json(), inputs={
+        "stream_manifest": {k: store.manifest()[k] for k in
+                            ("n_genes", "n_cells", "row_window")},
+        "groups_sha": groups_sha,
+    })
+    # retry-budget persistence: same kill-proof ratchet as the in-memory
+    # pipeline (a killed streaming run must not resurrect with a fresh
+    # retry allowance)
+    try:
+        _, rb_meta = stages.load("robust_state")
+        if rb_meta.get("budget_used"):
+            run_log.restore_budget(int(rb_meta["budget_used"]))
+    except ValueError:
+        pass
+    run_log.set_budget_persist(
+        lambda used: stages.save("robust_state",
+                                 meta={"budget_used": used})
+    )
+
+    def _guard(fn, site="stream_stage", degrade=None):
+        return robust_retry.call(fn, site, degrade=degrade)
+
+    with acct:
+        result = _streaming_impl(
+            store, lab, config, gene_names, timer, stages, state, acct,
+            regen, _guard, groups_sha,
+        )
+
+    # -- the validated streaming section ---------------------------------
+    c = store.counters
+    completed = c["fresh"] + c["resumed"]
+    bud = acct.budget_fields()
+    section = stream_record.build_streaming_section(
+        planned=store.n_chunks, fresh=c["fresh"], resumed=c["resumed"],
+        recomputed=c["recomputed"], quarantined=c["quarantined"],
+        window_initial=state.window_initial,
+        window_final=state.window_rows, halvings=state.halvings,
+        ckpt_initial=state.ckpt_initial, ckpt_final=state.ckpt_every,
+        limit_mb=bud["limit_mb"], stage_limit_mb=bud["stage_limit_mb"],
+        baseline_rss_mb=bud["baseline_rss_mb"],
+        peak_rss_mb=bud["peak_rss_mb"],
+        peak_staged_mb=bud["peak_staged_mb"],
+        complete=(completed == store.n_chunks),
+    )
+    stream_record.validate_streaming(section)  # the emitter self-checks
+    result.metrics["streaming"] = section
+    rb = robust_record.section()
+    if rb is not None:
+        result.metrics["robustness"] = rb
+    try:
+        stages.save("robust_state", meta={"budget_used": 0})
+    except Exception:
+        pass
+    return result
+
+
+def _gram_pca_streamed(store, union, acct, n_pcs: int,
+                       load_part) -> np.ndarray:
+    """Fully-streamed PCA via the (|U|, |U|) gene-space Gram matrix:
+    eigenvectors of the centered Gram ARE the principal axes, the Gram
+    accumulates from PAIRWISE chunk joins (two chunks' union rows in
+    memory at a time — never the whole slab), and the (N, p) scores
+    accumulate chunk-at-a-time from one sparse-times-dense product per
+    chunk. The only O(N) buffer is the scores array, budget-charged.
+    Deterministic (LAPACK eigh + a fixed sign convention), so resumes
+    and reruns reproduce bit-for-bit. IO cost: the union-bearing chunks
+    load O(u_chunks) times each for the joins — the price of the
+    degraded path, paid only when the dense embed would bust the
+    budget."""
+    n_cells = store.shape[1]
+    u = int(np.asarray(union).size)
+    with_rows = []
+    for i in range(store.n_chunks):
+        g0, g1 = store.chunk_rows(i)
+        uni = np.asarray(union)
+        if np.any((uni >= g0) & (uni < g1)):
+            with_rows.append(i)
+    gram = np.zeros((u, u), np.float64)
+    msum = np.zeros(u, np.float64)
+    for ai, a in enumerate(with_rows):
+        xa, sel_a = load_part(a)
+        acct.charge(xa.data.nbytes * 3, "gram_join")
+        try:
+            msum[sel_a] = np.asarray(xa.sum(axis=1), np.float64).ravel()
+            gram[np.ix_(sel_a, sel_a)] = (xa @ xa.T).toarray()
+            for b in with_rows[ai + 1:]:
+                xb, sel_b = load_part(b)
+                blockc = np.asarray((xa @ xb.T).toarray(), np.float64)
+                gram[np.ix_(sel_a, sel_b)] = blockc
+                gram[np.ix_(sel_b, sel_a)] = blockc.T
+                del xb
+        finally:
+            acct.release(xa.data.nbytes * 3, "gram_join")
+            del xa
+    m = msum / n_cells
+    gram -= n_cells * np.outer(m, m)
+    evals, evecs = np.linalg.eigh(gram)
+    order = np.argsort(evals)[::-1][:n_pcs]
+    v = evecs[:, order]
+    # deterministic sign convention (eigh signs are arbitrary):
+    # largest-|loading| component positive
+    flip = v[np.argmax(np.abs(v), axis=0), np.arange(v.shape[1])] < 0
+    v[:, flip] *= -1.0
+    v32 = np.ascontiguousarray(v, np.float32)
+    acct.charge(n_cells * n_pcs * 4, "scores")
+    scores = np.zeros((n_cells, n_pcs), np.float32)
+    for a in with_rows:
+        xa, sel_a = load_part(a)
+        try:
+            scores += np.asarray(xa.T.dot(v32[sel_a]), np.float32)
+        finally:
+            del xa
+    return scores - (m @ v).astype(np.float32)[None, :]
+
+
+def _chunk_aggregates(block, cid: np.ndarray, K: int) -> Dict[str, Any]:
+    """Per-cluster sufficient statistics of one (Gb, N) CSR slab as
+    nnz-bound host scatter-adds — no (N, K) one-hot ever materializes
+    (at 10M cells that one-hot alone would eat the whole budget)."""
+    gb = block.shape[0]
+    data, indices, indptr = block.data, block.indices, block.indptr
+    rows = np.repeat(np.arange(gb, dtype=np.int64), np.diff(indptr))
+    k = cid[indices]
+    m = k >= 0
+    rows, k, vals = rows[m], k[m], data[m].astype(np.float64)
+    out = {
+        "sum_log": np.zeros((gb, K), np.float64),
+        "sum_expm1": np.zeros((gb, K), np.float64),
+        "sum_sq": np.zeros((gb, K), np.float64),
+        "nnz": np.zeros((gb, K), np.float64),
+    }
+    np.add.at(out["sum_log"], (rows, k), vals)
+    np.add.at(out["sum_expm1"], (rows, k), np.expm1(vals))
+    np.add.at(out["sum_sq"], (rows, k), vals * vals)
+    np.add.at(out["nnz"], (rows, k), (vals > 0).astype(np.float64))
+    return out
+
+
+def _streaming_impl(store, lab, config, gene_names, timer, stages, state,
+                    acct, regen, _guard, groups_sha):
+    import jax
+    import jax.numpy as jnp
+
+    from scconsensus_tpu.de.engine import (
+        _all_pairs,
+        filter_clusters,
+        de_gene_union,
+        streaming_wilcox_block,
+        PairwiseDEResult,
+    )
+    from scconsensus_tpu.models.pipeline import ReclusterResult
+    from scconsensus_tpu.obs import residency
+    from scconsensus_tpu.obs.live import active_recorder
+    from scconsensus_tpu.ops.colors import labels_to_colors
+    from scconsensus_tpu.ops.gates import ClusterAggregates, pair_gates_fast
+    from scconsensus_tpu.ops.linkage import HClustTree, ward_linkage
+    from scconsensus_tpu.ops.multipletests import bh_adjust_masked
+    from scconsensus_tpu.ops.treecut import cutree_hybrid
+    from scconsensus_tpu.robust import record as robust_record
+    from scconsensus_tpu.stream.store import ChunkCorrupt
+
+    logger = timer.logger
+    G, N = store.shape
+
+    # ---- cluster groups (host, O(N)) -----------------------------------
+    with timer.stage("cluster_filter"):
+        names, cell_idx = filter_clusters(
+            lab, config.min_cluster_size, config.drop_grey
+        )
+        K = len(names)
+        if K < 2:
+            raise ValueError(
+                f"need >= 2 clusters above min_cluster_size="
+                f"{config.min_cluster_size}, got {K}"
+            )
+        cell_idx_of = [np.nonzero(cell_idx == k)[0].astype(np.int32)
+                       for k in range(K)]
+        if config.max_cells_per_ident is not None:
+            rng = np.random.default_rng(config.random_seed)
+            cap = config.max_cells_per_ident
+            cell_idx_of = [
+                rng.choice(ci, size=cap, replace=False)
+                if ci.size > cap else ci for ci in cell_idx_of
+            ]
+        pair_i, pair_j = _all_pairs(K)
+        P = int(pair_i.size)
+        n_of = np.array([ci.size for ci in cell_idx_of], np.int32)
+        pair_ok = (n_of[pair_i] >= config.min_cells_group) & (
+            n_of[pair_j] >= config.min_cells_group
+        )
+        skip_reasons = [
+            f"{names[i]} vs {names[j]}: group sizes ({n_of[i]}, {n_of[j]})"
+            f" below min_cells_group={config.min_cells_group}"
+            for i, j in zip(pair_i[~pair_ok], pair_j[~pair_ok])
+        ]
+        if not pair_ok.any():
+            raise ValueError(
+                "every cluster pair has a group below min_cells_group="
+                f"{config.min_cells_group}; nothing to test"
+            )
+        acct.charge(cell_idx.nbytes, "cell_groups")
+
+    # ---- DE: chunk-at-a-time wilcox + aggregates ------------------------
+    def _process_chunk(i: int, g0: int, g1: int):
+        """One chunk's (P, Gb) log-p/U + (Gb, K) aggregate slabs, from
+        the durable stage artifact when present (the resume path), else
+        computed under the window-halving ladder and checkpointed."""
+        key = _chunk_key(i, g0, g1, N, groups_sha)
+        if stages.has(key):
+            try:
+                arrays, _ = stages.load(key)
+                state.de_resumed += 1
+                return arrays
+            except ValueError as e:  # quarantined: recompute below
+                logger.warning("stream de chunk %d unusable (%s); "
+                               "recomputing", i, e)
+        est_chunk = store.chunk_host_bytes(i)
+        acct.charge(est_chunk, "chunk")
+        try:
+            try:
+                block = store.ensure_chunk(i, regen)
+            except ChunkCorrupt:
+                # no generator: the typed corruption propagates (the
+                # store already quarantined the files)
+                raise
+            gb = block.shape[0]
+            lp_rows: List[np.ndarray] = []
+            u_rows: List[np.ndarray] = []
+            agg_parts: List[Dict[str, Any]] = []
+            r0 = 0
+            while r0 < gb:
+                w = max(min(state.window_rows, gb - r0), 1)
+                sub = block[r0:r0 + w]
+                # working-set estimate for this sub-window: the (P, w)
+                # outputs (×2, lp+u, f32 device+host copies) plus the
+                # compacted window staging (nnz-bound) — what halving
+                # actually shrinks
+                est = w * P * 4 * 4 + int(sub.nnz) * 12
+                try:
+                    acct.charge(est, "de_window")
+                except HostBudgetExceeded as e:
+                    state.halve_window(str(e).splitlines()[0][:140])
+                    continue
+                try:
+                    lp_d, u_d = streaming_wilcox_block(
+                        sub, cell_idx_of, pair_i, pair_j
+                    )
+                    with residency.boundary("stream_block_fetch"):
+                        lp_h, u_h = jax.device_get((lp_d, u_d))
+                    lp_rows.append(np.asarray(lp_h, np.float32))
+                    u_rows.append(np.asarray(u_h, np.float32))
+                    agg_parts.append(_chunk_aggregates(sub, cell_idx, K))
+                finally:
+                    acct.release(est, "de_window")
+                r0 += w
+                rec = active_recorder()
+                if rec is not None:
+                    rec.touch()
+            arrays = {
+                "lp": np.concatenate(lp_rows, axis=1),
+                "u": np.concatenate(u_rows, axis=1),
+            }
+            for f in ("sum_log", "sum_expm1", "sum_sq", "nnz"):
+                arrays[f] = np.concatenate(
+                    [a[f] for a in agg_parts], axis=0
+                ).astype(np.float32)
+            if i % state.ckpt_every == 0:
+                def _save():
+                    stages.save(key, arrays, meta={"g0": g0, "g1": g1})
+
+                def _ckpt_degrade(_attempt):
+                    # ENOSPC on a durability write: coarsen granularity
+                    # (fewer checkpoints = less disk) before retrying —
+                    # durability must never become the failure mode
+                    state.coarsen_ckpt(
+                        "disk fault writing per-chunk DE checkpoint"
+                    )
+                try:
+                    _guard(_save, site="stream_chunk_write",
+                           degrade=_ckpt_degrade)
+                except Exception as e:
+                    robust_record.note_degradation(
+                        "stream_chunk_write", "ckpt-skip",
+                        f"checkpoint write failed typed ({e!r}); "
+                        "continuing without durability for this chunk",
+                    )
+            return arrays
+        finally:
+            acct.release(est_chunk, "chunk")
+
+    with timer.stage("de", n_clusters=K, n_pairs=P) as de_rec:
+        # ensure every chunk is durable first (resumable ingest — the
+        # generator-backed benches materialize here; pre-ingested stores
+        # just count their durable chunks, so a full-resume run still
+        # reports completed == planned)
+        if regen is not None:
+            store.ingest(regen)
+        else:
+            store.adopt_durable()
+        lp_parts: List[np.ndarray] = []
+        u_parts: List[np.ndarray] = []
+        agg_acc: Dict[str, List[np.ndarray]] = {
+            "sum_log": [], "sum_expm1": [], "sum_sq": [], "nnz": [],
+        }
+        for i in range(store.n_chunks):
+            g0, g1 = store.chunk_rows(i)
+            arrays = _guard(lambda i=i, g0=g0, g1=g1:
+                            _process_chunk(i, g0, g1))
+            lp_parts.append(arrays["lp"])
+            u_parts.append(arrays["u"])
+            for f in agg_acc:
+                agg_acc[f].append(np.asarray(arrays[f], np.float64))
+            acct.note_progress(stage="de", chunks_done=i + 1,
+                               chunks_planned=store.n_chunks,
+                               halvings=state.halvings)
+        if state.de_resumed:
+            robust_record.note_resume_point(
+                "stream_de", "chunk", state.de_resumed, store.n_chunks
+            )
+        log_p = np.concatenate(lp_parts, axis=1)      # (P, G) f32
+        del lp_parts, u_parts  # U rides the chunk artifacts for resume
+        # identity; the fast-path DE call never consumes it
+        agg_host = {f: np.concatenate(v, axis=0) for f, v in
+                    agg_acc.items()}
+        del agg_acc
+        de_rec["chunks"] = store.n_chunks
+        de_rec["resumed_chunks"] = state.de_resumed
+
+        counts = np.zeros(K, np.float64)
+        for k in range(K):
+            counts[k] = float(np.sum(cell_idx == k))
+        agg = ClusterAggregates(
+            sum_log=jnp.asarray(agg_host["sum_log"], jnp.float32),
+            sum_expm1=jnp.asarray(agg_host["sum_expm1"], jnp.float32),
+            sum_sq=jnp.asarray(agg_host["sum_sq"], jnp.float32),
+            nnz=jnp.asarray(agg_host["nnz"], jnp.float32),
+            counts=jnp.asarray(counts, jnp.float32),
+        )
+        pi, pj = jnp.asarray(pair_i), jnp.asarray(pair_j)
+        j_ok = jnp.asarray(pair_ok)
+        gate, log_fc, pct1, pct2 = pair_gates_fast(
+            agg, pi, pj,
+            min_pct=config.min_pct,
+            min_diff_pct=config.min_diff_pct,
+            log_fc_thrs=config.log_fc_thrs,
+            mean_exprs_thrs=config.mean_exprs_thrs,
+            pseudocount=config.pseudocount,
+            only_pos=config.only_pos,
+        )
+        tested = gate & j_ok[:, None]
+        jlp = jnp.where(tested, jnp.asarray(log_p), jnp.nan)
+        log_q = bh_adjust_masked(jlp, tested)
+        log_thr = float(np.log(np.float32(config.q_val_thrs)))
+        de_mask = tested & (log_q < log_thr) & ~jnp.isnan(log_q)
+        de_res = PairwiseDEResult(
+            cluster_names=names,
+            pair_i=pair_i, pair_j=pair_j,
+            log_p=jlp, log_q=log_q, log_fc=log_fc,
+            tested=tested, de_mask=de_mask,
+            pair_skipped=~pair_ok,
+            pct1=pct1, pct2=pct2,
+            aux={"funnel_gate_full": jnp.sum(gate, axis=1)},
+            skip_reasons=skip_reasons or None,
+        )
+
+    # ---- union ----------------------------------------------------------
+    with timer.stage("union") as rec:
+        union = _guard(lambda: stages.cached(
+            "union",
+            lambda: {"idx": de_gene_union(de_res, config.n_top_de_genes)},
+        ))["idx"]
+        rec["union_size"] = int(union.size)
+        rec["per_pair_de_counts"] = de_res.de_counts().tolist()
+    if union.size < 2:
+        raise ValueError(
+            f"DE gene union has {union.size} genes — nothing to "
+            "re-embed. Loosen q_val_thrs/log_fc_thrs or check cluster "
+            "labels."
+        )
+
+    # ---- embed: streamed union gather + Gram PCA ------------------------
+    with timer.stage("embed") as rec:
+        n_pcs = min(int(union.size), config.n_pcs)
+        rec["n_pcs"] = n_pcs
+
+        def _union_rows_of(i: int):
+            """(local row ids, global union positions) of chunk i."""
+            g0, g1 = store.chunk_rows(i)
+            uni = np.asarray(union)
+            sel = np.nonzero((uni >= g0) & (uni < g1))[0]
+            return (uni[sel] - g0), sel
+
+        def _load_union_slab_part(i: int):
+            """This chunk's union rows as a CSR part (transient chunk
+            charge; the caller owns the part's lifetime)."""
+            est = store.chunk_host_bytes(i)
+            acct.charge(est, "chunk")
+            try:
+                block = store.ensure_chunk(i, regen)
+                rows, sel = _union_rows_of(i)
+                return block[rows], sel
+            finally:
+                acct.release(est, "chunk")
+
+        def _embed():
+            import scipy.sparse as sp
+
+            if config.distance != "euclidean":
+                raise NotImplementedError(
+                    "streaming_refine supports distance='euclidean' "
+                    f"only (got {config.distance!r})"
+                )
+            # Exact-twin path first: when the dense (N, |U|) cell matrix
+            # fits the staged budget, run THE SAME randomized subspace
+            # iteration as the in-memory pipeline on the same bytes —
+            # the embedding, and therefore every downstream label, is
+            # BIT-identical to refine()'s (the mid-size ARI==1.0 pin
+            # measures exactly this). Past the budget the run degrades
+            # (recorded) to the fully-streamed gene-space Gram path.
+            # the reservation covers the dense matrix AND the largest
+            # transient chunk load the gather will charge on top of it —
+            # otherwise a dense plan that "fits" dies mid-gather on the
+            # first chunk charge
+            dense_bytes = int(N) * int(union.size) * 4 * 3 + max(
+                store.chunk_host_bytes(i) for i in range(store.n_chunks)
+            )
+            try:
+                acct.charge(dense_bytes, "embed_dense")
+            except HostBudgetExceeded:
+                robust_record.note_degradation(
+                    "stream_stage", "gram-pca-embed",
+                    f"dense (N={N}, |U|={union.size}) embed would pass "
+                    "the staged budget; using the streamed gene-space "
+                    "Gram eigenbasis (deterministic, subspace-equal "
+                    "for separated spectra)",
+                )
+                # regeneration of a torn chunk during the joins rides
+                # load_part's closure over regen
+                return {"scores": _gram_pca_streamed(
+                    store, union, acct, n_pcs, _load_union_slab_part,
+                )}
+            try:
+                from scconsensus_tpu.ops.pca import pca_scores
+
+                parts = [None] * store.n_chunks
+                for i in range(store.n_chunks):
+                    if _union_rows_of(i)[0].size:
+                        parts[i] = _load_union_slab_part(i)[0]
+                xs = sp.vstack([p for p in parts if p is not None]
+                               ).tocsr()  # (|U|, N), union order
+                del parts
+                cells = xs.toarray().T.astype(np.float32)   # (N, |U|)
+                del xs
+                scores = pca_scores(jnp.asarray(cells), n_pcs)
+                del cells
+                with residency.boundary("embed_scores_fetch"):
+                    acct.charge(N * n_pcs * 4, "scores")
+                    return {"scores": np.asarray(scores)}
+            finally:
+                acct.release(dense_bytes, "embed_dense")
+
+        embedding = _guard(lambda: stages.cached("embed", _embed))["scores"]
+
+    # ---- tree (mirrors models.pipeline's branch policy) -----------------
+    with timer.stage("tree", n_cells=N) as rec:
+        approx = N > config.approx_threshold
+        rec["approx"] = approx
+        lm_policy = (
+            config.landmark_policy(N)
+            if approx and config.approx_method == "pool" else None
+        )
+
+        def _tree():
+            if approx and config.approx_method == "knn":
+                from scconsensus_tpu.ops.knn_linkage import knn_ward_linkage
+
+                t = knn_ward_linkage(embedding, k=config.knn_graph_k,
+                                     mesh=None)
+                return {"merge": t.merge, "height": t.height,
+                        "order": t.order}
+            if lm_policy is not None:
+                from scconsensus_tpu.ops.pooling import (
+                    landmark_ward_linkage,
+                )
+
+                t, assign, cents, info = landmark_ward_linkage(
+                    embedding,
+                    n_landmarks=lm_policy["k"],
+                    sketch=lm_policy["sketch"],
+                    seed=config.random_seed,
+                    c=lm_policy["c"],
+                    k_min=lm_policy["k_min"],
+                    k_max=lm_policy["k_max"],
+                    linkage=lm_policy["linkage"],
+                    knn_k=lm_policy["knn_k"],
+                    mesh=None,
+                    charge=lambda nb, what: acct.charge(nb, what) and
+                    acct.release(nb, what),
+                )
+                return {"merge": t.merge, "height": t.height,
+                        "order": t.order, "pool_assign": assign,
+                        "pool_centroids": cents,
+                        "landmark_k": np.asarray(info["k_used"]),
+                        "landmark_sketch": np.asarray(info["sketch"])}
+            if approx:
+                from scconsensus_tpu.ops.pooling import pooled_ward_linkage
+
+                t, assign, cents = pooled_ward_linkage(
+                    embedding, n_centroids=config.n_pool_centroids,
+                    seed=config.random_seed,
+                )
+                return {"merge": t.merge, "height": t.height,
+                        "order": t.order, "pool_assign": assign,
+                        "pool_centroids": cents}
+            t = ward_linkage(embedding)
+            return {"merge": t.merge, "height": t.height, "order": t.order}
+
+        tree_arrays = _guard(lambda: stages.cached("tree", _tree))
+        tree = HClustTree(merge=tree_arrays["merge"],
+                          height=tree_arrays["height"],
+                          order=tree_arrays["order"])
+        pool_assign = tree_arrays.get("pool_assign")
+        pool_centroids = tree_arrays.get("pool_centroids")
+        landmark_used = "landmark_k" in tree_arrays
+        if landmark_used:
+            rec["landmark"] = True
+            rec["landmark_k"] = int(tree_arrays["landmark_k"])
+
+    # ---- cuts -----------------------------------------------------------
+    dynamic_colors: Dict[str, np.ndarray] = {}
+    dynamic_labels: Dict[str, np.ndarray] = {}
+    deep_split_info: List[Dict] = []
+    with timer.stage("cuts"):
+        cut_weights = None
+        if pool_assign is None:
+            cut_points, cut_min_size = embedding, config.min_cluster_size
+        elif landmark_used:
+            cut_points = pool_centroids
+            cut_min_size = config.min_cluster_size
+            cut_weights = np.bincount(
+                pool_assign, minlength=pool_centroids.shape[0]
+            ).astype(np.float64)
+        else:
+            avg_pool = max(N / pool_centroids.shape[0], 1.0)
+            cut_points = pool_centroids
+            cut_min_size = max(
+                2, int(round(config.min_cluster_size / avg_pool))
+            )
+
+        def _cuts():
+            out = {}
+            for dsv in config.deep_split_values:
+                cut_labels = cutree_hybrid(
+                    tree, cut_points, deep_split=int(dsv),
+                    min_cluster_size=cut_min_size,
+                    pam_stage=config.pam_stage,
+                    weights=cut_weights,
+                )
+                if pool_assign is not None:
+                    cut_labels = cut_labels[pool_assign]
+                out[f"ds{dsv}"] = cut_labels
+            return out
+
+        cut_arrays = _guard(lambda: stages.cached("cuts", _cuts))
+        for dsv in config.deep_split_values:
+            cut_labels = cut_arrays[f"ds{dsv}"]
+            key = f"deepsplit: {dsv}"
+            dynamic_labels[key] = cut_labels
+            dynamic_colors[key] = labels_to_colors(cut_labels)
+            deep_split_info.append({
+                "deep_split": int(dsv),
+                "n_clusters": int(
+                    len(set(cut_labels[cut_labels > 0].tolist()))
+                ),
+            })
+
+    # ---- silhouette (pooled estimator above threshold, exact below) -----
+    if config.compat.return_silhouette:
+        with timer.stage("silhouette") as sil_rec:
+            labs = [
+                np.where(dynamic_labels[f"deepsplit: {dsv}"] > 0,
+                         dynamic_labels[f"deepsplit: {dsv}"], -1)
+                for dsv in config.deep_split_values
+            ]
+
+            def _silhouette():
+                if N > config.approx_threshold:
+                    from scconsensus_tpu.ops.silhouette import (
+                        pooled_multi_cut_silhouette,
+                    )
+
+                    sil_rec["method"] = "pooled-estimator"
+                    sil_rec["pool_reused"] = pool_centroids is not None
+                    for info, (si, _per) in zip(
+                        deep_split_info,
+                        pooled_multi_cut_silhouette(
+                            embedding, labs,
+                            n_centroids=config.silhouette_pool_centroids,
+                            seed=config.random_seed,
+                            centroids=pool_centroids,
+                            assign=pool_assign,
+                            sample=config.silhouette_sample,
+                        ),
+                    ):
+                        info["silhouette"] = si
+                        info["silhouette_method"] = "pooled-estimator"
+                else:
+                    from scconsensus_tpu.ops.silhouette import (
+                        multi_cut_silhouette,
+                    )
+
+                    for info, (si, _per) in zip(
+                        deep_split_info,
+                        multi_cut_silhouette(embedding, labs),
+                    ):
+                        info["silhouette"] = si
+
+            _guard(_silhouette)
+
+    # ---- nodg: streamed per-cell detected-gene counts -------------------
+    with timer.stage("nodg"):
+        def _nodg():
+            acc = np.zeros(N, np.int64)
+            for i in range(store.n_chunks):
+                est = store.chunk_host_bytes(i)
+                acct.charge(est, "chunk")
+                try:
+                    block = store.ensure_chunk(i, regen)
+                    acc += np.bincount(
+                        block.indices[block.data > 0], minlength=N
+                    )
+                finally:
+                    acct.release(est, "chunk")
+            return {"nodg": acc}
+
+        nodg = _guard(lambda: stages.cached("nodg", _nodg))["nodg"]
+
+    union_names = (
+        np.asarray(gene_names)[union] if gene_names is not None
+        else union.copy()
+    )
+    acct.sample_rss()
+    return ReclusterResult(
+        de_gene_union=union_names,
+        de_gene_union_idx=union,
+        cell_tree=tree,
+        dynamic_colors=dynamic_colors,
+        dynamic_labels=dynamic_labels,
+        deep_split_info=deep_split_info,
+        nodg=nodg,
+        embedding=embedding,
+        de=de_res,
+        metrics=timer.as_dict(),
+    )

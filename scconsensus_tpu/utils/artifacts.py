@@ -26,7 +26,7 @@ from scconsensus_tpu.obs.export import (
 )
 
 __all__ = ["ArtifactStore", "ArtifactCorrupt", "input_fingerprint",
-           "config_fingerprint"]
+           "config_fingerprint", "file_sha256", "quarantine_files"]
 
 
 class ArtifactCorrupt(ValueError):
@@ -88,6 +88,44 @@ def config_fingerprint(obj: Any, n_hex: int = 12) -> str:
     """
     blob = json.dumps(obj, sort_keys=True, default=str, separators=(",", ":"))
     return hashlib.sha256(blob.encode()).hexdigest()[:n_hex]
+
+
+def file_sha256(path: str) -> str:
+    """Streaming sha256 of a file's bytes — THE content-checksum
+    primitive every durable artifact shares (the ArtifactStore sidecars
+    and the ChunkedCSRStore chunk integrity stamps both call this, so
+    'verified' means the same thing for a stage artifact and a streamed
+    chunk)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def quarantine_files(paths, logger=None) -> list:
+    """Move files aside under ``<path>.quarantined-<n>`` names (never
+    silently delete what might be the only copy of a long compute) —
+    the shared rename loop behind ArtifactStore._quarantine and the
+    chunk store's torn-chunk path. Returns the destination names."""
+    dests = []
+    for path in paths:
+        if not os.path.exists(path):
+            continue
+        n = 0
+        dest = f"{path}.quarantined-{n}"
+        while os.path.exists(dest):
+            n += 1
+            dest = f"{path}.quarantined-{n}"
+        try:
+            os.replace(path, dest)
+            dests.append(dest)
+        except OSError:
+            try:  # last resort: a corrupt file must not stay loadable
+                os.unlink(path)
+            except OSError:
+                pass
+    return dests
 
 
 class ArtifactStore:
@@ -200,11 +238,7 @@ class ArtifactStore:
 
     @staticmethod
     def _file_sha(path: str) -> str:
-        h = hashlib.sha256()
-        with open(path, "rb") as f:
-            for chunk in iter(lambda: f.read(1 << 20), b""):
-                h.update(chunk)
-        return h.hexdigest()
+        return file_sha256(path)
 
     def save(self, stage: str, arrays: Optional[Dict[str, np.ndarray]] = None,
              meta: Optional[Dict[str, Any]] = None) -> None:
@@ -293,21 +327,7 @@ class ArtifactStore:
                 "files left in place and load refused", stage, reason,
             )
             return
-        for path in self._paths(stage):
-            if not os.path.exists(path):
-                continue
-            n = 0
-            dest = f"{path}.quarantined-{n}"
-            while os.path.exists(dest):
-                n += 1
-                dest = f"{path}.quarantined-{n}"
-            try:
-                os.replace(path, dest)
-            except OSError:
-                try:  # last resort: a corrupt file must not stay loadable
-                    os.unlink(path)
-                except OSError:
-                    pass
+        quarantine_files(self._paths(stage))
         _robust_record.note_degradation(
             f"artifact:{stage}", "quarantine", reason
         )

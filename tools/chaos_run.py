@@ -27,8 +27,15 @@ mesh so the shrink ladder is exercised without hardware) and
 restart-and-replay identity check, corrupt model artifact with a typed
 quarantine refusal, stalled device calls against short deadlines, oom
 under load tripping the breaker into flagged degraded mode — each plan
-verifying the serve worker's request accounting). ``--soak-plans``
-filters both matrices by name (comma-separated) for bounded CI runs.
+verifying the serve worker's request accounting) and
+:data:`STREAM_SOAK_MATRIX` (round 17, the disk/host-memory axis:
+SIGKILL mid-ingest → resume to byte-identical labels, injected torn
+chunk → quarantine-and-recompute to identical labels, ENOSPC at the
+chunk-write site → typed disk-class recovery, host-budget breach →
+window-halving recovery, plus the standing device-loss plan run against
+the atlas_query fleet shape so device-class recovery is proven beyond
+the anchor pipeline). ``--soak-plans`` filters all three matrices by
+name (comma-separated) for bounded CI runs.
 
 Exit codes: 0 chaos contract held; 1 it did not; 2 usage/IO error.
 """
@@ -118,10 +125,43 @@ SERVE_SOAK_MATRIX: List[Tuple[str, List[Dict[str, Any]], str,
     ("replay-across-replicas", [], "fleet-replay", {"replicas": 3}),
 ]
 
+# The out-of-core streaming matrix (round 17): each plan drives the
+# replayable streaming worker (python -m scconsensus_tpu.stream.soak —
+# a deterministic chunked synthetic dataset whose labels_sha is a pure
+# function of the seed) under disk-axis faults. The contract: a
+# SIGKILLed ingest resumes from the last durable chunk to IDENTICAL
+# labels, a torn chunk quarantines-and-recomputes to identical labels,
+# ENOSPC at the chunk-write site recovers through the disk-class
+# sweep-and-retry with the recovery recorded typed, and a host-budget
+# breach recovers through the window-halving ladder — all without a
+# byte of label drift. The matrix additionally covers ONE non-anchor
+# scenario (ROADMAP item 4 note): the standing device-loss plan run
+# against the atlas_query fleet shape, proving device-class recovery
+# (breaker → flagged degraded, zero lost requests) beyond the anchor
+# refine pipeline.
+STREAM_SOAK_MATRIX: List[Tuple[str, List[Dict[str, Any]], str,
+                               Dict[str, Any]]] = [
+    ("stream-kill-mid-ingest",
+     [{"site": "stream_chunk_write", "class": "kill", "after": 2}],
+     "stream-kill-resume", {}),
+    ("stream-torn-chunk",
+     [{"site": "artifact:stream_chunk", "class": "corrupt", "after": 1}],
+     "stream-torn", {}),
+    ("stream-enospc",
+     [{"site": "stream_chunk_write", "class": "disk", "after": 1}],
+     "stream-soak", {"expect_disk_recovery": True}),
+    ("stream-budget-breach", [], "stream-soak",
+     {"stage_budget_mb": 0.7, "expect_halving": True}),
+    ("atlas-device-loss",
+     [{"site": "serve_device", "class": "device_loss", "times": 6}],
+     "atlas-device-loss", {"replicas": 2}),
+]
+
 
 def _fleet_worker(workdir: str, timeout_s: float, n_requests: int,
                   extra_args: Optional[List[str]] = None,
                   summary_name: str = "FLEET_SOAK_SUMMARY.json",
+                  plan_path: Optional[str] = None,
                   ) -> Tuple[int, Optional[Dict[str, Any]]]:
     """One fleet-soak worker subprocess; returns (rc, summary|None)."""
     summary_path = os.path.join(workdir, summary_name)
@@ -131,6 +171,8 @@ def _fleet_worker(workdir: str, timeout_s: float, n_requests: int,
         pass
     env = dict(os.environ)
     env.pop("SCC_FAULT_PLAN", None)
+    if plan_path:
+        env["SCC_FAULT_PLAN"] = os.path.abspath(plan_path)
     env.setdefault("JAX_PLATFORMS", "cpu")
     cmd = [sys.executable, "-m", "scconsensus_tpu.serve.fleet.soak",
            "--dir", workdir, "--requests", str(n_requests),
@@ -185,6 +227,170 @@ def _serve_worker(workdir: str, plan_path: Optional[str],
             return rc, json.load(f)
     except (OSError, json.JSONDecodeError):
         return rc, None
+
+
+def _stream_worker(workdir: str, plan_path: Optional[str],
+                   timeout_s: float,
+                   extra_args: Optional[List[str]] = None
+                   ) -> Tuple[int, Optional[Dict[str, Any]]]:
+    """One streaming-soak worker subprocess; returns (rc, summary|None).
+    rc -9 (SIGKILL) with no fresh summary is the kill-plan's expected
+    shape."""
+    summary_path = os.path.join(workdir, "STREAM_SOAK_SUMMARY.json")
+    try:
+        os.remove(summary_path)
+    except OSError:
+        pass
+    env = dict(os.environ)
+    env.pop("SCC_FAULT_PLAN", None)
+    if plan_path:
+        env["SCC_FAULT_PLAN"] = os.path.abspath(plan_path)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, "-m", "scconsensus_tpu.stream.soak",
+           "--dir", workdir, "--summary", summary_path] \
+        + list(extra_args or [])
+    try:
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=timeout_s, cwd=_REPO)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        return 124, None
+    if rc != 0 and proc.stderr:
+        for ln in proc.stderr.strip().splitlines()[-4:]:
+            print(f"[stream-soak] {ln}", file=sys.stderr)
+    try:
+        with open(summary_path) as f:
+            return rc, json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return rc, None
+
+
+def run_stream_plan(name: str, rules: List[Dict[str, Any]], mode: str,
+                    extra: Dict[str, Any], tmp: str, timeout_s: float,
+                    ref_cache: Dict[str, Any]) -> int:
+    """Run one streaming (or atlas-fleet) fault plan; 0 = the streaming
+    chaos contract held. ``ref_cache`` shares ONE uninterrupted
+    reference run's labels_sha across the plans that pin label identity
+    (the workload is a pure function of the seed, so one reference
+    covers them all)."""
+    workdir = os.path.join(tmp, name)
+    os.makedirs(workdir, exist_ok=True)
+    plan_path = os.path.join(workdir, "plan.json")
+    with open(plan_path, "w") as f:
+        json.dump({"faults": rules}, f)
+    checks: List[Tuple[str, bool]] = []
+    deadline = time.monotonic() + timeout_s
+
+    def _left() -> float:
+        return max(deadline - time.monotonic(), 1.0)
+
+    def _reference_sha() -> Optional[str]:
+        if "sha" not in ref_cache:
+            ref_dir = os.path.join(tmp, "stream-reference")
+            os.makedirs(ref_dir, exist_ok=True)
+            rc, ref = _stream_worker(ref_dir, None, _left(), ["--fresh"])
+            ref_cache["sha"] = (ref or {}).get("labels_sha") \
+                if rc == 0 and ref and ref.get("ok") else None
+        return ref_cache["sha"]
+
+    if mode == "stream-kill-resume":
+        ref_sha = _reference_sha()
+        checks.append(("reference run clean", ref_sha is not None))
+        rc1, _ = _stream_worker(workdir, plan_path, _left(), ["--fresh"])
+        checks.append(("kill plan killed the worker mid-ingest",
+                       rc1 != 0))
+        rc2, resumed = _stream_worker(workdir, None, _left())
+        checks.append(("resume run clean", rc2 == 0 and bool(resumed)
+                       and resumed.get("ok")))
+        checks.append((
+            "resume adopted durable chunks (did not restart from zero)",
+            bool(resumed)
+            and (resumed.get("chunks") or {}).get("resumed", 0) >= 1,
+        ))
+        checks.append((
+            "killed-and-resumed run produced byte-identical labels",
+            bool(resumed) and resumed.get("labels_sha") == ref_sha,
+        ))
+    elif mode == "stream-torn":
+        ref_sha = _reference_sha()
+        checks.append(("reference run clean", ref_sha is not None))
+        rc, summary = _stream_worker(workdir, plan_path, _left(),
+                                     ["--fresh"])
+        checks.append(("worker exited 0 under the torn-chunk plan",
+                       rc == 0 and bool(summary) and summary.get("ok")))
+        ch = (summary or {}).get("chunks") or {}
+        checks.append(("torn chunk quarantined",
+                       ch.get("quarantined", 0) >= 1))
+        checks.append(("quarantined chunk recomputed through the "
+                       "generator", ch.get("recomputed", 0) >= 1))
+        checks.append((
+            "quarantine-and-recompute produced byte-identical labels",
+            bool(summary) and summary.get("labels_sha") == ref_sha,
+        ))
+    elif mode == "atlas-device-loss":
+        # the standing device-loss plan against the atlas_query fleet
+        # shape (serve path as a batch workload): device_lost classified
+        # by the shared classifier must trip the breaker into flagged
+        # degraded service with ZERO lost requests — recovery proven on
+        # a non-anchor workload
+        rc, summary = _fleet_worker(
+            workdir, _left(), 16,
+            ["--fresh", "--replicas", str(extra.get("replicas", 2))],
+            plan_path=plan_path,
+        )
+        sv = ((summary or {}).get("record") or {}).get("serving") or {}
+        counts = (summary or {}).get("outcome_counts") or {}
+        checks.append(("worker exited 0 (wire accounting held under "
+                       "device loss)", rc == 0))
+        checks.append(("every request resolved", bool(summary)
+                       and summary.get("resolved")
+                       == summary.get("requests")))
+        checks.append(("degraded responses served and flagged",
+                       counts.get("degraded", 0) > 0))
+        checks.append((
+            "breaker tripped on the device_lost class",
+            int(((sv.get("breaker") or {}).get("trips")) or 0) >= 1,
+        ))
+    else:  # "stream-soak"
+        args = ["--fresh"]
+        if extra.get("stage_budget_mb"):
+            args += ["--stage-budget-mb", str(extra["stage_budget_mb"])]
+        rc, summary = _stream_worker(workdir, plan_path or None, _left(),
+                                     args)
+        checks.append(("worker exited 0 (streaming section validated, "
+                       "all chunks completed)",
+                       rc == 0 and bool(summary) and summary.get("ok")))
+        if extra.get("expect_disk_recovery"):
+            rb = ((summary or {}).get("record") or {}).get(
+                "robustness") or {}
+            checks.append((
+                "disk-class fault recovered typed at "
+                "stream_chunk_write",
+                any(r.get("error_class") == "disk" and r.get("recovered")
+                    for r in rb.get("retries") or []),
+            ))
+        if extra.get("expect_halving"):
+            checks.append((
+                "host-budget breach recovered by halving the window",
+                (summary or {}).get("halvings", 0) >= 1,
+            ))
+            # determinism under degradation: the same tight budget must
+            # reproduce the same plan and the same labels (the
+            # constrained run swaps the embed to the Gram basis, so it
+            # pins against ITSELF, not the unconstrained reference)
+            rc2, again = _stream_worker(
+                os.path.join(tmp, f"{name}-again"), plan_path or None,
+                _left(), args)
+            checks.append((
+                "same budget reproduces byte-identical labels",
+                rc2 == 0 and bool(again) and bool(summary)
+                and again.get("labels_sha") == summary.get("labels_sha"),
+            ))
+    ok = all(c for _, c in checks)
+    for label, c in checks:
+        print(f"[chaos:{name}] {'ok  ' if c else 'FAIL'} {label}",
+              file=sys.stderr)
+    return 0 if ok else 1
 
 
 def run_serve_plan(name: str, rules: List[Dict[str, Any]], mode: str,
@@ -346,9 +552,12 @@ def run_soak(config: str, evidence_dir: str, budget_s: float,
     matrix = [m for m in SOAK_MATRIX if not only or m[0] in only]
     serve_matrix = [m for m in SERVE_SOAK_MATRIX
                     if not only or m[0] in only]
-    if not matrix and not serve_matrix:
-        known = [m[0] for m in SOAK_MATRIX] + [m[0] for m
-                                               in SERVE_SOAK_MATRIX]
+    stream_matrix = [m for m in STREAM_SOAK_MATRIX
+                     if not only or m[0] in only]
+    if not matrix and not serve_matrix and not stream_matrix:
+        known = ([m[0] for m in SOAK_MATRIX]
+                 + [m[0] for m in SERVE_SOAK_MATRIX]
+                 + [m[0] for m in STREAM_SOAK_MATRIX])
         print(f"chaos_run: --soak-plans matched nothing "
               f"(known: {known})", file=sys.stderr)
         return 2
@@ -395,6 +604,21 @@ def run_soak(config: str, evidence_dir: str, budget_s: float,
             t_plan = time.monotonic()
             rc = run_serve_plan(name, rules, mode, extra, tmp,
                                 remaining, n_requests=serve_requests)
+            results.append({
+                "plan": name, "ok": rc == 0,
+                "outcome": "ok" if rc == 0 else f"rc={rc}",
+                "elapsed_s": round(time.monotonic() - t_plan, 1),
+            })
+        stream_ref: Dict[str, Any] = {}  # one shared reference sha
+        for name, rules, mode, extra in stream_matrix:
+            remaining = budget_s - (time.monotonic() - t0)
+            if remaining <= 0:
+                results.append({"plan": name, "ok": False,
+                                "outcome": "budget-exhausted"})
+                continue
+            t_plan = time.monotonic()
+            rc = run_stream_plan(name, rules, mode, extra, tmp,
+                                 remaining, stream_ref)
             results.append({
                 "plan": name, "ok": rc == 0,
                 "outcome": "ok" if rc == 0 else f"rc={rc}",

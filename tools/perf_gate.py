@@ -191,6 +191,14 @@ def _report(verdict: regress.GateVerdict, drifts: List[Dict[str, Any]],
                 sign = "-" if u == "rps" else "+"
                 line += f"  ({sign}{sv.excess_ms:.3f}{u} past band)"
             print(line)
+        for sv in verdict.streaming:
+            mark = "REGRESSED" if sv.regressed else "ok"
+            line = (f"  mem   {sv.metric:<20} {sv.value_mb:>9.1f}MB "
+                    f"baseline {sv.baseline_mb:.1f}MB "
+                    f"± {sv.band_mb:.1f}MB  {mark}")
+            if sv.regressed:
+                line += f"  (+{sv.excess_mb:.1f}MB past band)"
+            print(line)
         for d in drifts:
             state = "acknowledged" if d["acknowledged"] else "UNACKNOWLEDGED"
             src = d.get("pins_source")
@@ -364,6 +372,59 @@ def _smoke(fixtures: str, as_json: bool) -> int:
     checks.append((
         "mesh transition with a non-shrinking device set rejected",
         el_rejected,
+    ))
+
+    # streaming schema (round 17): an out-of-core record with a populated
+    # streaming section (chunk counters summing, resume evidence, peak
+    # RSS under its budget) validates and gates normally...
+    verdict_sm, _ = run_gate(
+        os.path.join(fixtures, "candidate_stream_recovered.json"),
+        evidence,
+    )
+    sm_rec = _load_json(
+        os.path.join(fixtures, "candidate_stream_recovered.json")
+    )
+    sm = sm_rec.get("streaming") or {}
+    checks.append((
+        "stream-recovered candidate validates and passes with chunk "
+        "resume + budget evidence",
+        verdict_sm.ok and (sm.get("chunks") or {}).get("resumed", 0) >= 1
+        and (sm.get("budget") or {}).get("within_budget") is True,
+    ))
+    # ...while a section CLAIMING bounded memory with its peak RSS over
+    # the budget is REJECTED naming the rule — the claim must not
+    # contradict its own evidence
+    try:
+        run_gate(os.path.join(fixtures, "candidate_bad_streaming.json"),
+                 evidence)
+        sm_rejected = False
+    except ValueError as e:
+        sm_rejected = "over budget" in str(e)
+    checks.append((
+        "within_budget claim with peak RSS over budget rejected",
+        sm_rejected,
+    ))
+    # ...and chunk counters that do not sum are equally a schema
+    # violation, not a gateable record (a lost chunk is a lost shard of
+    # the answer); scratch file to a temp dir like the serve twin below
+    import copy as _copy0
+    import tempfile as _tempfile0
+
+    bad_sum = _copy0.deepcopy(sm_rec)
+    bad_sum["streaming"]["chunks"]["resumed"] += 1  # one chunk vanishes
+    with _tempfile0.TemporaryDirectory(prefix="scc-gate-smoke-") as tmp0:
+        bad_path = os.path.join(tmp0, "candidate_stream_bad_sum.json")
+        with open(bad_path, "w") as f:
+            json.dump(bad_sum, f)
+        try:
+            run_gate(bad_path, evidence)
+            sum_rejected = False
+        except ValueError as e:
+            sum_rejected = "chunk counts do not sum" in str(e)
+    checks.append((
+        "streaming chunk counts that do not sum rejected naming the "
+        "rule",
+        sum_rejected,
     ))
 
     # serving-latency gate (round 15, BASELINE.md serving-latency

@@ -187,10 +187,16 @@ def render(lines: List[Dict[str, Any]],
                    + ("" if hdr else " (stream has no header either)"))
     else:
         age = now - float(hb.get("ts") or now)
+        rss_bit = f"rss {_fmt_bytes(hb.get('rss_bytes'))}"
+        if hb.get("rss_peak_bytes"):
+            # the peak-since-start is the number the streaming budget
+            # assertion is judged by — the live panel shows BOTH so a
+            # spike between ticks is still visible
+            rss_bit += f" (peak {_fmt_bytes(hb['rss_peak_bytes'])})"
         bits = [f"last heartbeat {_fmt_dur(age)} ago",
                 f"tick #{hb.get('seq')}",
                 f"up {_fmt_dur(hb.get('up_s'))}",
-                f"rss {_fmt_bytes(hb.get('rss_bytes'))}"]
+                rss_bit]
         hbm = hb.get("hbm") or {}
         if hbm.get("bytes_in_use") is not None:
             bits.append(f"hbm {_fmt_bytes(hbm['bytes_in_use'])}"
@@ -282,6 +288,29 @@ def render(lines: List[Dict[str, Any]],
                 )
             if bits:
                 out.append("  robust: " + "   ".join(bits))
+        sm = hb.get("streaming") or {}
+        if sm:
+            # streaming heartbeat panel (round 17, obs.live ←
+            # stream.record): chunk progress, staged bytes, and the
+            # peak-RSS-vs-budget headroom — an out-of-core run's vitals
+            bits = []
+            if sm.get("chunks_planned"):
+                bits.append(f"chunks {sm.get('chunks_done', 0)}"
+                            f"/{sm['chunks_planned']}"
+                            + (f" ({sm['stage']})" if sm.get("stage")
+                               else ""))
+            bits.append(f"staged {_fmt_bytes(sm.get('staged_bytes'))}")
+            peak, bud = sm.get("peak_rss_bytes"), sm.get("budget_bytes")
+            if peak and bud:
+                over = peak > bud
+                bits.append(
+                    ("PEAK RSS " if over else "peak rss ")
+                    + f"{_fmt_bytes(peak)}/{_fmt_bytes(bud)}"
+                    + (" OVER BUDGET" if over else "")
+                )
+            if sm.get("halvings"):
+                bits.append(f"window halved x{sm['halvings']}")
+            out.append("  streaming: " + "   ".join(bits))
         sv = hb.get("serving") or {}
         if sv:
             # serving heartbeat panel (obs.live ← serve.metrics): queue
