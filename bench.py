@@ -146,6 +146,21 @@ def _trim_line(parsed: dict) -> str:
             ex["device_time_s"] = kern["total_device_time_s"]
         ex["truncated"] = True
         line = json.dumps(parsed)
+    # integrity section: the tail keeps the verification facts a driver
+    # must see (checks passed/run + detection counts); the full catalog
+    # lives in the checkpoint + ledger record
+    if len(line) > 1500 and parsed.get("integrity"):
+        ig = parsed.pop("integrity")
+        ex = parsed.setdefault("extra", {})
+        ch = ig.get("checks") or {}
+        ex["integrity_checks"] = (f"{ch.get('passed', 0)}"
+                                  f"/{ch.get('run', 0)}")
+        det = (len(ig.get("violations") or [])
+               + len((ig.get("ghost") or {}).get("mismatches") or []))
+        if det:
+            ex["integrity_detections"] = det
+        ex["truncated"] = True
+        line = json.dumps(parsed)
     # robustness section: the tail keeps the survival facts a driver must
     # see (retry/fault counts + whether the run recovered); the full
     # trail lives in the checkpoint + ledger record
@@ -346,6 +361,18 @@ def _robust_section() -> "dict | None":
         from scconsensus_tpu.robust import record as robust_record
 
         return robust_record.section()
+    except Exception:
+        return None
+
+
+def _integrity_section() -> "dict | None":
+    """The worker's in-process computation-integrity trail
+    (robust.integrity) — None with SCC_INTEGRITY=off, so the section's
+    very presence means the run audited its own arithmetic."""
+    try:
+        from scconsensus_tpu.robust import integrity as robust_integrity
+
+        return robust_integrity.section()
     except Exception:
         return None
 
@@ -1031,6 +1058,7 @@ def _worker_body() -> None:
                 extra=extra,
                 spans=b1m_state.get("spans") or [],
                 robustness=_robust_section(),
+                integrity=_integrity_section(),
             )
 
         b1m_state = {"secs": None, "phase": "cold", "spans": None}
@@ -1105,6 +1133,7 @@ def _worker_body() -> None:
                 streaming=s10_state.get("streaming"),
                 robustness=(s10_state.get("robustness")
                             or _robust_section()),
+                integrity=_integrity_section(),
             )
 
         _install_term_handler(lambda: _s10_record(s10_state["secs"]))
@@ -1226,6 +1255,7 @@ def _worker_body() -> None:
                 extra=extra,
                 serving=aq_state["serving"],
                 robustness=_robust_section(),
+                integrity=_integrity_section(),
             )
 
         _install_term_handler(lambda: _aq_record(aq_state["secs"]))
@@ -1306,7 +1336,7 @@ def _worker_body() -> None:
         size = f"{n_cells // 1000}k" if n_cells >= 1000 else str(n_cells)
         state = {"edger": None, "wilcox": None, "spans": None,
                  "quality": None, "residency": None, "kernels": None,
-                 "robustness": None}
+                 "robustness": None, "integrity": None}
 
         def _record():
             """Cumulative flagship record from whatever has finished."""
@@ -1351,6 +1381,8 @@ def _worker_body() -> None:
                 # partial must carry the faults/retries of the run it
                 # interrupted, not of the previous one)
                 robustness=state.get("robustness") or _robust_section(),
+                integrity=(state.get("integrity")
+                           or _integrity_section()),
             )
 
         def _ckpt():
@@ -1403,6 +1435,8 @@ def _worker_body() -> None:
             # recovery evidence
             state["robustness"] = (result.metrics.get("robustness")
                                    or state["robustness"])
+            state["integrity"] = (result.metrics.get("integrity")
+                                  or state["integrity"])
             return elapsed
 
         state["edger"] = _section(extra, "edger", _edger)

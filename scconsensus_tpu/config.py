@@ -163,6 +163,28 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "would leave fewer devices than this is FATAL instead of "
                 "recovered (for workloads whose sharded working set "
                 "genuinely needs a minimum aggregate HBM footprint)."),
+        EnvFlag("SCC_INTEGRITY", str, "off",
+                "Computation-integrity sentinels (robust.integrity, "
+                "round 18): 'off' (default), 'audit' (algebraic "
+                "invariant checks fused at stage boundaries + a seeded "
+                "ghost-replay sample recomputed through the float64 "
+                "host oracle, all recorded on the validated integrity "
+                "run-record section), 'enforce' (a violation or replay "
+                "mismatch raises typed silent_corruption, recovered by "
+                "recompute-the-unit; repeated detection at one site "
+                "evicts the suspect device via the elastic mesh)."),
+        EnvFlag("SCC_INTEGRITY_TOL_SCALE", float, 1.0,
+                "Scale factor on every integrity tolerance band "
+                "(robust.integrity.TOLERANCES — per-check defaults in "
+                "BASELINE.md). Raise it on backends whose float32 "
+                "rounding is looser; tests shrink it to force "
+                "detections."),
+        EnvFlag("SCC_INTEGRITY_EVICT_THRESHOLD", int, 2,
+                "Consecutive silent-corruption detections at one site "
+                "before the retry policy escalates to its device-loss "
+                "hook — the elastic mesh shrinks off the suspect chip "
+                "(a chip that computes wrong gets evicted like one "
+                "that died)."),
         EnvFlag("SCC_ROBUST_DE_CKPT", bool, True,
                 "Mid-stage wilcox checkpointing: with an artifact store "
                 "active, each completed window-ladder bucket persists "

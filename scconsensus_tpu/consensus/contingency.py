@@ -74,6 +74,19 @@ def contingency_table(labels_1: Sequence, labels_2: Sequence) -> ContingencyResu
     k1, k2 = row_labels.size, col_labels.size
     mat = np.zeros((k1, k2), dtype=np.int64)
     np.add.at(mat, (ridx, cidx), 1)
+    # Computation-integrity tier (robust.integrity, r18): the injected
+    # in-computation corruption site and the conservation invariant —
+    # row sums must equal the first labeling's cluster sizes, column
+    # sums the second's, the grand total N. Every consensus label the
+    # merge grammar emits descends from these counts.
+    from scconsensus_tpu.robust import integrity as robust_integrity
+    from scconsensus_tpu.robust.faults import corrupt_value
+
+    mat = corrupt_value("contingency_table", mat)
+    if robust_integrity.enabled():
+        robust_integrity.check_contingency(
+            "contingency_table", mat, ridx, cidx
+        )
     return ContingencyResult(mat, row_labels, col_labels)
 
 

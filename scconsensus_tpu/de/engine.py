@@ -576,6 +576,16 @@ class _LadderRecovery:
                 self.site, self.err_class or "transient", self.attempt + 1,
                 recovered=True, backoff_s=self.backoff_total,
             )
+            if self.err_class == "silent_corruption":
+                # the corrupted bucket recomputed clean — recompute-the-
+                # unit is the silent_corruption recovery, and this is
+                # its evidence on the integrity section
+                from scconsensus_tpu.robust import (
+                    integrity as robust_integrity,
+                )
+
+                robust_integrity.current().note_recompute()
+                robust_integrity.current().reset_streak(self.site)
         self.attempt = 0
         self.backoff_total = 0.0
 
@@ -602,6 +612,19 @@ class _LadderRecovery:
             # resumes from exactly where the mesh died (no note_retry
             # here: the stage-level policy records the recovery)
             return False
+        if err_class == "silent_corruption":
+            from scconsensus_tpu.robust import (
+                integrity as robust_integrity,
+            )
+
+            if robust_integrity.should_evict(self.site):
+                # repeated miscompute at this site: the in-place
+                # recompute keeps producing corrupt answers, so the
+                # right adaptation is the elastic one — propagate to
+                # the stage guard, whose device-loss hook shrinks the
+                # mesh off the suspect chip (completed buckets resume
+                # through their checkpoints, exactly like device loss)
+                return False
         if (err_class == "fatal"
                 or self.attempt >= self.MAX_BUCKET_ATTEMPTS
                 or not run.budget_take()):
@@ -752,6 +775,11 @@ def _run_wilcox_device(
 
         n_dev = int(mesh.devices.size)
         gc = max(gc, n_dev * 8)
+    # live device ids for the corruption fault class's device-pinned
+    # rules (robust.faults.corrupt_value): a rule modeling one bad chip
+    # stops firing once the elastic supervisor evicts that chip
+    live_dev_ids = ([int(d.id) for d in mesh.devices.flat]
+                    if mesh is not None else [0])
 
     sparse_in = is_sparse(data)
     windowed = False
@@ -938,6 +966,36 @@ def _run_wilcox_device(
                     out = allpairs_ranksum_chunk(
                         rows, kcid, jn, jpi, jpj, K, window=weff,
                     )
+                # Computation-integrity tier (robust.integrity, r18):
+                # the injected in-computation corruption site, the
+                # fused rank-sum conservation invariant, and — on the
+                # seeded sample bucket of each window rung — the
+                # float64 ghost replay. All inside the recovery
+                # context: a detection raises typed silent_corruption
+                # and the bucket recomputes (repeated detection
+                # propagates to the elastic eviction path).
+                from scconsensus_tpu.robust import (
+                    integrity as robust_integrity,
+                )
+                from scconsensus_tpu.robust.faults import corrupt_value
+
+                out = corrupt_value("wilcox_bucket_out", out,
+                                    live_devices=live_dev_ids)
+                if robust_integrity.enabled():
+                    robust_integrity.check_wilcox_bucket(
+                        "wilcox_bucket", out[0], out[1], out[2],
+                        n_of[pair_i], n_of[pair_j],
+                    )
+                    if robust_integrity.current().want_replay(
+                            "wilcox", int(w)):
+                        robust_integrity.replay_wilcox_window(
+                            "wilcox_bucket", f"window:{int(w)}",
+                            vals if compact else rows,
+                            wcid if compact else kcid,
+                            n_of, pair_i, pair_j,
+                            out[0], out[1], int(ids.size),
+                            full_rows=not compact,
+                        )
                 # the former SCC_WILCOX_PROBE payload, as first-class span
                 # metrics (always on — these are cheap host-side stats)
                 real = int(nnz_sorted[g0:g1].sum())
@@ -1068,6 +1126,9 @@ def _run_wilcox_device(
         ), jinv, axis=0).T
         outs = None
     else:
+        from scconsensus_tpu.robust import integrity as robust_integrity
+        from scconsensus_tpu.robust.faults import corrupt_value
+
         outs = []
         overflow = []  # (outs idx, g0, g1, device n_runs)
         for g0, g1, chunk in _gene_chunks(data, gc, jdata=jdata):
@@ -1076,9 +1137,9 @@ def _run_wilcox_device(
             ) as csp:
                 csp.metrics.counter("genes").add(int(g1 - g0))
                 if mesh is not None:
-                    outs.append((g0, g1, sharded_allpairs_ranksum(
+                    cout = sharded_allpairs_ranksum(
                         chunk, jcid, jn, jpi, jpj, K, mesh=mesh
-                    )))
+                    )
                 elif use_runspace:
                     attach_cost(csp, allpairs_ranksum_runspace_chunk,
                                 chunk, jcid, jn, jpi, jpj, K)
@@ -1086,13 +1147,32 @@ def _run_wilcox_device(
                         chunk, jcid, jn, jpi, jpj, K
                     )
                     overflow.append((len(outs), g0, g1, nr_b))
-                    outs.append((g0, g1, (lp_b, u_b, ts_b)))
+                    cout = (lp_b, u_b, ts_b)
                 else:
                     attach_cost(csp, allpairs_ranksum_chunk,
                                 chunk, jcid, jn, jpi, jpj, K)
-                    outs.append((g0, g1, allpairs_ranksum_chunk(
+                    cout = allpairs_ranksum_chunk(
                         chunk, jcid, jn, jpi, jpj, K
-                    )))
+                    )
+                # integrity tier on the non-windowed chunk path: same
+                # corruption site, conservation invariant, and one
+                # sampled ghost replay per run (rung key "chunk")
+                cout = corrupt_value("wilcox_bucket_out", cout,
+                                     live_devices=live_dev_ids)
+                if robust_integrity.enabled():
+                    robust_integrity.check_wilcox_bucket(
+                        "wilcox_bucket", cout[0], cout[1], cout[2],
+                        n_of[pair_i], n_of[pair_j],
+                    )
+                    if robust_integrity.current().want_replay(
+                            "wilcox", "chunk"):
+                        robust_integrity.replay_wilcox_window(
+                            "wilcox_bucket", f"chunk:{int(g0)}",
+                            chunk, jcid, n_of, pair_i, pair_j,
+                            cout[0], cout[1], int(g1 - g0),
+                            full_rows=True,
+                        )
+                outs.append((g0, g1, cout))
         if use_runspace and overflow:
             _redo_overflow_dense(
                 outs, overflow, data, gc, jdata, jcid, jn, jpi, jpj, K,
@@ -1441,6 +1521,18 @@ def pairwise_de(
                 )
             else:
                 log_q = bh_adjust_masked(log_p, tested)
+            from scconsensus_tpu.robust import (
+                integrity as robust_integrity,
+            )
+            from scconsensus_tpu.robust.faults import corrupt_value
+
+            log_q = corrupt_value("bh_logq", log_q)
+            if robust_integrity.enabled():
+                # BH-threshold monotonicity: q >= p and q <= 1 over the
+                # finite entries — fused at the stage boundary, inside
+                # the stage guard so an enforce-mode violation
+                # recomputes the unit typed (silent_corruption)
+                robust_integrity.check_bh("bh_adjust", log_p, log_q)
             if obs_quality.enabled():
                 # BH masks out non-FINITE p (a -inf underflow gets NaN q
                 # by design), so the legitimate-NaN budget is everything
@@ -1535,6 +1627,16 @@ def pairwise_de(
                 if config.compat.bh_reference_n
                 else bh_adjust(jnp.asarray(log_p))
             )
+            from scconsensus_tpu.robust import (
+                integrity as robust_integrity,
+            )
+            from scconsensus_tpu.robust.faults import corrupt_value
+
+            log_q = corrupt_value("bh_logq", log_q)
+            if robust_integrity.enabled():
+                robust_integrity.check_bh(
+                    "bh_adjust", jnp.asarray(log_p), log_q
+                )
             if obs_quality.enabled():
                 # non-finite p (skipped pairs' NaN, -inf underflow) is
                 # masked out of BH and legitimately NaN in q

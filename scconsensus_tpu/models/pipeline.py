@@ -119,6 +119,12 @@ def refine(
     # retries, ladder degradations, mid-stage resume points, and any
     # SCC_FAULT_PLAN injections all land on result.metrics["robustness"]
     robust_record.begin_run()
+    # fresh integrity trail (robust.integrity, round 18): invariant
+    # checks, ghost-replay results, and silent-corruption recomputes
+    # land on result.metrics["integrity"] (absent with SCC_INTEGRITY=off)
+    from scconsensus_tpu.robust import integrity as robust_integrity
+
+    robust_integrity.begin_run()
     capture = KernelCapture()
     if timer is None:
         # the kernel join needs TraceAnnotation windows in the profiler
@@ -150,6 +156,11 @@ def refine(
     if rb_section is not None:
         # absent on healthy unfaulted runs — absence IS the healthy signal
         result.metrics["robustness"] = rb_section
+    ig_section = robust_integrity.section()
+    if ig_section is not None:
+        # absent with SCC_INTEGRITY=off — a run that never audited its
+        # arithmetic carries no claim about it
+        result.metrics["integrity"] = ig_section
     if capture.enabled:
         try:
             from scconsensus_tpu.obs.cost import stage_cost_summary
@@ -369,7 +380,34 @@ def _refine_impl(
                 cells = (c / jnp.maximum(norm, 1e-12)).T  # (N, |U|)
             else:
                 cells = _rows_dense(union).T
-            scores = pca_scores(jnp.asarray(cells), n_pcs)
+            from scconsensus_tpu.robust import (
+                integrity as robust_integrity,
+            )
+
+            if robust_integrity.enabled():
+                # audited embed (robust.integrity): same subspace
+                # iteration, plus the basis-orthonormality residual and
+                # the mean/components the sampled float64 ghost replay
+                # verifies sampled score rows against — detection
+                # raises typed silent_corruption HERE, inside the stage
+                # guard and BEFORE the store save, so recompute-the-
+                # unit can never persist a corrupted embedding
+                from scconsensus_tpu.ops.pca import pca_scores_audited
+                from scconsensus_tpu.robust.faults import corrupt_value
+
+                jcells = jnp.asarray(cells)
+                scores, ortho, pmean, pcomp = pca_scores_audited(
+                    jcells, n_pcs
+                )
+                scores = corrupt_value("embed_scores", scores)
+                robust_integrity.check_pca_basis("stage:embed", ortho)
+                if robust_integrity.current().want_replay("pca", 0):
+                    robust_integrity.replay_pca_rows(
+                        "stage:embed", jcells, pmean, pcomp, scores,
+                        n_rows=int(jcells.shape[0]),
+                    )
+            else:
+                scores = pca_scores(jnp.asarray(cells), n_pcs)
             # declared crossing: tree/cuts/silhouette are host algorithms
             # today, so the (N, n_pcs) scores must land on host — the
             # TODO(item-2) boundary the device-resident-graph refactor
@@ -515,6 +553,18 @@ def _refine_impl(
             cut_weights = np.bincount(
                 pool_assign, minlength=pool_centroids.shape[0]
             ).astype(np.float64)
+            from scconsensus_tpu.robust import (
+                integrity as robust_integrity,
+            )
+
+            if robust_integrity.enabled():
+                # landmark occupancy conservation at the CUT boundary:
+                # the weights the size floor runs in must account for
+                # every cell exactly once (segment-sum == N)
+                robust_integrity.check_landmark_occupancy(
+                    "stage:cuts", pool_assign,
+                    pool_centroids.shape[0], N,
+                )
         else:
             # treecut operates on centroids: scale the size floor by the
             # average pool occupancy (approximate-path semantics).

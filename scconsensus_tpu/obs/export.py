@@ -76,6 +76,18 @@ schema ``scc-run-record`` version 1 — top-level keys:
                     claiming within_budget without peak-RSS evidence
                     (or with the peak over the budget), or whose chunk
                     counts do not sum, is rejected.
+  integrity         OPTIONAL (still schema version 1 — additive): the
+                    computation-integrity trail (robust.integrity,
+                    round 18) — invariant checks planned/run/passed
+                    per check and in total, recorded violations,
+                    ghost-replay counters + mismatches against the
+                    float64 oracle, and silent-corruption recomputes.
+                    Validated by robust.integrity.validate_integrity —
+                    a section claiming ``all_checks_passed`` with
+                    ``checks_run < checks_planned`` (or with failed
+                    checks, unmatched replays, or phantom recomputes)
+                    is rejected: claims must carry evidence. Absent
+                    with SCC_INTEGRITY=off.
 
 The Chrome trace export (:func:`chrome_trace`) converts the span tree to
 ``traceEvents`` complete ("X") events — open the file in Perfetto
@@ -148,6 +160,7 @@ def build_run_record(
     robustness: Optional[Dict[str, Any]] = None,
     serving: Optional[Dict[str, Any]] = None,
     streaming: Optional[Dict[str, Any]] = None,
+    integrity: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One schema-v1 run record. Pass ``tracer`` to take spans + compile
     stats from it; or pre-built ``spans`` (e.g. a resumed pipeline's
@@ -159,7 +172,9 @@ def build_run_record(
     timeline; ``robustness`` (optional) attaches the robust.record
     fault/retry/resume trail; ``serving`` (optional) attaches the
     serve.metrics online-serving section; ``streaming`` (optional)
-    attaches the stream.record out-of-core section."""
+    attaches the stream.record out-of-core section; ``integrity``
+    (optional) attaches the robust.integrity computation-integrity
+    section."""
     if spans is None:
         spans = tracer.span_records() if tracer is not None else []
     extra = dict(extra or {})
@@ -199,6 +214,8 @@ def build_run_record(
         rec["serving"] = serving
     if streaming is not None:
         rec["streaming"] = streaming
+    if integrity is not None:
+        rec["integrity"] = integrity
     return rec
 
 
@@ -311,6 +328,13 @@ def validate_run_record(rec: Dict[str, Any]) -> None:
         from scconsensus_tpu.stream.record import validate_streaming
 
         validate_streaming(sm)
+    ig = rec.get("integrity")
+    if ig is not None:
+        # jax-free import (robust.integrity's module level is jax-free
+        # by contract; jax loads only inside the device checks)
+        from scconsensus_tpu.robust.integrity import validate_integrity
+
+        validate_integrity(ig)
 
 
 # --------------------------------------------------------------------------

@@ -397,6 +397,23 @@ class Ledger:
                 # keyed baselines (p99@rN, throughput@rN) read it —
                 # absent means the bare r15 driver (keys as r1)
                 entry["serving"]["replicas"] = nrep
+        ig = rec.get("integrity")
+        if isinstance(ig, dict) and ig:
+            # computation-integrity summary on the index (round 18): a
+            # gate/report scanning the manifest sees WHICH runs proved
+            # their arithmetic (and which caught corruption) without
+            # loading every record
+            entry["integrity"] = {
+                "mode": ig.get("mode"),
+                "checks_run": (ig.get("checks") or {}).get("run"),
+                "checks_passed": (ig.get("checks") or {}).get("passed"),
+                "violations": len(ig.get("violations") or []),
+                "mismatches": len(
+                    (ig.get("ghost") or {}).get("mismatches") or []
+                ),
+                "recomputes": (ig.get("ghost") or {}).get("recomputes"),
+                "all_checks_passed": bool(ig.get("all_checks_passed")),
+            }
         sm = rec.get("streaming")
         if isinstance(sm, dict) and sm:
             # out-of-core summary on the index (round 17): the perf

@@ -242,6 +242,7 @@ class LiveRecorder:
         for mod in ("scconsensus_tpu.obs.quality",
                     "scconsensus_tpu.obs.residency",
                     "scconsensus_tpu.robust.record",
+                    "scconsensus_tpu.robust.integrity",
                     "scconsensus_tpu.serve.metrics"):
             try:
                 __import__(mod)
@@ -506,6 +507,20 @@ class LiveRecorder:
             sm = stream_record.live_summary()
             if sm:
                 hb["streaming"] = sm
+        except Exception:
+            pass
+        try:
+            # integrity panel: invariant checks passed/run, ghost-replay
+            # progress + lag, mismatches and recomputes (robust.
+            # integrity) — a run silently fighting corruption shows it
+            # on the stream, tick by tick
+            from scconsensus_tpu.robust import (
+                integrity as robust_integrity,
+            )
+
+            ig = robust_integrity.live_summary()
+            if ig:
+                hb["integrity"] = ig
         except Exception:
             pass
         try:

@@ -187,6 +187,16 @@ BOUNDARIES: Dict[str, str] = {
         "sentinels, sentinel-count fetches. Auto-attributed when the "
         "source line resolves inside obs/."
     ),
+    "integrity_check": (
+        "The computation-integrity layer's verification transfers "
+        "(robust.integrity, round 18): one scalar residual per fused "
+        "invariant check at a stage boundary, plus the sampled "
+        "ghost-replay rows (a few genes × pairs per ladder rung, one "
+        "landmark block, one serving batch). Sized O(samples) by "
+        "construction and active only under SCC_INTEGRITY=audit|"
+        "enforce — the cost of proving the arithmetic, never part of "
+        "the workload's own transfer budget."
+    ),
 }
 
 _EVENT_CAP = 256            # stored events; totals keep counting past it

@@ -17,7 +17,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-__all__ = ["pca_scores", "pca_basis"]
+__all__ = ["pca_scores", "pca_scores_audited", "pca_basis"]
 
 
 def _subspace_basis(x, n_components: int, n_oversample: int, n_iter: int,
@@ -63,6 +63,36 @@ def pca_scores(
     """
     _, vt, xc = _subspace_basis(x, n_components, n_oversample, n_iter, seed)
     return xc @ vt.T                     # (N, n_components)
+
+
+@partial(jax.jit, static_argnames=("n_components", "n_oversample", "n_iter"))
+def pca_scores_audited(
+    x: jnp.ndarray,
+    n_components: int,
+    n_oversample: int = 10,
+    n_iter: int = 4,
+    seed: int = 0,
+):
+    """:func:`pca_scores` plus the integrity layer's verification
+    outputs, from ONE fused program (robust.integrity, round 18):
+
+    Returns ``(scores, ortho_residual, mean, components)`` where
+    ``ortho_residual = ‖V·Vᵀ − I‖∞`` is the basis-orthonormality
+    invariant (any correct run of the subspace iteration ends in an SVD
+    whose right-singular rows are orthonormal — a residual past the
+    float32 band means the basis, and therefore every downstream
+    distance, is corrupt), and ``mean``/``components`` feed the sampled
+    float64 ghost replay of score rows. The extra work over
+    ``pca_scores`` is one (k, k) gram — noise next to the iteration's
+    (N, F) matmuls — and the residual stays on device until the
+    integrity layer fetches its one scalar.
+    """
+    mean, vt, xc = _subspace_basis(x, n_components, n_oversample, n_iter,
+                                   seed)
+    scores = xc @ vt.T
+    g = vt @ vt.T
+    resid = jnp.max(jnp.abs(g - jnp.eye(g.shape[0], dtype=g.dtype)))
+    return scores, resid, mean, vt
 
 
 @partial(jax.jit, static_argnames=("n_components", "n_oversample", "n_iter"))

@@ -317,6 +317,29 @@ def landmark_pool(
             )
             assign = np.asarray(_assign_blocks(pb, cent_d)).reshape(-1)[:n]
             cent = np.asarray(cent_d, np.float64)
+    # Computation-integrity tier (robust.integrity, r18): the injected
+    # in-computation corruption site, the occupancy-conservation
+    # invariant (segment-sum of occupancies == assigned-cell count,
+    # every index live), and — once per run — the float64 ghost replay
+    # of one seeded assignment block against the fetched centroids. A
+    # detection raises typed silent_corruption inside the tree stage's
+    # guard, so the unit recomputes before any artifact persists.
+    from scconsensus_tpu.robust import integrity as robust_integrity
+    from scconsensus_tpu.robust.faults import corrupt_value
+
+    assign = corrupt_value("landmark_assign", assign)
+    if robust_integrity.enabled():
+        robust_integrity.check_landmark_occupancy(
+            "landmark_assign", assign, k, n
+        )
+        if robust_integrity.current().want_replay("landmark", 0):
+            blk = robust_integrity._sample_idx(n, 256)
+            robust_integrity.replay_landmark_block(
+                "landmark_assign",
+                x[blk] if isinstance(x, np.ndarray)
+                else xd[jnp.asarray(blk)],
+                cent, assign[blk], unit="block0",
+            )
     used = np.unique(assign)
     remap = -np.ones(k, np.int64)
     remap[used] = np.arange(used.size)

@@ -29,6 +29,16 @@ The pieces, one contract:
 * :mod:`~scconsensus_tpu.robust.contract` — input-contract pre-flight
   at the ``refine()`` boundary: named repair-or-reject policies that
   turn degenerate inputs into one-line typed diagnoses.
+* :mod:`~scconsensus_tpu.robust.integrity` — computation-integrity
+  sentinels (round 18, ``SCC_INTEGRITY`` off/audit/enforce): algebraic
+  invariant checks fused at stage boundaries, a seeded ghost-replay
+  sample recomputed through the float64 host oracle, the typed
+  ``silent_corruption`` error class (recompute-the-unit recovery,
+  repeated detection evicts the miscomputing device via the elastic
+  supervisor), the validated ``integrity`` run-record section, and the
+  in-computation ``corruption`` fault class that makes every detection
+  path tier-1-testable. ``robust.soak`` is its replayable worker;
+  ``tools/verify_run.py`` the cross-shape determinism auditor.
 
 The recovery *surfaces* live where the work lives: the wilcox ladder
 persists per-bucket completion into the ``ArtifactStore`` (mid-stage
@@ -61,6 +71,12 @@ from scconsensus_tpu.robust.record import (  # noqa: F401
     note_mesh_transition,
     note_resume_point,
     validate_robustness,
+)
+from scconsensus_tpu.robust.integrity import (  # noqa: F401
+    GhostReplayMismatch,
+    IntegrityError,
+    InvariantViolation,
+    validate_integrity,
 )
 from scconsensus_tpu.robust.retry import (  # noqa: F401
     ERROR_CLASSES,

@@ -64,6 +64,21 @@ Fault classes and what they do at a compute site:
              ``ENOSPC``/``No space left on device`` text a full
              filesystem raises) — the streaming layer's disk-class
              test vector (stream.store, robust.retry "disk")
+  corruption no-op at :func:`fault_point`; consumed by
+             :func:`corrupt_value` at the named IN-COMPUTATION sites
+             (``wilcox_bucket_out``, ``embed_scores``, ``bh_logq``,
+             ``landmark_assign``, ``stream_block``, ``serve_classify``,
+             ``contingency_table``) — a seeded perturbation of freshly
+             computed VALUES (scale / sign-bit flip / index shift),
+             distinct from the post-write artifact ``corrupt`` class.
+             The computation-integrity layer's test vector
+             (robust.integrity, round 18): every documented corruption
+             site must be detected by an invariant or ghost-replay
+             check and recovered via the typed ``silent_corruption``
+             recompute. A rule with ``"device": D`` only fires while
+             device D is in the caller's live mesh — a specific chip
+             that computes wrong until the elastic supervisor evicts
+             it.
 
 With ``SCC_FAULT_PLAN`` unset every entry point is a single registry
 lookup returning immediately — the zero-fault overhead contract.
@@ -87,12 +102,13 @@ __all__ = [
     "InjectedDiskFault",
     "fault_point",
     "corrupt_artifact",
+    "corrupt_value",
     "active",
     "reset",
 ]
 
 FAULT_CLASSES = ("oom", "transient", "kill", "stall", "corrupt",
-                 "device_loss", "disk")
+                 "device_loss", "disk", "corruption")
 
 
 class InjectedFault(Exception):
@@ -208,7 +224,11 @@ def fault_point(site: str) -> None:
     every injection is recorded on the run's robustness log BEFORE the
     action, so even a SIGKILL leaves the fault attributable (the partial
     flight record carries the log's live summary)."""
-    rules = _matches(site)
+    rules = [(i, r) for i, r in _matches(site)
+             if r.get("class") != "corruption"]
+    # "corruption" rules are excluded BEFORE the counters advance: they
+    # are consumed (and counted) by corrupt_value at the value sites, so
+    # a site carrying both hooks cannot double-advance their windows
     if not rules:
         return
     from scconsensus_tpu.robust import record as _record
@@ -290,3 +310,86 @@ def corrupt_artifact(stage: str, path: str) -> bool:
         except OSError:
             pass
     return applied
+
+
+def _perturb_one(x, mode: str, factor: float):
+    """One array perturbed per ``mode`` — device arrays stay on device
+    (jnp ops), host arrays stay host. Modes:
+
+      scale     multiply every element by ``factor`` (float arrays) —
+                a wrong-but-finite global scale, the signature of a
+                shape-dependent code path gone wrong;
+      signflip  flip the IEEE sign bit of the max-|x| FINITE element —
+                one corrupted number (deterministic: the most
+                significant entry, so detection cannot depend on where
+                a random flip landed);
+      shift     integer arrays: (x + 1) mod (max + 1) — every index
+                wrong by one, occupancy totals conserved (the case
+                only a ghost replay catches).
+    """
+    import numpy as _np
+
+    is_host = isinstance(x, _np.ndarray)
+    if is_host:
+        xp = _np
+        arr = x
+    else:
+        import jax.numpy as xp  # device array: perturb in place on device
+
+        arr = x
+    if mode == "shift" or not xp.issubdtype(arr.dtype, xp.floating):
+        k = xp.max(arr) + 1
+        return ((arr + 1) % xp.maximum(k, 1)).astype(arr.dtype)
+    if mode == "scale":
+        return arr * xp.asarray(factor, dtype=arr.dtype)
+    # signflip
+    flat = xp.ravel(arr)
+    mag = xp.where(xp.isfinite(flat), xp.abs(flat), -xp.inf)
+    idx = xp.argmax(mag)
+    if is_host:
+        flat = flat.copy()
+        flat[idx] = -flat[idx]
+        return flat.reshape(arr.shape)
+    return xp.reshape(flat.at[idx].set(-flat[idx]), arr.shape)
+
+
+def corrupt_value(site: str, value, live_devices=None):
+    """Apply any ``corruption``-class rule at an in-computation ``site``
+    to freshly computed VALUES — the silent-corruption test vector the
+    integrity layer (robust.integrity) must detect. ``value`` is one
+    array or a tuple of arrays; the FIRST array is perturbed (rule key
+    ``"index"`` picks another). Returns the same structure.
+
+    ``live_devices``: the caller's current mesh device ids — a rule
+    carrying ``"device": D`` fires only while D is live, so an evicted
+    chip stops corrupting (the elastic-eviction soak's contract). Rules
+    without a device pin always fire in their window. No plan → one
+    registry lookup and return, like :func:`fault_point`."""
+    rules = [(i, r) for i, r in _matches(site)
+             if r.get("class") == "corruption"]
+    if not rules:
+        return value
+    from scconsensus_tpu.robust import record as _record
+
+    firing = [(idx, rule) for idx, rule in rules if _fire(idx, rule)]
+
+    def _live(rule) -> bool:
+        dev = rule.get("device")
+        return (dev is None or live_devices is None
+                or int(dev) in [int(d) for d in live_devices])
+
+    # the liveness gate filters BEFORE one rule is picked: a rule
+    # pinned to an evicted chip goes clean (the soak's contract)
+    # without masking a co-firing unpinned rule at the same site
+    for idx, rule in [fr for fr in firing if _live(fr[1])][:1]:
+        _record.note_fault(site, "corruption", seq=_HITS[idx] - 1)
+        mode = rule.get("mode", "scale")
+        factor = float(rule.get("factor", 1.5))
+        if isinstance(value, tuple):
+            i = int(rule.get("index", 0))
+            return tuple(
+                _perturb_one(v, mode, factor) if k == i else v
+                for k, v in enumerate(value)
+            )
+        return _perturb_one(value, mode, factor)
+    return value

@@ -1,0 +1,1053 @@
+"""Computation-integrity sentinels: silent-corruption defense (round 18).
+
+Every recovery layer before this one assumes a device that fails
+LOUDLY: the r10 numeric sentinels stop at NaN/Inf, the r14 elastic mesh
+evicts chips that die. Nothing catches a device (or a shape-dependent
+code path) that returns a wrong-but-finite answer. This module is the
+layer that proves the pipeline's own arithmetic, in three tiers behind
+the registered ``SCC_INTEGRITY`` flag (``off | audit | enforce`` — the
+residency-auditor mode pattern):
+
+**(a) Algebraic invariant checks** fused at stage boundaries, each
+O(output) and device-resident until the one scalar residual crosses to
+host (declared ``integrity_check`` boundary):
+
+  * ``wilcox_conservation`` — rank-sum conservation per ladder window:
+    midranks over the M pooled cells of a pair sum to M(M+1)/2, so the
+    Mann-Whitney U = rs1 − n1(n1+1)/2 must lie in [0, n1·n2] for every
+    (pair, gene), the pooled tie term Σ(t³−t) in [0, M³−M], and log p
+    ≤ 0 — rank mass can neither appear nor vanish without breaking one
+    of these bounds;
+  * ``bh_monotonic`` — BH-threshold monotonicity: adjusted q ≥ raw p
+    (the cummin-from-the-right never lowers a p below itself when the
+    multiplicity n ≥ rank) and q ≤ 1, elementwise over finite entries;
+  * ``pca_orthonormal`` — the randomized-subspace basis must satisfy
+    ‖V·Vᵀ − I‖∞ ≤ tol (computed inside the same jit as the scores);
+  * ``landmark_occupancy`` — landmark occupancy conservation: the
+    segment-sum of per-landmark occupancies equals the assigned-cell
+    count, and every assignment indexes a live landmark;
+  * ``contingency_sums`` — contingency-table row/col sums equal the
+    input cluster sizes (and the grand total equals N).
+
+Violations ride the ambient span (``integrity_violations`` counter) and
+the run's integrity log; in **enforce** mode they raise
+:class:`InvariantViolation` — typed, classified ``silent_corruption``
+by ``robust.retry``, whose recovery is recompute-the-unit.
+
+**(b) Sampled ghost-replay.** A deterministic, seeded sample of units —
+one ladder window per rung (window width), one landmark block, one
+streaming chunk per run, one serving batch per server — is recomputed
+through an independent reference path (host float64 oracle: scipy
+midranks + the R normal-approximation arithmetic for the rank test;
+float64 matmul/argmin for the landmark and classify paths) and compared
+within per-check tolerance bands. A mismatch raises
+:class:`GhostReplayMismatch` (enforce) or records it (audit). Repeated
+mismatch at one site feeds the elastic supervisor: after
+``SCC_INTEGRITY_EVICT_THRESHOLD`` consecutive detections the retry
+policy runs its ``on_device_loss`` hook — a chip that computes wrong
+gets evicted like one that died (the mesh shrinks deterministically
+onto survivors and the unit recomputes there).
+
+**(c) Evidence.** The validated ``integrity`` run-record section
+(checks planned/run/passed, violations, ghost-replay counters,
+mismatches, recomputes — a section claiming ``all_checks_passed`` with
+``checks_run < checks_planned`` is REJECTED naming the rule), ledger
+manifest stamps, and the heartbeat panel ``tools/tail_run.py`` renders.
+
+Import discipline: module import stays jax-free (``validate_run_record``
+and the bench orchestrator load it); jax/scipy are imported inside the
+check/replay functions only. The injected test vectors live in
+``robust.faults`` (the ``corruption`` in-computation fault class).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from scconsensus_tpu.config import env_flag
+
+__all__ = [
+    "MODES",
+    "IntegrityError",
+    "InvariantViolation",
+    "GhostReplayMismatch",
+    "mode",
+    "enabled",
+    "enforcing",
+    "begin_run",
+    "current",
+    "section",
+    "live_summary",
+    "validate_integrity",
+    "TOLERANCES",
+]
+
+MODES = ("off", "audit", "enforce")
+
+# Per-check tolerance bands (BASELINE.md "Integrity policy" documents
+# them). Scaled by SCC_INTEGRITY_TOL_SCALE; the float32 kernels earn a
+# real band — counts are exact below 2^24, but log-space p-values and
+# projected scores round.
+TOLERANCES: Dict[str, float] = {
+    # invariant residuals (absolute)
+    "wilcox_conservation": 0.51,   # U/ties bound slack: f32 half-ranks
+    "bh_monotonic": 1e-3,          # log-space slack for q >= p, q <= 1
+    "pca_orthonormal": 1e-3,       # max |V.Vt - I| after QR in f32
+    "landmark_occupancy": 0.0,     # integer conservation is exact
+    "contingency_sums": 0.0,       # integer conservation is exact
+    # ghost-replay comparison bands (absolute, on the named quantity)
+    "replay_wilcox_logp": 5e-2,    # f32 log-p vs float64 oracle
+    "replay_wilcox_u": 0.51,       # U is half-integer-exact in f64
+    "replay_landmark_d2": 1e-3,    # relative distance-tie slack
+    "replay_classify_d2": 1e-3,
+    "replay_pca": 1e-2,            # relative, on sampled score rows
+}
+
+
+class IntegrityError(RuntimeError):
+    """Base of every typed integrity failure. Classified as the fifth
+    error class ``silent_corruption`` by ``robust.retry`` (precedence
+    device_lost > silent_corruption > disk > resource > transient);
+    recovery is recompute-the-unit."""
+
+    def __init__(self, msg: str, check: str = "", site: str = "",
+                 magnitude: float = 0.0, tol: float = 0.0):
+        super().__init__(msg)
+        self.check = check
+        self.site = site
+        self.magnitude = float(magnitude)
+        self.tol = float(tol)
+
+
+class InvariantViolation(IntegrityError):
+    """An algebraic invariant failed at a stage boundary (enforce mode):
+    the computation produced output that no correct run of the algorithm
+    can produce — rank mass created or destroyed, a non-orthonormal
+    basis, occupancy that does not conserve cells."""
+
+
+class GhostReplayMismatch(IntegrityError):
+    """A sampled unit, recomputed through the independent float64 host
+    oracle, disagreed with the device result beyond the check's
+    tolerance band — silent corruption, detected."""
+
+
+def mode() -> str:
+    m = str(env_flag("SCC_INTEGRITY") or "off").lower()
+    return m if m in MODES else "off"
+
+
+def enabled() -> bool:
+    return mode() != "off"
+
+
+def enforcing() -> bool:
+    return mode() == "enforce"
+
+
+def tol(check: str) -> float:
+    return TOLERANCES.get(check, 0.0) * float(
+        env_flag("SCC_INTEGRITY_TOL_SCALE")
+    )
+
+
+# capped like robust.record's lists: a corruption storm must not grow a
+# record without bound (counts stay exact; only event lists truncate)
+_LIST_CAP = 64
+
+
+class IntegrityLog:
+    """Per-run integrity trail (thread-safe: the serving driver's worker
+    thread and the heartbeat sampler both touch it)."""
+
+    def __init__(self) -> None:
+        self.mode = mode()
+        # check name -> [planned, run, passed]
+        self.checks: Dict[str, List[int]] = {}
+        self.violations: List[Dict[str, Any]] = []
+        self.replays_planned = 0
+        self.replays_run = 0
+        self.replays_passed = 0
+        self.mismatches: List[Dict[str, Any]] = []
+        self.recomputes = 0
+        self.consumed_s = 0.0
+        self.last_replay_unix: Optional[float] = None
+        self._replayed_units: set = set()
+        # thread id -> the (kind, key) most recently armed by
+        # want_replay on that thread: the replay call follows the
+        # arming synchronously, so a mismatch can re-arm exactly the
+        # unit it caught (see note_mismatch)
+        self._armed_by_thread: Dict[int, Any] = {}
+        self._site_streak: Dict[str, int] = {}
+        self._n_dropped = 0
+        self._lock = threading.Lock()
+
+    # -- counters ----------------------------------------------------------
+    def _bucket(self, check: str) -> List[int]:
+        return self.checks.setdefault(check, [0, 0, 0])
+
+    def plan(self, check: str, n: int = 1) -> None:
+        with self._lock:
+            self._bucket(check)[0] += int(n)
+
+    def note_check(self, check: str, site: str, ok: bool,
+                   magnitude: float, tolerance: float) -> None:
+        with self._lock:
+            b = self._bucket(check)
+            b[1] += 1
+            if ok:
+                b[2] += 1
+                self._site_streak.pop(site, None)
+            else:
+                self._site_streak[site] = \
+                    self._site_streak.get(site, 0) + 1
+                item = {"check": check, "site": site,
+                        "magnitude": round(float(magnitude), 6),
+                        "tol": round(float(tolerance), 6)}
+                if len(self.violations) < _LIST_CAP:
+                    self.violations.append(item)
+                else:
+                    self._n_dropped += 1
+
+    def note_mismatch(self, check: str, site: str, unit: str,
+                      magnitude: float, tolerance: float) -> None:
+        with self._lock:
+            self.replays_run += 1
+            self._site_streak[site] = self._site_streak.get(site, 0) + 1
+            # re-arm the unit this thread just replayed: the
+            # silent_corruption recovery recomputes it, and the
+            # recomputed answer must be re-verified by the same replay
+            # (otherwise corruption only the replay can catch would
+            # survive the recompute unchecked — and single-unit sites
+            # could never accumulate the eviction streak)
+            armed = self._armed_by_thread.pop(
+                threading.get_ident(), None)
+            if armed is not None:
+                self._replayed_units.discard(armed)
+            item = {"check": check, "site": site, "unit": unit,
+                    "magnitude": round(float(magnitude), 6),
+                    "tol": round(float(tolerance), 6)}
+            if len(self.mismatches) < _LIST_CAP:
+                self.mismatches.append(item)
+            else:
+                self._n_dropped += 1
+            self.last_replay_unix = time.time()
+
+    def note_replay_ok(self, site: str) -> None:
+        with self._lock:
+            self.replays_run += 1
+            self.replays_passed += 1
+            self._site_streak.pop(site, None)
+            self._armed_by_thread.pop(threading.get_ident(), None)
+            self.last_replay_unix = time.time()
+
+    def note_recompute(self) -> None:
+        """A silent_corruption retry recovered: the corrupted unit was
+        recomputed (robust.retry / the ladder recovery bump this)."""
+        with self._lock:
+            self.recomputes += 1
+
+    def site_streak(self, site: str) -> int:
+        with self._lock:
+            return self._site_streak.get(site, 0)
+
+    def reset_streak(self, site: str) -> None:
+        with self._lock:
+            self._site_streak.pop(site, None)
+
+    def want_replay(self, kind: str, key) -> bool:
+        """Deterministic unit sampling: the FIRST unit of each
+        (kind, key) per run is the seeded sample — one ladder window per
+        rung (key = window width), one landmark block, one streaming
+        chunk, one serving batch per run. Also counts the plan. A
+        mismatch re-arms the unit (note_mismatch), so the recomputed
+        answer is verified by the same replay on the retry."""
+        with self._lock:
+            k = (kind, key)
+            if k in self._replayed_units:
+                return False
+            self._replayed_units.add(k)
+            self._armed_by_thread[threading.get_ident()] = k
+            self.replays_planned += 1
+            return True
+
+    def add_consumed(self, dt: float) -> None:
+        with self._lock:
+            self.consumed_s += max(float(dt), 0.0)
+
+    # -- section / live feed ----------------------------------------------
+    def empty(self) -> bool:
+        with self._lock:
+            return not (self.checks or self.replays_planned
+                        or self.mismatches or self.recomputes)
+
+    def section(self) -> Optional[Dict[str, Any]]:
+        """The run record's ``integrity`` section, or None when the layer
+        never engaged (absence IS the off-mode signal — zero bytes of
+        record overhead on an unaudited run)."""
+        with self._lock:
+            if not (self.checks or self.replays_planned
+                    or self.mismatches or self.recomputes):
+                return None
+            planned = sum(b[0] for b in self.checks.values())
+            run = sum(b[1] for b in self.checks.values())
+            passed = sum(b[2] for b in self.checks.values())
+            out: Dict[str, Any] = {
+                "mode": self.mode,
+                "checks": {"planned": planned, "run": run,
+                           "passed": passed},
+                "per_check": {
+                    name: {"planned": b[0], "run": b[1], "passed": b[2]}
+                    for name, b in sorted(self.checks.items())
+                },
+                "violations": [dict(v) for v in self.violations],
+                "ghost": {
+                    "planned": self.replays_planned,
+                    "run": self.replays_run,
+                    "passed": self.replays_passed,
+                    "mismatches": [dict(m) for m in self.mismatches],
+                    "recomputes": self.recomputes,
+                },
+                # COMPUTED, never asserted: all checks passed only when
+                # every planned check ran, every run check passed, and
+                # every ghost replay agreed (the validator rejects a
+                # record claiming this with less)
+                "all_checks_passed": bool(
+                    run == planned and passed == run
+                    and not self.violations
+                    and self.replays_run == self.replays_planned
+                    and self.replays_passed == self.replays_run
+                ),
+                "consumed_s": round(self.consumed_s, 4),
+            }
+            if self._n_dropped:
+                out["events_dropped"] = self._n_dropped
+            return out
+
+    def live_summary(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            if not (self.checks or self.replays_planned
+                    or self.mismatches):
+                return None
+            planned = sum(b[0] for b in self.checks.values())
+            run = sum(b[1] for b in self.checks.values())
+            passed = sum(b[2] for b in self.checks.values())
+            out: Dict[str, Any] = {
+                "mode": self.mode,
+                "checks_planned": planned,
+                "checks_run": run,
+                "checks_passed": passed,
+                "violations": len(self.violations),
+                "replays_run": self.replays_run,
+                "replays_planned": self.replays_planned,
+                "mismatches": len(self.mismatches),
+                "recomputes": self.recomputes,
+            }
+            if self.last_replay_unix is not None:
+                # ghost-replay lag: how stale the newest oracle
+                # comparison is — a long lag on a long run means the
+                # sampled coverage stopped keeping up
+                out["replay_age_s"] = round(
+                    max(time.time() - self.last_replay_unix, 0.0), 1
+                )
+            return out
+
+
+_RUN: Optional[IntegrityLog] = None
+
+
+def begin_run() -> IntegrityLog:
+    """Fresh integrity log for a new run (refine()/server entry)."""
+    global _RUN
+    _RUN = IntegrityLog()
+    return _RUN
+
+
+def current() -> IntegrityLog:
+    global _RUN
+    if _RUN is None:
+        _RUN = IntegrityLog()
+    return _RUN
+
+
+def section() -> Optional[Dict[str, Any]]:
+    return _RUN.section() if _RUN is not None else None
+
+
+def live_summary() -> Optional[Dict[str, Any]]:
+    return _RUN.live_summary() if _RUN is not None else None
+
+
+class timed:
+    """``with timed():`` accumulates the block's THREAD-CPU time onto
+    the layer's self-measured overhead — the <2% audit-mode guard reads
+    it. Thread CPU, not wall (the r15 serve-driver precedent): the
+    checks' scalar fetch BLOCKS on the bucket kernel that was going to
+    run anyway, and charging that wait here would bill the workload's
+    own compute to the integrity layer (measured: 86% "overhead" by
+    wall vs a ~0% differential — the stage-boundary sync pays the same
+    wait a moment later)."""
+
+    def __enter__(self):
+        self._t0 = time.thread_time()
+        return self
+
+    def __exit__(self, *exc):
+        current().add_consumed(time.thread_time() - self._t0)
+        return False
+
+
+def _span_violation(check: str, site: str) -> None:
+    """Violations ride spans: bump the ambient span's counter so the
+    trace/heartbeat sees WHERE integrity tripped."""
+    try:
+        from scconsensus_tpu.obs import trace as obs_trace
+
+        sp = obs_trace.current_span()
+        if sp is not None:
+            sp.metrics.counter("integrity_violations").add(1)
+            sp.attrs.setdefault("integrity_trips", []).append(
+                f"{check}@{site}"
+            )
+    except Exception:
+        pass
+
+
+def _settle(check: str, site: str, residual: float,
+            kind: str = "invariant", unit: str = "") -> None:
+    """Record one check outcome; in enforce mode a violation raises the
+    typed error (classified silent_corruption → recompute-the-unit)."""
+    band = tol(check)
+    ok = float(residual) <= band
+    log = current()
+    if kind == "replay":
+        if ok:
+            log.note_replay_ok(site)
+            return
+        log.note_mismatch(check, site, unit, residual, band)
+    else:
+        log.note_check(check, site, ok, residual, band)
+        if ok:
+            return
+    _span_violation(check, site)
+    from scconsensus_tpu.utils.logging import get_logger
+
+    get_logger().warning(
+        "integrity: %s %s at %s (unit %r): residual %.6g > tol %.6g",
+        check, "ghost-replay MISMATCH" if kind == "replay"
+        else "invariant VIOLATED", site, unit or site, residual, band,
+    )
+    if enforcing():
+        cls = GhostReplayMismatch if kind == "replay" \
+            else InvariantViolation
+        raise cls(
+            f"silent corruption: {check} at {site}"
+            + (f" (unit {unit})" if unit else "")
+            + f": residual {residual:.6g} exceeds the tolerance band "
+            f"{band:.6g} — the computation produced an answer the "
+            "algorithm cannot produce",
+            check=check, site=site, magnitude=residual, tol=band,
+        )
+
+
+def should_evict(site: str) -> bool:
+    """True when ``site`` accumulated SCC_INTEGRITY_EVICT_THRESHOLD
+    consecutive silent-corruption detections: the retry policy escalates
+    to its device-loss hook (mesh shrink) instead of another same-mesh
+    recompute — a chip that computes wrong gets evicted like one that
+    died."""
+    thr = max(int(env_flag("SCC_INTEGRITY_EVICT_THRESHOLD")), 1)
+    return current().site_streak(site) >= thr
+
+
+# --------------------------------------------------------------------------
+# (a) invariant checks — device-resident reductions, one scalar crosses
+# --------------------------------------------------------------------------
+
+def check_wilcox_bucket(site: str, log_p, u, ties, n1, n2) -> None:
+    """Rank-sum conservation for one ladder bucket. ``log_p/u/ties`` are
+    the kernel's (Gc, P) DEVICE outputs, ``n1/n2`` host (P,) group
+    sizes. Midranks over the M = n1+n2 pooled cells sum to M(M+1)/2, so
+    U ∈ [0, n1·n2], Σ(t³−t) ∈ [0, M³−M], and log p ≤ 0; the residual is
+    the worst bound violation across the whole bucket — one fused
+    device reduction, one scalar fetch."""
+    if not enabled():
+        return
+    with timed():
+        current().plan("wilcox_conservation")
+        import jax
+        import jax.numpy as jnp
+
+        from scconsensus_tpu.obs.residency import boundary
+
+        jn1 = jnp.asarray(np.asarray(n1, np.float32))
+        jn2 = jnp.asarray(np.asarray(n2, np.float32))
+        m = jn1 + jn2
+        umax = jn1 * jn2
+        tmax = m * m * m - m
+        # Scale-aware slack: the kernel accumulates U and Σ(t³−t) in
+        # float32, whose rounding at M³ ≈ 1e10 is O(relative), so each
+        # bound earns max(band, 4e-6·bound) of slack — a real
+        # corruption (1.5× scale, a sign flip) overshoots by ORDERS,
+        # while honest f32 rounding stays inside. The residual is the
+        # worst violation re-expressed in band units.
+        band = max(tol("wilcox_conservation"), 1e-12)
+        slack_u = jnp.maximum(band, 4e-6 * umax)[None, :]
+        slack_t = jnp.maximum(band, 4e-6 * tmax)[None, :]
+        # NaN entries (degenerate/untested) compare False and drop out
+        # of the max via nan_to_num — legitimate NaN is the r10 numeric
+        # sentinels' territory, not a conservation violation
+        r_u = jnp.maximum(-u, u - umax[None, :]) / slack_u
+        r_t = jnp.maximum(-ties, ties - tmax[None, :]) / slack_t
+        r_p = log_p / jnp.float32(max(1e-3, band))
+        resid = jnp.maximum(
+            jnp.max(jnp.nan_to_num(r_u, nan=-jnp.inf)),
+            jnp.maximum(
+                jnp.max(jnp.nan_to_num(r_t, nan=-jnp.inf)),
+                jnp.max(jnp.nan_to_num(r_p, nan=-jnp.inf)),
+            ),
+        )
+        with boundary("integrity_check"):
+            residual = float(jax.device_get(resid)) * band
+    _settle("wilcox_conservation", site, residual)
+
+
+def check_wilcox_host(site: str, lp: np.ndarray, u: np.ndarray,
+                      n1, n2) -> None:
+    """Host twin of :func:`check_wilcox_bucket` for blocks that already
+    crossed (the streaming runner's per-chunk (P, Gb) fetch): U ∈
+    [0, n1·n2] and log p ≤ 0, pure numpy, no device traffic."""
+    if not enabled():
+        return
+    with timed():
+        current().plan("wilcox_conservation")
+        n1 = np.asarray(n1, np.float64)
+        n2 = np.asarray(n2, np.float64)
+        band = max(tol("wilcox_conservation"), 1e-12)
+        umax = (n1 * n2)[:, None]
+        slack_u = np.maximum(band, 4e-6 * umax)
+        uu = np.asarray(u, np.float64)
+        r_u = np.maximum(-uu, uu - umax) / slack_u
+        lpp = np.asarray(lp, np.float64) / max(1e-3, band)
+        resid = max(
+            float(np.nanmax(r_u, initial=-np.inf)),
+            float(np.nanmax(lpp, initial=-np.inf)),
+        ) * band
+        if not np.isfinite(resid):
+            resid = 0.0
+    _settle("wilcox_conservation", site, resid)
+
+
+def check_bh(site: str, log_p, log_q) -> None:
+    """BH-threshold monotonicity over finite entries: q ≥ p (the cummin
+    never lowers a p below itself while n ≥ rank) and q ≤ 1. One fused
+    device reduction over the (P, G) log arrays."""
+    if not enabled():
+        return
+    with timed():
+        current().plan("bh_monotonic")
+        import jax
+        import jax.numpy as jnp
+
+        from scconsensus_tpu.obs.residency import boundary
+
+        lp = jnp.asarray(log_p)
+        lq = jnp.asarray(log_q)
+        both = jnp.isfinite(lp) & jnp.isfinite(lq)
+        # r1: q must not undercut p  (log_p - log_q <= 0)
+        r1 = jnp.where(both, lp - lq, -jnp.inf)
+        # r2: q <= 1  (log_q <= 0)
+        r2 = jnp.where(jnp.isfinite(lq), lq, -jnp.inf)
+        resid = jnp.maximum(jnp.max(r1), jnp.max(r2))
+        with boundary("integrity_check"):
+            residual = float(jax.device_get(resid))
+    if not np.isfinite(residual):
+        residual = 0.0  # nothing finite to check (all-NaN slab)
+    _settle("bh_monotonic", site, residual)
+
+
+def check_pca_basis(site: str, residual) -> None:
+    """Orthonormality residual ‖V·Vᵀ − I‖∞ of the randomized-subspace
+    basis — computed inside the scores jit (ops.pca), one scalar."""
+    if not enabled():
+        return
+    with timed():
+        current().plan("pca_orthonormal")
+        import jax
+
+        from scconsensus_tpu.obs.residency import boundary
+
+        with boundary("integrity_check"):
+            r = float(jax.device_get(residual))
+    _settle("pca_orthonormal", site, r)
+
+
+def check_landmark_occupancy(site: str, assign: np.ndarray,
+                             k: int, n_cells: int) -> None:
+    """Landmark occupancy conservation: the segment-sum of per-landmark
+    occupancies equals the assigned-cell count, and every assignment
+    indexes a live landmark. Host ints (the assignment is a host output
+    by construction) — exact, zero-tolerance."""
+    if not enabled():
+        return
+    with timed():
+        current().plan("landmark_occupancy")
+        a = np.asarray(assign)
+        # out-of-range indices are counted FIRST and excluded from the
+        # bincount: np.bincount raises on negatives, and an untyped
+        # ValueError here would be exactly the corruption this check
+        # exists to convert into a typed violation
+        bad_idx = int((a < 0).sum() + (a >= int(k)).sum())
+        good = a[(a >= 0) & (a < int(k))]
+        occ = np.bincount(good, minlength=int(k)) if good.size else \
+            np.zeros(int(k), np.int64)
+        residual = float(abs(int(occ.sum()) - int(n_cells)) + bad_idx)
+    _settle("landmark_occupancy", site, residual)
+
+
+def check_contingency(site: str, mat: np.ndarray, ridx: np.ndarray,
+                      cidx: np.ndarray) -> None:
+    """Contingency-table conservation: row sums equal the first
+    labeling's cluster sizes, col sums the second's, the grand total N.
+    ``ridx``/``cidx`` are the unique-inverse index vectors the table was
+    built from — the independent count."""
+    if not enabled():
+        return
+    with timed():
+        current().plan("contingency_sums")
+        m = np.asarray(mat, np.int64)
+        want_rows = np.bincount(np.asarray(ridx), minlength=m.shape[0])
+        want_cols = np.bincount(np.asarray(cidx), minlength=m.shape[1])
+        residual = float(
+            np.abs(m.sum(axis=1) - want_rows).sum()
+            + np.abs(m.sum(axis=0) - want_cols).sum()
+            + abs(int(m.sum()) - int(np.asarray(ridx).size))
+        )
+    _settle("contingency_sums", site, residual)
+
+
+# --------------------------------------------------------------------------
+# (b) ghost replay — the independent float64 host oracle
+# --------------------------------------------------------------------------
+
+def _midranks64(x: np.ndarray) -> Tuple[np.ndarray, float]:
+    """Float64 midranks + pooled tie term Σ(t³−t) — the r6 host
+    contraction forms' reference arithmetic, scipy-ranked."""
+    from scipy.stats import rankdata
+
+    r = rankdata(x.astype(np.float64), method="average")
+    _, counts = np.unique(x.astype(np.float64), return_counts=True)
+    t = counts.astype(np.float64)
+    return r, float(np.sum(t * t * t - t))
+
+
+def wilcox_oracle_pair(vals: np.ndarray, cids: np.ndarray,
+                       n1: int, n2: int, i: int, j: int,
+                       pad_zeros: bool = True) -> Tuple[float, float]:
+    """R's normal-approximation rank-sum for ONE (gene, pair) in pure
+    float64 — the independent reference path the device ladder is
+    replayed against. With ``pad_zeros`` (compacted windows) ``vals``
+    holds only the gene's stored POSITIVE entries and absent cells are
+    implicit zeros, padded here to the full group sizes ``n1``/``n2``;
+    without it (full dense rows) every cell is explicit and values pass
+    through as-is. Returns (log_p, U); degenerate slices return
+    (nan, U) exactly like the kernel."""
+    import math
+
+    from scipy.stats import norm
+
+    v = np.asarray(vals, np.float64)
+    c = np.asarray(cids)
+    if pad_zeros:
+        g1 = v[(c == i) & (v > 0)]
+        g2 = v[(c == j) & (v > 0)]
+        g1 = np.concatenate([g1, np.zeros(max(int(n1) - g1.size, 0))])
+        g2 = np.concatenate([g2, np.zeros(max(int(n2) - g2.size, 0))])
+    else:
+        g1 = v[c == i]
+        g2 = v[c == j]
+    pooled = np.concatenate([g1, g2])
+    ranks, tie_sum = _midranks64(pooled)
+    rs1 = float(ranks[: g1.size].sum())
+    u = rs1 - n1 * (n1 + 1.0) / 2.0
+    z = u - n1 * n2 / 2.0
+    z = z - math.copysign(0.5, z) if z != 0.0 else 0.0
+    m = float(n1 + n2)
+    sigma2 = (n1 * n2 / 12.0) * (
+        (m + 1.0) - tie_sum / max(m * (m - 1.0), 1.0)
+    )
+    if n1 < 1 or n2 < 1 or sigma2 <= 0.0:
+        return float("nan"), u
+    log_p = min(math.log(2.0) + float(norm.logcdf(-abs(z / math.sqrt(sigma2)))),
+                0.0)
+    return log_p, u
+
+
+def _sample_idx(n: int, k: int) -> np.ndarray:
+    """Deterministic spread sample of ``k`` indices over [0, n)."""
+    if n <= k:
+        return np.arange(n)
+    return np.unique(np.linspace(0, n - 1, k).astype(np.int64))
+
+
+def replay_wilcox_window(
+    site: str, unit: str,
+    vals: np.ndarray,            # (Rows, W) host window values
+    cids,                        # (W,) or (Rows, W) host cluster ids
+    n_of: np.ndarray,            # (K,) full group sizes
+    pair_i: np.ndarray, pair_j: np.ndarray,
+    out_lp, out_u,               # (Rows, P) DEVICE kernel outputs
+    n_rows: int,
+    full_rows: bool = False,     # True: vals rows hold ALL cells (dense)
+    n_genes_sample: int = 3, n_pairs_sample: int = 3,
+) -> None:
+    """Ghost-replay one sampled ladder window: recompute a seeded
+    (genes × pairs) sample through :func:`wilcox_oracle_pair` and
+    compare log-p / U within the tolerance bands. Compacted windows
+    arrive as host arrays (the pre-upload vals/cids), so the only
+    crossing is the sampled output rows; dense-device buckets
+    additionally fetch the sampled INPUT rows — both ride the declared
+    ``integrity_check`` boundary."""
+    if not enabled():
+        return
+    with timed():
+        import jax
+        import jax.numpy as jnp
+
+        from scconsensus_tpu.obs.residency import boundary
+
+        g_sel = _sample_idx(int(n_rows), n_genes_sample)
+        ok_pairs = np.nonzero(
+            (np.asarray(n_of)[pair_i] >= 1)
+            & (np.asarray(n_of)[pair_j] >= 1)
+        )[0]
+        if not g_sel.size or not ok_pairs.size:
+            current().note_replay_ok(site)
+            return
+        p_sel = ok_pairs[_sample_idx(int(ok_pairs.size), n_pairs_sample)]
+        with boundary("integrity_check"):
+            lp_dev, u_dev = jax.device_get((
+                jnp.asarray(out_lp)[jnp.asarray(g_sel)][
+                    :, jnp.asarray(p_sel)],
+                jnp.asarray(out_u)[jnp.asarray(g_sel)][
+                    :, jnp.asarray(p_sel)],
+            ))
+            if not isinstance(vals, np.ndarray):
+                vals = np.asarray(jax.device_get(
+                    jnp.asarray(vals)[jnp.asarray(g_sel)]
+                ))
+                g_sel_local = np.arange(vals.shape[0])
+            else:
+                vals = vals[g_sel]
+                g_sel_local = np.arange(vals.shape[0])
+            if not (isinstance(cids, np.ndarray)
+                    or isinstance(cids, (list, tuple))):
+                if getattr(cids, "ndim", 1) == 2:
+                    cids = np.asarray(jax.device_get(
+                        jnp.asarray(cids)[jnp.asarray(g_sel)]
+                    ))
+                else:
+                    cids = np.asarray(jax.device_get(cids))
+            elif np.asarray(cids).ndim == 2:
+                cids = np.asarray(cids)[g_sel]
+        # one dimensionless residual: each delta normalized by its own
+        # band, the worst carried; _settle re-scales onto the logp band
+        # so the recorded magnitude/tol pair stays interpretable
+        worst_norm = 0.0
+        tol_p = max(tol("replay_wilcox_logp"), 1e-12)
+        tol_u = max(tol("replay_wilcox_u"), 1e-12)
+        cids = np.asarray(cids)
+        for gi in g_sel_local:
+            row = np.asarray(vals[gi], np.float64)
+            crow = cids[gi] if cids.ndim == 2 else cids
+            for pi, p in enumerate(p_sel):
+                i, j = int(pair_i[p]), int(pair_j[p])
+                n1, n2 = int(n_of[i]), int(n_of[j])
+                if full_rows:
+                    sel = (crow == i) | (crow == j)
+                    lp_ref, u_ref = wilcox_oracle_pair(
+                        row[sel], crow[sel], n1, n2, i, j,
+                        pad_zeros=False,
+                    )
+                else:
+                    lp_ref, u_ref = wilcox_oracle_pair(
+                        row, crow, n1, n2, i, j
+                    )
+                lp_d, u_d = float(lp_dev[gi, pi]), float(u_dev[gi, pi])
+                if np.isnan(lp_ref) != np.isnan(lp_d):
+                    worst_norm = max(worst_norm, float("inf"))
+                    continue
+                if not np.isnan(lp_ref):
+                    # absolute band near 0, relative (2 %) for the huge
+                    # negative log-p where f32 logcdf rounding grows
+                    band = max(tol_p, 0.02 * abs(lp_ref))
+                    worst_norm = max(worst_norm,
+                                     abs(lp_ref - lp_d) / band)
+                worst_norm = max(worst_norm, abs(u_ref - u_d) / tol_u)
+        worst = worst_norm * tol("replay_wilcox_logp")
+    _settle("replay_wilcox_logp", site, worst, kind="replay", unit=unit)
+
+
+def replay_stream_chunk(site: str, unit: str, block, cids: np.ndarray,
+                        n_of: np.ndarray, pair_i: np.ndarray,
+                        pair_j: np.ndarray, lp: np.ndarray,
+                        u: np.ndarray, n_genes_sample: int = 3,
+                        n_pairs_sample: int = 3) -> None:
+    """Ghost-replay one streaming chunk: a seeded (genes × pairs)
+    sample of the chunk's (P, Gb) host outputs recomputed through the
+    float64 oracle from the CSR slab's own rows — entirely host-side
+    (the block and its outputs already crossed on the stream
+    boundaries), so the replay adds zero device traffic."""
+    if not enabled():
+        return
+    with timed():
+        gb = int(block.shape[0])
+        g_sel = _sample_idx(gb, n_genes_sample)
+        ok_pairs = np.nonzero(
+            (np.asarray(n_of)[pair_i] >= 1)
+            & (np.asarray(n_of)[pair_j] >= 1)
+        )[0]
+        if not g_sel.size or not ok_pairs.size:
+            current().note_replay_ok(site)
+            return
+        p_sel = ok_pairs[_sample_idx(int(ok_pairs.size), n_pairs_sample)]
+        rows = np.asarray(block[g_sel].toarray(), np.float64)
+        worst_norm = 0.0
+        tol_p = max(tol("replay_wilcox_logp"), 1e-12)
+        tol_u = max(tol("replay_wilcox_u"), 1e-12)
+        lp = np.asarray(lp)
+        u = np.asarray(u)
+        for gi, g in enumerate(g_sel):
+            for p in p_sel:
+                i, j = int(pair_i[p]), int(pair_j[p])
+                n1, n2 = int(n_of[i]), int(n_of[j])
+                sel = (cids == i) | (cids == j)
+                lp_ref, u_ref = wilcox_oracle_pair(
+                    rows[gi][sel], np.asarray(cids)[sel], n1, n2, i, j,
+                    pad_zeros=False,
+                )
+                lp_d, u_d = float(lp[p, g]), float(u[p, g])
+                if np.isnan(lp_ref) != np.isnan(lp_d):
+                    worst_norm = max(worst_norm, float("inf"))
+                    continue
+                if not np.isnan(lp_ref):
+                    band = max(tol_p, 0.02 * abs(lp_ref))
+                    worst_norm = max(worst_norm,
+                                     abs(lp_ref - lp_d) / band)
+                worst_norm = max(worst_norm, abs(u_ref - u_d) / tol_u)
+        worst = worst_norm * tol("replay_wilcox_logp")
+    _settle("replay_wilcox_logp", site, worst, kind="replay", unit=unit)
+
+
+def replay_landmark_block(site: str, x_rows, cent: np.ndarray,
+                          assign_rows: np.ndarray, unit: str = "block0",
+                          ) -> None:
+    """Ghost-replay one landmark-assignment block: float64 nearest-
+    landmark argmin vs the device assignment, tie-tolerant (a device
+    pick is wrong only if the oracle's choice is STRICTLY closer beyond
+    the relative band — f32 ties may break either way). Device
+    ``x_rows`` fetch on the declared boundary."""
+    if not enabled():
+        return
+    with timed():
+        if not isinstance(x_rows, np.ndarray):
+            import jax
+
+            from scconsensus_tpu.obs.residency import boundary
+
+            with boundary("integrity_check"):
+                x_rows = np.asarray(jax.device_get(x_rows))
+        x = np.asarray(x_rows, np.float64)
+        c = np.asarray(cent, np.float64)
+        a = np.asarray(assign_rows)
+        d2 = (
+            np.sum(x * x, axis=1, keepdims=True)
+            - 2.0 * x @ c.T
+            + np.sum(c * c, axis=1)[None, :]
+        )
+        best = np.min(d2, axis=1)
+        chosen = d2[np.arange(a.size), np.clip(a, 0, c.shape[0] - 1)]
+        scale = np.maximum(np.abs(best), 1e-9)
+        bad_idx = (a < 0) | (a >= c.shape[0])
+        worst = float(np.max(np.where(
+            bad_idx, np.inf, (chosen - best) / scale
+        ))) if a.size else 0.0
+    _settle("replay_landmark_d2", site, worst, kind="replay", unit=unit)
+
+
+def replay_pca_rows(site: str, x, mean, components, scores,
+                    n_rows: int, unit: str = "rows",
+                    n_sample: int = 4) -> None:
+    """Ghost-replay sampled embedding rows: float64
+    (x − mean) @ componentsᵀ vs the device scores, relative band. ``x``
+    and ``scores`` may be device arrays — the seeded sample rows (plus
+    the small mean/basis) are the only crossing, on the declared
+    boundary."""
+    if not enabled():
+        return
+    with timed():
+        import jax
+        import jax.numpy as jnp
+
+        from scconsensus_tpu.obs.residency import boundary
+
+        sel = _sample_idx(int(n_rows), n_sample)
+        if not sel.size:
+            current().note_replay_ok(site)
+            return
+        with boundary("integrity_check"):
+            xr, sr, mu, vt = jax.device_get((
+                jnp.asarray(x)[jnp.asarray(sel)],
+                jnp.asarray(scores)[jnp.asarray(sel)],
+                jnp.asarray(mean), jnp.asarray(components),
+            ))
+        xh = np.asarray(xr, np.float64)
+        ref = (xh - np.asarray(mu, np.float64)[None, :]) \
+            @ np.asarray(vt, np.float64).T
+        got = np.asarray(sr, np.float64)
+        scale = max(float(np.max(np.abs(ref))), 1e-6)
+        worst = float(np.max(np.abs(ref - got))) / scale
+    _settle("replay_pca", site, worst, kind="replay", unit=unit)
+
+
+def replay_classify(site: str, x: np.ndarray, labels: np.ndarray,
+                    model, unit: str = "batch") -> None:
+    """Ghost-replay one serving batch: the frozen model's float64 host
+    mirror (classify_host) vs the device labels, distance-tie-tolerant.
+    A disagreement beyond the band means the device path answered with
+    labels its own model cannot produce."""
+    if not enabled():
+        return
+    with timed():
+        ref_lab, _ = model.classify_host(np.asarray(x))
+        got = np.asarray(labels)
+        if got.shape != ref_lab.shape:
+            worst = float("inf")
+        else:
+            diff = got != ref_lab
+            if not diff.any():
+                worst = 0.0
+            else:
+                # tie tolerance: a differing label is a true mismatch
+                # only when the oracle's landmark is strictly closer
+                # than the device's beyond the relative band
+                xp = model._gather_panel(np.asarray(x)).astype(np.float64)
+                proj = (xp - model.pca_mean.astype(np.float64)) @ \
+                    model.pca_components.astype(np.float64).T
+                c = model.centroids.astype(np.float64)
+                d2 = (
+                    np.sum(proj * proj, axis=1, keepdims=True)
+                    - 2.0 * proj @ c.T
+                    + np.sum(c * c, axis=1)[None, :]
+                )
+                best = np.min(d2, axis=1)
+                lab_to_cent: Dict[int, np.ndarray] = {}
+                clab = model.centroid_labels.astype(np.int64)
+                worst = 0.0
+                for r in np.nonzero(diff)[0]:
+                    lr = int(got[r])
+                    cands = lab_to_cent.setdefault(
+                        lr, np.nonzero(clab == lr)[0]
+                    )
+                    chosen = float(np.min(d2[r, cands])) if cands.size \
+                        else float("inf")
+                    worst = max(
+                        worst,
+                        (chosen - float(best[r]))
+                        / max(abs(float(best[r])), 1e-9),
+                    )
+    _settle("replay_classify_d2", site, worst, kind="replay", unit=unit)
+
+
+# --------------------------------------------------------------------------
+# schema validation (stdlib — validate_run_record dispatches here)
+# --------------------------------------------------------------------------
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(f"integrity section: {msg}")
+
+
+def _nonneg(v: Any, name: str) -> int:
+    _require(isinstance(v, int) and v >= 0,
+             f"{name} must be an int >= 0, got {v!r}")
+    return v
+
+
+def validate_integrity(ig: Dict[str, Any]) -> None:
+    """Structural validation of a record's ``integrity`` section. The
+    load-bearing rule (the perf-gate smoke pins it): a section claiming
+    ``all_checks_passed`` must have run every check it planned, passed
+    every check it ran, and matched every ghost replay — claims must
+    carry evidence."""
+    _require(isinstance(ig, dict), "must be an object")
+    _require(ig.get("mode") in ("audit", "enforce"),
+             f"mode must be 'audit' or 'enforce', got {ig.get('mode')!r}")
+    ch = ig.get("checks")
+    _require(isinstance(ch, dict), "checks must be an object")
+    planned = _nonneg(ch.get("planned"), "checks.planned")
+    run = _nonneg(ch.get("run"), "checks.run")
+    passed = _nonneg(ch.get("passed"), "checks.passed")
+    _require(run <= planned,
+             f"checks.run ({run}) exceeds checks.planned ({planned})")
+    _require(passed <= run,
+             f"checks.passed ({passed}) exceeds checks.run ({run})")
+    violations = ig.get("violations", [])
+    _require(isinstance(violations, list), "violations must be a list")
+    for i, v in enumerate(violations):
+        _require(isinstance(v, dict) and bool(v.get("check"))
+                 and bool(v.get("site")),
+                 f"violations[{i}] needs check and site")
+    per = ig.get("per_check", {})
+    _require(isinstance(per, dict), "per_check must be an object")
+    for name, b in per.items():
+        _require(isinstance(b, dict), f"per_check[{name}] must be an "
+                                      "object")
+        p_, r_, s_ = (_nonneg(b.get(k), f"per_check[{name}].{k}")
+                      for k in ("planned", "run", "passed"))
+        _require(s_ <= r_ <= p_,
+                 f"per_check[{name}] counters must satisfy "
+                 "passed <= run <= planned")
+    gh = ig.get("ghost")
+    _require(isinstance(gh, dict), "ghost must be an object")
+    g_planned = _nonneg(gh.get("planned"), "ghost.planned")
+    g_run = _nonneg(gh.get("run"), "ghost.run")
+    g_passed = _nonneg(gh.get("passed"), "ghost.passed")
+    _require(g_run <= g_planned,
+             f"ghost.run ({g_run}) exceeds ghost.planned ({g_planned})")
+    _require(g_passed <= g_run,
+             f"ghost.passed ({g_passed}) exceeds ghost.run ({g_run})")
+    mms = gh.get("mismatches", [])
+    _require(isinstance(mms, list), "ghost.mismatches must be a list")
+    _require(len(mms) <= max(g_run - g_passed, 0),
+             f"ghost.mismatches lists {len(mms)} entries but only "
+             f"{max(g_run - g_passed, 0)} replays failed — a mismatch "
+             "that never ran is fabricated evidence")
+    recomputes = _nonneg(gh.get("recomputes", 0), "ghost.recomputes")
+    if ig.get("all_checks_passed"):
+        _require(
+            run == planned,
+            "all_checks_passed claimed with checks_run < checks_planned "
+            f"({run} < {planned}) — a check that never ran proves "
+            "nothing, and claiming otherwise is the exact failure this "
+            "layer exists to catch",
+        )
+        _require(passed == run and not violations,
+                 "all_checks_passed claimed with failed checks or "
+                 "recorded violations — the claim contradicts its own "
+                 "evidence")
+        _require(g_run == g_planned and g_passed == g_run,
+                 "all_checks_passed claimed with unmatched or unrun "
+                 "ghost replays")
+    if recomputes:
+        _require(
+            len(mms) >= 1 or g_run > g_passed or passed < run
+            or bool(violations),
+            "recomputes claimed with no recorded detection (no "
+            "mismatch, no violation) — a recompute without a detection "
+            "is a phantom corruption",
+        )

@@ -286,7 +286,8 @@ def live_summary() -> Optional[Dict[str, Any]]:
 # schema validation
 # --------------------------------------------------------------------------
 
-_ERROR_CLASSES = ("transient", "resource", "disk", "device_lost", "fatal")
+_ERROR_CLASSES = ("transient", "resource", "disk", "silent_corruption",
+                  "device_lost", "fatal")
 _TRANSITION_CAUSES = ("device_loss", "resume")
 
 

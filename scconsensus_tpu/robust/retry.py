@@ -12,6 +12,16 @@ embed stage, the pipeline's stage-boundary recovery — runs through
      too, because the right retry is a *different* write — sweep
      reclaimable files, shrink checkpoint granularity — while the
      quarantine machinery has already isolated anything torn),
+     ``silent_corruption`` (round 18 — a computation-integrity
+     detection, robust.integrity: an invariant violated at a stage
+     boundary or a ghost-replay mismatch against the float64 oracle.
+     The recovery is recompute-the-unit — a plain retry, because the
+     corrupted VALUES never left the unit; the degrade hook does NOT
+     run (there is nothing to free or shrink — the answer was wrong,
+     not big). REPEATED detection at one site escalates: once
+     ``integrity.should_evict`` trips, the retry runs the caller's
+     ``on_device_loss`` hook instead, so a chip that computes wrong
+     gets evicted like one that died),
      ``device_lost`` (a lost/preempted
      device or a mesh whose device set no longer exists — run the
      caller's ``on_device_loss`` hook, which rebuilds the mesh on
@@ -51,7 +61,8 @@ __all__ = [
     "default_policy",
 ]
 
-ERROR_CLASSES = ("transient", "resource", "disk", "device_lost", "fatal")
+ERROR_CLASSES = ("transient", "resource", "disk", "silent_corruption",
+                 "device_lost", "fatal")
 
 # Message fragments, lowercase. Matched against str(exc) / raw text; the
 # XLA runtime stringifies device failures with their gRPC-style status
@@ -82,6 +93,19 @@ _DISK_PAT = (
     "checksum mismatch", "torn chunk", "unparseable npz",
     "sidecar unreadable",
 )
+# Silent-corruption signatures (round 18, robust.integrity): the typed
+# integrity errors stringify with these — and a remote worker's stderr
+# tail carrying them classifies the same way. Loses only to device_lost
+# (a dead chip may also miscompute on the way down, and only a mesh
+# rebuild helps); wins over disk/resource/transient because the right
+# retry is a RECOMPUTE of the unit, not a different write, a smaller
+# shape, or an unchanged re-dispatch of the program that just proved it
+# computes wrong.
+_SILENT_CORRUPTION_PAT = (
+    "silent corruption", "silent_corruption",
+    "ghost replay mismatch", "ghost-replay mismatch",
+    "integrity violation", "invariant violated",
+)
 # Device-loss signatures: what the XLA/PJRT runtime actually prints when
 # a chip dies or is preempted mid-program, plus the JAX-level errors a
 # Mesh raises once its device set no longer matches the live client
@@ -103,11 +127,14 @@ _DEVICE_LOST_PAT = (
 
 
 def classify_text(text: Optional[str]) -> Optional[str]:
-    """'device_lost' | 'disk' | 'resource' | 'transient' | None (no
-    signature recognized) for raw text — stderr tails, TUNNEL_LOG probe
-    errors, heartbeat post-mortems. Device-loss wins over everything (a
-    dead chip often also prints UNAVAILABLE, and only a mesh rebuild
-    helps); disk wins over resource/transient (an ENOSPC strerror also
+    """'device_lost' | 'silent_corruption' | 'disk' | 'resource' |
+    'transient' | None (no signature recognized) for raw text — stderr
+    tails, TUNNEL_LOG probe errors, heartbeat post-mortems. Device-loss
+    wins over everything (a dead chip often also prints UNAVAILABLE,
+    and only a mesh rebuild helps); silent_corruption wins over
+    disk/resource/transient (an integrity detection names the wrongness
+    of the ANSWER — recompute-the-unit is the only retry that can fix
+    it); disk wins over resource/transient (an ENOSPC strerror also
     says "error", and retrying a full filesystem unchanged loops);
     resource wins over transient (degrading is the safer adaptation — a
     transient retry of a genuinely too-big shape loops)."""
@@ -116,6 +143,8 @@ def classify_text(text: Optional[str]) -> Optional[str]:
     low = str(text).lower()
     if any(p in low for p in _DEVICE_LOST_PAT):
         return "device_lost"
+    if any(p in low for p in _SILENT_CORRUPTION_PAT):
+        return "silent_corruption"
     if any(p in low for p in _DISK_PAT):
         return "disk"
     if any(p in low for p in _RESOURCE_PAT):
@@ -131,6 +160,14 @@ def classify_exception(exc: BaseException) -> str:
     else fatal."""
     if isinstance(exc, faults.InjectedDeviceLoss):
         return "device_lost"
+    # the typed integrity errors classify BEFORE their message is
+    # consulted (type-first, like the injected fault family): the
+    # signature matrix test pins tolerance-band mismatch, float64-oracle
+    # disagreement, and injected bit-flip all landing here
+    from scconsensus_tpu.robust import integrity as _integrity
+
+    if isinstance(exc, _integrity.IntegrityError):
+        return "silent_corruption"
     if isinstance(exc, faults.InjectedDiskFault):
         return "disk"
     if isinstance(exc, (MemoryError, faults.InjectedResourceExhausted)):
@@ -204,6 +241,15 @@ class RetryPolicy:
                     record.note_retry(site, err_class, attempt,
                                       recovered=True,
                                       backoff_s=backoff_total)
+                    if err_class == "silent_corruption":
+                        # the corrupted unit was recomputed clean — the
+                        # integrity section's recovery evidence
+                        from scconsensus_tpu.robust import (
+                            integrity as _integrity,
+                        )
+
+                        _integrity.current().note_recompute()
+                        _integrity.current().reset_streak(site)
                 return out
             except Exception as e:
                 err_class = classify(e)
@@ -231,6 +277,48 @@ class RetryPolicy:
                         # the adaptation IS the recovery here: shrink the
                         # mesh onto survivors before re-entering the stage
                         on_device_loss(attempt)
+                    elif err_class == "silent_corruption":
+                        # recompute-the-unit: a plain retry, UNLESS the
+                        # site keeps miscomputing — repeated detections
+                        # past the eviction threshold run the device-
+                        # loss hook, so a chip that computes wrong gets
+                        # evicted like one that died (the shrunk mesh
+                        # excludes it and the unit recomputes there)
+                        from scconsensus_tpu.robust import (
+                            integrity as _integrity,
+                        )
+
+                        # streak keyed on the DETECTION's own site (the
+                        # ladder bucket, the serve device call), which a
+                        # propagated error carries — the stage-level
+                        # guard must escalate on the inner site's record
+                        det_site = getattr(e, "site", "") or site
+                        if (on_device_loss is not None
+                                and _integrity.should_evict(det_site)):
+                            _integrity.current().reset_streak(det_site)
+                            try:
+                                on_device_loss(attempt)
+                                record.note_degradation(
+                                    det_site,
+                                    "evict-miscomputing-device",
+                                    "repeated silent-corruption "
+                                    "detections — mesh shrunk off the "
+                                    "suspect chip before the recompute",
+                                )
+                            except Exception:
+                                # no smaller mesh (serial run, floor
+                                # reached): eviction is unavailable —
+                                # the bounded recompute ladder is still
+                                # the best remaining move, so keep
+                                # retrying rather than converting a
+                                # detected corruption into a crash
+                                record.note_degradation(
+                                    det_site, "eviction-unavailable",
+                                    "repeated silent-corruption "
+                                    "detections but no smaller mesh to "
+                                    "shrink to; continuing recompute "
+                                    "attempts",
+                                )
                     elif degrade is not None and err_class in ("resource",
                                                                "disk"):
                         # both classes demand a DIFFERENT retry: resource

@@ -1,0 +1,180 @@
+"""Runnable integrity-soak worker: the chaos harness's in-memory
+workload and the cross-shape determinism auditor's unit of replay.
+
+    python -m scconsensus_tpu.robust.soak --dir DIR [--cells N]
+        [--genes G] [--clusters K] [--seed S] [--summary PATH]
+        [--stream] [--stream-window W] [--mesh auto|none]
+
+Builds the SAME deterministic planted-marker dataset as the streaming
+soak (``stream.soak.chunk_generator`` — every row a pure function of
+(seed, gene), independent of chunk boundaries) and runs one full
+``refine()`` over it: in-memory CSR by default, or out-of-core through
+a ``ChunkedCSRStore`` with ``--stream`` (``--stream-window`` sets the
+chunk shape). Writes one summary JSON whose ``labels_sha`` is a pure
+function of (seed, shape) — which is exactly what makes it a reusable
+auditor:
+
+  * ``tools/chaos_run.py``'s ``INTEGRITY_SOAK_MATRIX`` runs it under
+    injected in-computation corruption (``SCC_FAULT_PLAN`` +
+    ``SCC_INTEGRITY=enforce``) and pins the corrupted-then-recovered
+    run's sha equal to a clean reference run's — detection, typed
+    silent_corruption recompute, byte-identical labels;
+  * ``tools/verify_run.py`` replays the same workload under different
+    chunk/mesh/batch shapes (stream windows, a forced 8-virtual-device
+    mesh, the scan-vs-runspace kernel family) and pins ONE sha across
+    all of them — the scattered per-PR identity tests as one auditor.
+
+The exit code IS the contract: 0 = the run completed, the run record
+(integrity/robustness/streaming sections included) validates, and
+labels were produced for every deepSplit; 1 = the contract broke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = ["run_integrity_soak", "main"]
+
+
+def run_integrity_soak(
+    workdir: str, n_cells: int = 3000, n_genes: int = 120,
+    n_clusters: int = 3, seed: int = 7, stream: bool = False,
+    stream_window: Optional[int] = None, mesh: str = "none",
+    fresh: bool = False,
+) -> Dict[str, Any]:
+    """One deterministic refine; returns the summary dict (module doc)."""
+    from scconsensus_tpu.config import ReclusterConfig
+    from scconsensus_tpu.models.pipeline import refine
+    from scconsensus_tpu.obs.export import (
+        build_run_record,
+        validate_run_record,
+    )
+    from scconsensus_tpu.stream.soak import (
+        _labels_sha,
+        chunk_generator,
+        consensus_input,
+    )
+
+    gen = chunk_generator(n_genes, n_cells, n_clusters, seed)
+    labels = consensus_input(n_cells, n_clusters, seed)
+    config = ReclusterConfig(
+        method="wilcox", q_val_thrs=0.1, log_fc_thrs=0.25, min_pct=5.0,
+        deep_split_values=(1, 2), min_cluster_size=10,
+        n_top_de_genes=20, random_seed=seed,
+    )
+    t0 = time.perf_counter()
+    if stream:
+        from scconsensus_tpu.stream.store import ChunkedCSRStore
+
+        chunks_dir = os.path.join(workdir, "chunks")
+        stages_dir = os.path.join(workdir, "stages")
+        if fresh:
+            for d in (chunks_dir, stages_dir):
+                shutil.rmtree(d, ignore_errors=True)
+        win = int(stream_window or 32)
+        store = ChunkedCSRStore.create(chunks_dir, n_genes, n_cells, win)
+        config.artifact_dir = stages_dir
+        from scconsensus_tpu.stream.runner import streaming_refine
+
+        result = streaming_refine(store, labels, config,
+                                  stage_dir=stages_dir, regen=gen)
+    else:
+        data = gen(0, n_genes)  # one scipy CSR matrix, seed-pure
+        result = refine(data, labels, config,
+                        mesh=("auto" if mesh == "auto" else None))
+    wall = time.perf_counter() - t0
+    ig = result.metrics.get("integrity")
+    rb = result.metrics.get("robustness")
+    rec = build_run_record(
+        metric=f"integrity soak: {n_cells}-cell refine",
+        value=round(wall, 3), unit="seconds",
+        extra={"config": "integrity-soak", "platform": "cpu",
+               "n_cells": n_cells, "n_genes": n_genes,
+               "stream": bool(stream)},
+        spans=result.metrics.get("spans") or [],
+        robustness=rb,
+        integrity=ig,
+        streaming=result.metrics.get("streaming"),
+    )
+    invalid = None
+    try:
+        validate_run_record(rec)
+    except ValueError as e:
+        invalid = str(e)
+    have_all_cuts = all(
+        f"deepsplit: {d}" in result.dynamic_labels
+        for d in config.deep_split_values
+    )
+    gh = (ig or {}).get("ghost") or {}
+    sc_retries = [r for r in (rb or {}).get("retries") or []
+                  if r.get("error_class") == "silent_corruption"
+                  and r.get("recovered")]
+    mesh_transitions = (rb or {}).get("mesh_transitions") or []
+    return {
+        "ok": bool(invalid is None and have_all_cuts),
+        "invalid": invalid,
+        "wall_s": round(wall, 3),
+        "labels_sha": _labels_sha(result.dynamic_labels),
+        "integrity": ig,
+        "detections": (len((ig or {}).get("violations") or [])
+                       + len(gh.get("mismatches") or [])),
+        "recomputes": gh.get("recomputes", 0),
+        "sc_retries_recovered": len(sc_retries),
+        "mesh_transitions": len(mesh_transitions),
+        "mesh_final_devices": (
+            len(mesh_transitions[-1].get("to_devices") or [])
+            if mesh_transitions else None
+        ),
+        "record": rec,
+    }
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description="integrity soak worker")
+    ap.add_argument("--dir", required=True, help="work directory")
+    ap.add_argument("--cells", type=int, default=3000)
+    ap.add_argument("--genes", type=int, default=120)
+    ap.add_argument("--clusters", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--stream", action="store_true",
+                    help="run out-of-core through a ChunkedCSRStore")
+    ap.add_argument("--stream-window", type=int, default=None)
+    ap.add_argument("--mesh", choices=("none", "auto"), default="none",
+                    help="'auto' uses every visible device (force a "
+                         "virtual mesh via XLA_FLAGS in the parent)")
+    ap.add_argument("--summary", default=None)
+    ap.add_argument("--fresh", action="store_true")
+    args = ap.parse_args(argv)
+
+    summary_path = args.summary or os.path.join(
+        args.dir, "INTEGRITY_SOAK_SUMMARY.json"
+    )
+    os.makedirs(args.dir, exist_ok=True)
+    summary = run_integrity_soak(
+        args.dir, n_cells=args.cells, n_genes=args.genes,
+        n_clusters=args.clusters, seed=args.seed, stream=args.stream,
+        stream_window=args.stream_window, mesh=args.mesh,
+        fresh=args.fresh,
+    )
+    with open(summary_path, "w") as f:
+        json.dump(summary, f, indent=1, default=str)
+    print(json.dumps({
+        "ok": summary["ok"],
+        "detections": summary["detections"],
+        "recomputes": summary["recomputes"],
+        "mesh_transitions": summary["mesh_transitions"],
+        "labels_sha": summary["labels_sha"][:16],
+    }))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -495,8 +495,17 @@ class ConsensusServer:
     def _device_classify(self, x: np.ndarray):
         """One guarded device call (fault site ``serve_device``); batches
         are padded to the next power of two so the jitted kernel compiles
-        O(log max_batch) shapes, not one per batch size."""
+        O(log max_batch) shapes, not one per batch size. Under
+        ``SCC_INTEGRITY`` the injected ``serve_classify`` corruption
+        site perturbs the device labels, and a seeded sample of batches
+        (the first of every 64) is ghost-replayed against the model's
+        float64 host mirror — a mismatch raises typed
+        silent_corruption, which the in-batch retry loop recomputes
+        (and the breaker counts, so a device that KEEPS answering wrong
+        degrades to the host mirror exactly like one that keeps
+        crashing)."""
         from scconsensus_tpu.robust import faults
+        from scconsensus_tpu.robust import integrity as robust_integrity
 
         faults.fault_point("serve_device")
         n = x.shape[0]
@@ -508,6 +517,14 @@ class ConsensusServer:
                 [x, np.zeros((padded - n, x.shape[1]), x.dtype)]
             )
         labels, dist = self.model.classify(x)
+        labels = faults.corrupt_value("serve_classify", labels)
+        if robust_integrity.enabled() and \
+                robust_integrity.current().want_replay(
+                    "serve", self._batch_seq // 64):
+            robust_integrity.replay_classify(
+                "serve_classify", x[:n], labels[:n], self.model,
+                unit=f"batch:{self._batch_seq}",
+            )
         return labels[:n], dist[:n]
 
     def _process(self, batch: List[RequestHandle]) -> None:

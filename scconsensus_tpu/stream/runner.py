@@ -157,6 +157,9 @@ def streaming_refine(
             "out-of-core yet"
         )
     robust_record.begin_run()
+    from scconsensus_tpu.robust import integrity as robust_integrity
+
+    robust_integrity.begin_run()
     logger = get_logger()
     timer = timer or StageTimer(logger)
     G, N = store.shape
@@ -223,6 +226,9 @@ def streaming_refine(
     rb = robust_record.section()
     if rb is not None:
         result.metrics["robustness"] = rb
+    ig = robust_integrity.section()
+    if ig is not None:
+        result.metrics["integrity"] = ig
     try:
         stages.save("robust_state", meta={"budget_used": 0})
     except Exception:
@@ -423,8 +429,41 @@ def _streaming_impl(store, lab, config, gene_names, timer, stages, state,
                     )
                     with residency.boundary("stream_block_fetch"):
                         lp_h, u_h = jax.device_get((lp_d, u_d))
-                    lp_rows.append(np.asarray(lp_h, np.float32))
-                    u_rows.append(np.asarray(u_h, np.float32))
+                    lp_h = np.asarray(lp_h, np.float32)
+                    u_h = np.asarray(u_h, np.float32)
+                    # integrity tier (robust.integrity, r18): the
+                    # injected stream_block corruption site, the
+                    # conservation invariant over the fetched block,
+                    # and one host-side ghost replay per run — a
+                    # detection raises typed silent_corruption inside
+                    # this chunk's guard, so recompute-the-unit re-runs
+                    # THIS chunk before it persists
+                    from scconsensus_tpu.de.engine import (
+                        _cid_from_groups,
+                    )
+                    from scconsensus_tpu.robust import (
+                        integrity as robust_integrity,
+                    )
+                    from scconsensus_tpu.robust.faults import (
+                        corrupt_value,
+                    )
+
+                    lp_h, u_h = corrupt_value("stream_block",
+                                              (lp_h, u_h))
+                    if robust_integrity.enabled():
+                        robust_integrity.check_wilcox_host(
+                            "stream_block", lp_h, u_h,
+                            n_of[pair_i], n_of[pair_j],
+                        )
+                        if robust_integrity.current().want_replay(
+                                "stream_chunk", 0):
+                            robust_integrity.replay_stream_chunk(
+                                "stream_block", f"chunk:{i}", sub,
+                                _cid_from_groups(cell_idx_of, N),
+                                n_of, pair_i, pair_j, lp_h, u_h,
+                            )
+                    lp_rows.append(lp_h)
+                    u_rows.append(u_h)
                     agg_parts.append(_chunk_aggregates(sub, cell_idx, K))
                 finally:
                     acct.release(est, "de_window")

@@ -49,8 +49,10 @@ APPROVED = {
     # declared de_ckpt_fetch boundary — the wilcox ladder's mid-stage
     # bucket checkpoints fetch each completed (Gb, P) block for the
     # ArtifactStore (store-gated; SCC_ROBUST_DE_CKPT), and resume wraps
-    # the loaded host blocks back to device
-    "de/engine.py": {"np.asarray(": 57, "np.array(": 7,
+    # the loaded host blocks back to device.
+    # r18 integrity: +1 jnp.asarray — h2d staging of log_p for the
+    # BH-monotonicity invariant check (device-resident, no fetch)
+    "de/engine.py": {"np.asarray(": 58, "np.array(": 7,
                      "jax.device_get": 11, ".block_until_ready(": 4},
     "ops/colors.py": {"np.asarray(": 1},
     "ops/distance.py": {"np.asarray(": 1, "np.array(": 1},
@@ -63,8 +65,10 @@ APPROVED = {
     # intended d2h fetches ((k, d) centroids + (N,) assignment).
     # r15 serving: +2 host-only int conversions in
     # centroid_majority_labels (assign/labels vote tally — no device
-    # arrays in scope)
-    "ops/pooling.py": {"np.asarray(": 11},
+    # arrays in scope).
+    # r18 integrity: +1 jnp.asarray — h2d staging of the sampled ghost-
+    # replay block index for the device gather (no fetch)
+    "ops/pooling.py": {"np.asarray(": 12},
     "ops/silhouette.py": {"np.asarray(": 7},
     # r7 weighted cuts: +2 host-only conversions of the per-leaf weight
     # vector (treecut is a host algorithm; no device arrays in scope)
@@ -72,8 +76,10 @@ APPROVED = {
     "ops/treecut_direct.py": {"np.asarray(": 3},
     "ops/wilcoxon.py": {"np.asarray(": 1},
     # r7: +3 host scalar wraps of the landmark telemetry (k, sketch,
-    # linkage code) for the artifact store — no device arrays involved
-    "models/pipeline.py": {"np.asarray(": 10, "np.array(": 1},
+    # linkage code) for the artifact store — no device arrays involved.
+    # r18 integrity: +1 jnp.asarray — the audited-embed branch stages
+    # cells once and reuses the handle for scores + ghost replay
+    "models/pipeline.py": {"np.asarray(": 11, "np.array(": 1},
     "parallel/mesh.py": {"np.asarray(": 3, ".block_until_ready(": 1},
     "parallel/ring.py": {"np.asarray(": 11},
     "parallel/sharded_de.py": {"np.asarray(": 8, "jax.device_get": 2},

@@ -34,8 +34,17 @@ chunk → quarantine-and-recompute to identical labels, ENOSPC at the
 chunk-write site → typed disk-class recovery, host-budget breach →
 window-halving recovery, plus the standing device-loss plan run against
 the atlas_query fleet shape so device-class recovery is proven beyond
-the anchor pipeline). ``--soak-plans`` filters all three matrices by
-name (comma-separated) for bounded CI runs.
+the anchor pipeline) and :data:`INTEGRITY_SOAK_MATRIX` (round 18, the
+silent-corruption axis, driven through the replayable worker ``python
+-m scconsensus_tpu.robust.soak`` under ``SCC_INTEGRITY=enforce``:
+injected in-computation corruption at a ladder window → detected by an
+invariant/ghost-replay check → typed silent_corruption recompute →
+labels byte-identical to a clean reference run; repeated corruption
+pinned to one device of a forced 8-virtual-device mesh → the elastic
+supervisor evicts the miscomputing chip — mesh shrink recorded — and
+the run still lands byte-identical labels, extending the r14 plan from
+chips that die to chips that lie). ``--soak-plans`` filters all four
+matrices by name (comma-separated) for bounded CI runs.
 
 Exit codes: 0 chaos contract held; 1 it did not; 2 usage/IO error.
 """
@@ -157,6 +166,36 @@ STREAM_SOAK_MATRIX: List[Tuple[str, List[Dict[str, Any]], str,
      "atlas-device-loss", {"replicas": 2}),
 ]
 
+# The computation-integrity matrix (round 18): each plan drives the
+# replayable in-memory worker (python -m scconsensus_tpu.robust.soak —
+# the SAME seed-pure planted-marker workload as the streaming soak)
+# under SCC_INTEGRITY=enforce with injected IN-COMPUTATION corruption
+# (robust.faults "corruption" class: wrong-but-finite values, not
+# crashes). The contract: every corruption is DETECTED (an invariant or
+# ghost-replay check), recovered through the typed silent_corruption
+# recompute, recorded on the validated integrity section, and the
+# recovered run's labels_sha is byte-identical to a clean reference.
+# The eviction plan pins the corruption to device 7 of a forced
+# 8-virtual-device mesh with a large window: in-place recomputes keep
+# failing, the eviction threshold trips, and the elastic supervisor
+# shrinks the mesh off the lying chip (8 → 4 keeps ids 0-3) — after
+# which the device-gated rule stops firing and labels land identical.
+INTEGRITY_SOAK_MATRIX: List[Tuple[str, List[Dict[str, Any]], str,
+                                  Dict[str, Any]]] = [
+    ("integrity-corrupt-ladder",
+     [{"site": "wilcox_bucket_out", "class": "corruption",
+       "mode": "signflip"}],
+     "integrity-recover", {}),
+    ("integrity-corrupt-stream",
+     [{"site": "stream_block", "class": "corruption",
+       "mode": "signflip"}],
+     "integrity-recover", {"stream": True}),
+    ("integrity-evict-device",
+     [{"site": "wilcox_bucket_out", "class": "corruption",
+       "mode": "signflip", "times": 99, "device": 7}],
+     "integrity-evict", {}),
+]
+
 
 def _fleet_worker(workdir: str, timeout_s: float, n_requests: int,
                   extra_args: Optional[List[str]] = None,
@@ -263,6 +302,126 @@ def _stream_worker(workdir: str, plan_path: Optional[str],
             return rc, json.load(f)
     except (OSError, json.JSONDecodeError):
         return rc, None
+
+
+def _integrity_worker(workdir: str, plan_path: Optional[str],
+                      timeout_s: float,
+                      extra_args: Optional[List[str]] = None,
+                      mesh8: bool = False,
+                      ) -> Tuple[int, Optional[Dict[str, Any]]]:
+    """One integrity-soak worker subprocess (SCC_INTEGRITY=enforce);
+    returns (rc, summary|None)."""
+    summary_path = os.path.join(workdir, "INTEGRITY_SOAK_SUMMARY.json")
+    try:
+        os.remove(summary_path)
+    except OSError:
+        pass
+    env = dict(os.environ)
+    env.pop("SCC_FAULT_PLAN", None)
+    if plan_path:
+        env["SCC_FAULT_PLAN"] = os.path.abspath(plan_path)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["SCC_INTEGRITY"] = "enforce"
+    if mesh8:
+        env["XLA_FLAGS"] = (
+            (env.get("XLA_FLAGS") or "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    cmd = [sys.executable, "-m", "scconsensus_tpu.robust.soak",
+           "--dir", workdir, "--summary", summary_path, "--fresh"] \
+        + list(extra_args or [])
+    try:
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=timeout_s, cwd=_REPO)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        return 124, None
+    if rc != 0 and proc.stderr:
+        for ln in proc.stderr.strip().splitlines()[-4:]:
+            print(f"[integrity-soak] {ln}", file=sys.stderr)
+    try:
+        with open(summary_path) as f:
+            return rc, json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return rc, None
+
+
+def run_integrity_plan(name: str, rules: List[Dict[str, Any]],
+                       mode: str, extra: Dict[str, Any], tmp: str,
+                       timeout_s: float, ref_cache: Dict[str, Any]
+                       ) -> int:
+    """Run one silent-corruption plan; 0 = the integrity chaos contract
+    held. ``ref_cache`` shares ONE clean reference run's labels_sha
+    (the workload is a pure function of the seed, and the cross-shape
+    audit pins every execution shape to the same sha — one reference
+    covers all plans)."""
+    workdir = os.path.join(tmp, name)
+    os.makedirs(workdir, exist_ok=True)
+    plan_path = os.path.join(workdir, "plan.json")
+    with open(plan_path, "w") as f:
+        json.dump({"faults": rules}, f)
+    checks: List[Tuple[str, bool]] = []
+    deadline = time.monotonic() + timeout_s
+
+    def _left() -> float:
+        return max(deadline - time.monotonic(), 1.0)
+
+    def _reference_sha() -> Optional[str]:
+        if "sha" not in ref_cache:
+            ref_dir = os.path.join(tmp, "integrity-reference")
+            os.makedirs(ref_dir, exist_ok=True)
+            rc, ref = _integrity_worker(ref_dir, None, _left())
+            ref_cache["sha"] = (ref or {}).get("labels_sha") \
+                if rc == 0 and ref and ref.get("ok") else None
+        return ref_cache["sha"]
+
+    ref_sha = _reference_sha()
+    checks.append(("clean reference run produced labels",
+                   ref_sha is not None))
+    worker_args = ["--stream"] if extra.get("stream") else []
+    if mode == "integrity-evict":
+        worker_args += ["--mesh", "auto"]
+    rc, summary = _integrity_worker(
+        workdir, plan_path, _left(), worker_args,
+        mesh8=(mode == "integrity-evict"),
+    )
+    ig = (summary or {}).get("integrity") or {}
+    checks.append(("worker exited 0 (integrity section validated)",
+                   rc == 0 and bool(summary) and summary.get("ok")))
+    checks.append((
+        "injected corruption DETECTED (invariant or ghost replay)",
+        bool(summary) and summary.get("detections", 0) >= 1,
+    ))
+    checks.append((
+        "corrupted unit recomputed via typed silent_corruption",
+        bool(summary) and (summary.get("recomputes", 0) >= 1
+                           or summary.get("sc_retries_recovered", 0)
+                           >= 1),
+    ))
+    if mode == "integrity-evict":
+        checks.append((
+            "repeated corruption evicted the miscomputing device "
+            "(mesh shrink recorded)",
+            bool(summary) and summary.get("mesh_transitions", 0) >= 1
+            and (summary.get("mesh_final_devices") or 8) < 8,
+        ))
+    checks.append((
+        "recovered run produced byte-identical labels",
+        bool(summary) and ref_sha is not None
+        and summary.get("labels_sha") == ref_sha,
+    ))
+    checks.append((
+        "detection recorded on the validated integrity section",
+        bool(ig) and (
+            len(ig.get("violations") or [])
+            + len((ig.get("ghost") or {}).get("mismatches") or [])
+        ) >= 1,
+    ))
+    ok = all(c for _, c in checks)
+    for label, c in checks:
+        print(f"[chaos:{name}] {'ok  ' if c else 'FAIL'} {label}",
+              file=sys.stderr)
+    return 0 if ok else 1
 
 
 def run_stream_plan(name: str, rules: List[Dict[str, Any]], mode: str,
@@ -554,10 +713,14 @@ def run_soak(config: str, evidence_dir: str, budget_s: float,
                     if not only or m[0] in only]
     stream_matrix = [m for m in STREAM_SOAK_MATRIX
                      if not only or m[0] in only]
-    if not matrix and not serve_matrix and not stream_matrix:
+    integrity_matrix = [m for m in INTEGRITY_SOAK_MATRIX
+                        if not only or m[0] in only]
+    if not matrix and not serve_matrix and not stream_matrix \
+            and not integrity_matrix:
         known = ([m[0] for m in SOAK_MATRIX]
                  + [m[0] for m in SERVE_SOAK_MATRIX]
-                 + [m[0] for m in STREAM_SOAK_MATRIX])
+                 + [m[0] for m in STREAM_SOAK_MATRIX]
+                 + [m[0] for m in INTEGRITY_SOAK_MATRIX])
         print(f"chaos_run: --soak-plans matched nothing "
               f"(known: {known})", file=sys.stderr)
         return 2
@@ -619,6 +782,21 @@ def run_soak(config: str, evidence_dir: str, budget_s: float,
             t_plan = time.monotonic()
             rc = run_stream_plan(name, rules, mode, extra, tmp,
                                  remaining, stream_ref)
+            results.append({
+                "plan": name, "ok": rc == 0,
+                "outcome": "ok" if rc == 0 else f"rc={rc}",
+                "elapsed_s": round(time.monotonic() - t_plan, 1),
+            })
+        integrity_ref: Dict[str, Any] = {}  # one shared reference sha
+        for name, rules, mode, extra in integrity_matrix:
+            remaining = budget_s - (time.monotonic() - t0)
+            if remaining <= 0:
+                results.append({"plan": name, "ok": False,
+                                "outcome": "budget-exhausted"})
+                continue
+            t_plan = time.monotonic()
+            rc = run_integrity_plan(name, rules, mode, extra, tmp,
+                                    remaining, integrity_ref)
             results.append({
                 "plan": name, "ok": rc == 0,
                 "outcome": "ok" if rc == 0 else f"rc={rc}",

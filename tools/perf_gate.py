@@ -427,6 +427,62 @@ def _smoke(fixtures: str, as_json: bool) -> int:
         sum_rejected,
     ))
 
+    # integrity schema (round 18): a record whose run DETECTED silent
+    # corruption (an invariant violation + a ghost-replay mismatch) and
+    # recovered it via typed silent_corruption recomputes validates and
+    # gates normally on its walls...
+    verdict_ig, _ = run_gate(
+        os.path.join(fixtures, "candidate_integrity_recovered.json"),
+        evidence,
+    )
+    ig_rec = _load_json(
+        os.path.join(fixtures, "candidate_integrity_recovered.json")
+    )
+    ig = ig_rec.get("integrity") or {}
+    ig_rb = ig_rec.get("robustness") or {}
+    checks.append((
+        "integrity-recovered candidate validates and passes with "
+        "mismatch + recompute evidence",
+        verdict_ig.ok
+        and len((ig.get("ghost") or {}).get("mismatches") or []) >= 1
+        and (ig.get("ghost") or {}).get("recomputes", 0) >= 1
+        and any(r.get("error_class") == "silent_corruption"
+                and r.get("recovered")
+                for r in ig_rb.get("retries") or []),
+    ))
+    # ...while a section CLAIMING all_checks_passed with checks_run <
+    # checks_planned is REJECTED naming the rule — a check that never
+    # ran proves nothing, and claiming otherwise is the exact failure
+    # the integrity layer exists to catch
+    import copy as _copy_ig
+    import tempfile as _tempfile_ig
+
+    bad_ig = _copy_ig.deepcopy(ig_rec)
+    bad_ig["integrity"] = {
+        "mode": "audit",
+        "checks": {"planned": 9, "run": 7, "passed": 7},
+        "per_check": {},
+        "violations": [],
+        "ghost": {"planned": 0, "run": 0, "passed": 0,
+                  "mismatches": [], "recomputes": 0},
+        "all_checks_passed": True,
+        "consumed_s": 0.01,
+    }
+    with _tempfile_ig.TemporaryDirectory(prefix="scc-gate-smoke-") as tig:
+        bad_path = os.path.join(tig, "candidate_integrity_bad.json")
+        with open(bad_path, "w") as f:
+            json.dump(bad_ig, f)
+        try:
+            run_gate(bad_path, evidence)
+            ig_rejected = False
+        except ValueError as e:
+            ig_rejected = "checks_run < checks_planned" in str(e)
+    checks.append((
+        "all_checks_passed claim with checks_run < checks_planned "
+        "rejected naming the rule",
+        ig_rejected,
+    ))
+
     # serving-latency gate (round 15, BASELINE.md serving-latency
     # policy): the clean candidate's serving p99 sits inside the key's
     # latency band...

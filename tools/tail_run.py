@@ -11,7 +11,10 @@ host↔device bytes from the residency auditor plus a live byte rate
 differenced from consecutive ticks — a host-round-trip storm shows as
 MB/s mid-run), a serving panel (queue depth, live p99, breaker state,
 degraded/quarantined/rejected counters fed from serve.metrics via
-obs.live — an online driver's vitals tick by tick), and — when
+obs.live — an online driver's vitals tick by tick), an integrity panel
+(invariant checks passed/run, ghost-replay progress + lag, mismatches
+and silent-corruption recomputes from robust.integrity — a run
+fighting corruption shows it live), and — when
 the evidence ledger holds baseline history for the run's key — a
 per-stage ETA from the noise-banded baselines
 (``obs.regress.stage_baselines``). The sibling ``*_partial.json`` record
@@ -311,6 +314,31 @@ def render(lines: List[Dict[str, Any]],
             if sm.get("halvings"):
                 bits.append(f"window halved x{sm['halvings']}")
             out.append("  streaming: " + "   ".join(bits))
+        ig = hb.get("integrity") or {}
+        if ig:
+            # integrity heartbeat panel (round 18, obs.live ←
+            # robust.integrity): invariant checks passed/run, ghost-
+            # replay progress + lag, mismatches and recomputes — a run
+            # silently fighting corruption shows it tick by tick
+            bits = [f"checks {ig.get('checks_passed', 0)}"
+                    f"/{ig.get('checks_run', 0)}"
+                    + (f" (planned {ig['checks_planned']})"
+                       if ig.get("checks_planned",
+                                 0) > ig.get("checks_run", 0) else "")]
+            if ig.get("violations"):
+                bits.append(f"VIOLATIONS {ig['violations']}")
+            bits.append(f"replay {ig.get('replays_run', 0)}"
+                        f"/{ig.get('replays_planned', 0)}")
+            if ig.get("replay_age_s") is not None:
+                bits.append(f"lag {_fmt_dur(ig['replay_age_s'])}")
+            if ig.get("mismatches"):
+                bits.append(f"MISMATCHES {ig['mismatches']}")
+            if ig.get("recomputes"):
+                bits.append(f"recomputed x{ig['recomputes']}")
+            mode_ig = ig.get("mode", "audit")
+            if mode_ig != "audit":
+                bits.append(mode_ig)
+            out.append("  integrity: " + "   ".join(bits))
         sv = hb.get("serving") or {}
         if sv:
             # serving heartbeat panel (obs.live ← serve.metrics): queue

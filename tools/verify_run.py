@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Cross-shape determinism audit: replay one workload's stages under
+different chunk/mesh/batch shapes and pin the label shas identical.
+
+    verify_run.py [--cells N] [--genes G] [--clusters K] [--seed S]
+                  [--shapes serial,mesh8,...] [--timeout S]
+                  [--integrity off|audit|enforce] [--json]
+
+The scattered per-PR identity tests (mesh-vs-serial parity, streaming-
+vs-in-memory bit identity, scan-vs-runspace kernel equivalence,
+resume-to-identical-labels) all assert the same property: the answer is
+a pure function of (data, config, seed) — never of the execution shape.
+This tool is that property as ONE reusable auditor. Each shape runs the
+deterministic ``robust.soak`` workload (the stream-soak generator:
+every row a pure function of (seed, gene)) in its own subprocess with
+the shape expressed through environment/flags, and every summary's
+``labels_sha`` must equal the reference's. A shape-dependent code path
+that returns a wrong-but-finite answer — the silent-corruption class
+the SCC_INTEGRITY layer hunts at runtime — shows up here as a sha
+split, with the disagreeing shapes named.
+
+Shapes (``--shapes`` filters; default runs all):
+
+  serial      the reference: in-memory CSR, single device, runspace
+              kernel family (CPU default)
+  mesh8       a forced 8-virtual-device CPU mesh (XLA_FLAGS) — the
+              sharded gene-chunk path, r14's elastic substrate
+  scan        SCC_NO_RUNSPACE=1 — the scan kernel family at the same
+              shapes (the cross-KERNEL determinism pin)
+  stream32    out-of-core through a ChunkedCSRStore, 32-row windows
+  stream16    the same store shape at 16-row windows — different chunk
+              boundaries must not change one label
+  resume      stream32 run twice over the same stage store: the second
+              run adopts every durable chunk (full resume) and must
+              reproduce the sha without recomputing
+
+``--integrity`` additionally arms the SCC_INTEGRITY sentinels inside
+every worker (default: inherit the environment), so the audit can run
+with the runtime defense active.
+
+Exit codes: 0 every shape agreed; 1 a shape disagreed or failed;
+2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# name -> (worker args, env overrides)
+SHAPES: List[Tuple[str, List[str], Dict[str, str]]] = [
+    ("serial", [], {}),
+    ("mesh8", ["--mesh", "auto"],
+     {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}),
+    ("scan", [], {"SCC_NO_RUNSPACE": "1"}),
+    ("stream32", ["--stream", "--stream-window", "32"], {}),
+    ("stream16", ["--stream", "--stream-window", "16"], {}),
+    ("resume", ["--stream", "--stream-window", "32"], {}),
+]
+
+
+def run_shape(name: str, extra_args: List[str], env_over: Dict[str, str],
+              workdir: str, shape_args: List[str], timeout_s: float,
+              integrity: Optional[str], fresh: bool = True,
+              ) -> Tuple[bool, Optional[Dict[str, Any]], str]:
+    """One worker subprocess; returns (ok, summary|None, note)."""
+    summary_path = os.path.join(workdir, f"VERIFY_{name}.json")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("SCC_FAULT_PLAN", None)
+    if integrity is not None:
+        env["SCC_INTEGRITY"] = integrity
+    for k, v in env_over.items():
+        env[k] = (env.get(k, "") + " " + v).strip() \
+            if k == "XLA_FLAGS" else v
+    cmd = [sys.executable, "-m", "scconsensus_tpu.robust.soak",
+           "--dir", os.path.join(workdir, name),
+           "--summary", summary_path] + shape_args + extra_args
+    if fresh:
+        cmd.append("--fresh")
+    try:
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=timeout_s, cwd=_REPO)
+    except subprocess.TimeoutExpired:
+        return False, None, "timeout"
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        return False, None, f"rc={proc.returncode}: " + " | ".join(tail)
+    try:
+        with open(summary_path) as f:
+            return True, json.load(f), ""
+    except (OSError, json.JSONDecodeError) as e:
+        return False, None, f"summary unreadable: {e}"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="cross-shape determinism audit")
+    ap.add_argument("--cells", type=int, default=3000)
+    ap.add_argument("--genes", type=int, default=120)
+    ap.add_argument("--clusters", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--shapes", default=None,
+                    help="comma-separated shape names "
+                         f"(known: {[s[0] for s in SHAPES]})")
+    ap.add_argument("--timeout", type=float, default=1200.0,
+                    help="ONE wall-clock budget for the whole audit")
+    ap.add_argument("--integrity", choices=("off", "audit", "enforce"),
+                    default=None,
+                    help="force SCC_INTEGRITY inside every worker "
+                         "(default: inherit)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    only = ([s.strip() for s in args.shapes.split(",") if s.strip()]
+            if args.shapes else None)
+    shapes = [s for s in SHAPES if not only or s[0] in only]
+    if not shapes:
+        print(f"verify_run: --shapes matched nothing "
+              f"(known: {[s[0] for s in SHAPES]})", file=sys.stderr)
+        return 2
+    shape_args = ["--cells", str(args.cells), "--genes", str(args.genes),
+                  "--clusters", str(args.clusters), "--seed",
+                  str(args.seed)]
+    t0 = time.monotonic()
+    results: List[Dict[str, Any]] = []
+    with tempfile.TemporaryDirectory(prefix="scc-verify-") as tmp:
+        for name, extra, env_over in shapes:
+            left = args.timeout - (time.monotonic() - t0)
+            if left <= 0:
+                results.append({"shape": name, "ok": False,
+                                "note": "budget-exhausted"})
+                continue
+            t_s = time.monotonic()
+            if name == "resume":
+                # prime the durable store, then the audited run resumes
+                # every chunk — a full resume must reproduce the sha
+                ok0, _, note0 = run_shape(
+                    name, extra, env_over, tmp, shape_args, left,
+                    args.integrity, fresh=True,
+                )
+                left = args.timeout - (time.monotonic() - t0)
+                if not ok0 or left <= 0:
+                    results.append({"shape": name, "ok": False,
+                                    "note": f"prime failed: {note0}"})
+                    continue
+                ok, summary, note = run_shape(
+                    name, extra, env_over, tmp, shape_args, left,
+                    args.integrity, fresh=False,
+                )
+                if ok and summary is not None and not (
+                        (summary.get("record") or {}).get(
+                            "streaming", {}).get("chunks", {}
+                        ).get("resumed", 0) >= 1):
+                    ok, note = False, "resume shape adopted no chunks"
+            else:
+                ok, summary, note = run_shape(
+                    name, extra, env_over, tmp, shape_args, left,
+                    args.integrity,
+                )
+            results.append({
+                "shape": name,
+                "ok": bool(ok and summary and summary.get("ok")),
+                "labels_sha": (summary or {}).get("labels_sha"),
+                "note": note,
+                "elapsed_s": round(time.monotonic() - t_s, 1),
+            })
+    ref = next((r["labels_sha"] for r in results
+                if r["shape"] == "serial" and r["labels_sha"]),
+               next((r["labels_sha"] for r in results
+                     if r["labels_sha"]), None))
+    for r in results:
+        if r["ok"] and ref is not None and r["labels_sha"] != ref:
+            r["ok"] = False
+            r["note"] = (f"labels diverged from reference "
+                         f"({(r['labels_sha'] or '?')[:16]} != "
+                         f"{ref[:16]}) — a shape-dependent answer")
+    ok_all = bool(results) and all(r["ok"] for r in results) \
+        and ref is not None
+    verdict = {
+        "verify": "ok" if ok_all else "FAIL",
+        "labels_sha": ref,
+        "shapes": results,
+        "consumed_s": round(time.monotonic() - t0, 1),
+    }
+    if args.as_json:
+        print(json.dumps(verdict, indent=1))
+    else:
+        for r in results:
+            mark = "ok  " if r["ok"] else "FAIL"
+            print(f"[verify:{r['shape']}] {mark} "
+                  f"sha={(r['labels_sha'] or '?')[:16]}"
+                  + (f"  ({r['note']})" if r.get("note") else ""))
+        print(json.dumps({k: verdict[k] for k in
+                          ("verify", "labels_sha", "consumed_s")}))
+    return 0 if ok_all else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
