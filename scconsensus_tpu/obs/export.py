@@ -161,6 +161,7 @@ def build_run_record(
     serving: Optional[Dict[str, Any]] = None,
     streaming: Optional[Dict[str, Any]] = None,
     integrity: Optional[Dict[str, Any]] = None,
+    scenario: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One schema-v1 run record. Pass ``tracer`` to take spans + compile
     stats from it; or pre-built ``spans`` (e.g. a resumed pipeline's
@@ -174,7 +175,8 @@ def build_run_record(
     serve.metrics online-serving section; ``streaming`` (optional)
     attaches the stream.record out-of-core section; ``integrity``
     (optional) attaches the robust.integrity computation-integrity
-    section."""
+    section; ``scenario`` (optional) attaches the workload-zoo
+    scenario identity section (scconsensus_tpu.workloads)."""
     if spans is None:
         spans = tracer.span_records() if tracer is not None else []
     extra = dict(extra or {})
@@ -216,6 +218,8 @@ def build_run_record(
         rec["streaming"] = streaming
     if integrity is not None:
         rec["integrity"] = integrity
+    if scenario is not None:
+        rec["scenario"] = scenario
     return rec
 
 
@@ -335,6 +339,13 @@ def validate_run_record(rec: Dict[str, Any]) -> None:
         from scconsensus_tpu.robust.integrity import validate_integrity
 
         validate_integrity(ig)
+    sc = rec.get("scenario")
+    if sc is not None:
+        # jax-free import (workloads' module level is jax-free by
+        # contract; scenario runners lazy-import their compute)
+        from scconsensus_tpu.workloads import validate_scenario
+
+        validate_scenario(sc)
 
 
 # --------------------------------------------------------------------------

@@ -62,8 +62,11 @@ __all__ = [
     "occupancy_from_stage_records",
     "ari_final_vs",
     "cluster_structure",
+    "per_batch_ari",
+    "batch_mixing_entropy",
     "build_quality_section",
     "validate_quality",
+    "validate_scenario_scores",
     "live_summary",
     "consumed_cpu_s",
     "reset_cpu",
@@ -506,6 +509,133 @@ def cluster_structure(dynamic_labels: Dict[str, np.ndarray],
 
 
 # --------------------------------------------------------------------------
+# scenario scoring (workload zoo, round 19)
+# --------------------------------------------------------------------------
+
+def per_batch_ari(final_labels, truth_labels, batches) -> Dict[str, float]:
+    """ARI of the final cut against truth WITHIN each batch/sample.
+
+    The multi-sample scenario's per-batch quality block: an integration
+    that nails three samples and shreds the fourth must not hide behind
+    a healthy pooled ARI. Keys are ``str(batch)``; a batch with fewer
+    than 2 cells is skipped (ARI of a singleton is undefined, not 1)."""
+    from scconsensus_tpu.obs.regress import adjusted_rand_index
+
+    with _timed():
+        final = np.asarray(final_labels)
+        truth = np.asarray(truth_labels)
+        batches = np.asarray(batches)
+        if not (final.size == truth.size == batches.size):
+            raise ValueError(
+                f"per_batch_ari: size mismatch (final={final.size}, "
+                f"truth={truth.size}, batches={batches.size})"
+            )
+        out: Dict[str, float] = {}
+        for b in np.unique(batches):
+            sel = batches == b
+            if int(sel.sum()) < 2:
+                continue
+            out[str(b)] = round(
+                adjusted_rand_index(final[sel], truth[sel]), 6
+            )
+        return out
+
+
+def batch_mixing_entropy(labels, batches) -> Dict[str, Any]:
+    """Batch-composition entropy of every output cluster.
+
+    For each cluster, the Shannon entropy (nats) of its cells' batch
+    distribution; ``mean_norm_entropy`` is the cluster-size-weighted
+    mean normalized by ``ln(n_batches)`` — 1.0 means every cluster is
+    perfectly batch-mixed, 0.0 means every cluster is single-batch (the
+    batch effect became the clustering, the integration failure mode
+    this block exists to expose)."""
+    with _timed():
+        labels = np.asarray(labels)
+        batches = np.asarray(batches)
+        if labels.size != batches.size:
+            raise ValueError(
+                f"batch_mixing_entropy: size mismatch "
+                f"(labels={labels.size}, batches={batches.size})"
+            )
+        ub, bi = np.unique(batches, return_inverse=True)
+        n_batches = int(ub.size)
+        per_cluster: Dict[str, Dict[str, Any]] = {}
+        wsum, n_tot = 0.0, 0
+        for c in np.unique(labels):
+            sel = labels == c
+            counts = np.bincount(bi[sel], minlength=n_batches)
+            ent = _entropy(counts)
+            n = int(sel.sum())
+            per_cluster[str(c)] = {"entropy": round(ent, 6), "n": n}
+            wsum += ent * n
+            n_tot += n
+        denom = float(np.log(n_batches)) if n_batches > 1 else 1.0
+        mean_norm = (wsum / n_tot / denom) if n_tot else 0.0
+        return {
+            "n_batches": n_batches,
+            "per_cluster": per_cluster,
+            "mean_norm_entropy": round(float(mean_norm), 6),
+        }
+
+
+def validate_scenario_scores(s: Dict[str, Any]) -> None:
+    """Structural validation of a ``quality.scenario`` scoring block
+    (the workload zoo's per-scenario quality evidence). Raises
+    ValueError on the first violation; :func:`validate_quality` calls
+    this, so a scenario record is held to the same standard as every
+    other quality field."""
+    _require(isinstance(s, dict), "scenario must be an object")
+    name = s.get("name")
+    _require(isinstance(name, str) and bool(name),
+             "scenario.name must be a non-empty string")
+    metrics = s.get("metrics")
+    _require(isinstance(metrics, dict) and bool(metrics),
+             "scenario.metrics must be a non-empty object")
+    for k, v in metrics.items():
+        _require(isinstance(v, (int, float)) and not isinstance(v, bool)
+                 and np.isfinite(v),
+                 f"scenario.metrics[{k!r}] must be a finite number")
+    pba = s.get("per_batch_ari")
+    if pba is not None:
+        _require(isinstance(pba, dict) and bool(pba),
+                 "scenario.per_batch_ari must be a non-empty object")
+        for k, v in pba.items():
+            _require(isinstance(v, (int, float))
+                     and -1.0 - 1e-9 <= v <= 1.0 + 1e-9,
+                     f"scenario.per_batch_ari[{k!r}] must be an ARI "
+                     "in [-1, 1]")
+    bm = s.get("batch_mixing")
+    if bm is not None:
+        _require(isinstance(bm, dict), "scenario.batch_mixing must be "
+                 "an object")
+        nb = bm.get("n_batches")
+        _require(isinstance(nb, int) and nb >= 2,
+                 "scenario.batch_mixing.n_batches must be an int >= 2")
+        mne = bm.get("mean_norm_entropy")
+        _require(isinstance(mne, (int, float))
+                 and -1e-9 <= mne <= 1.0 + 1e-9,
+                 "scenario.batch_mixing.mean_norm_entropy must be in "
+                 "[0, 1]")
+        pc = bm.get("per_cluster")
+        _require(isinstance(pc, dict) and bool(pc),
+                 "scenario.batch_mixing.per_cluster must be a non-empty "
+                 "object")
+        for k, v in pc.items():
+            _require(isinstance(v, dict)
+                     and isinstance(v.get("entropy"), (int, float))
+                     and v["entropy"] >= -1e-9
+                     and isinstance(v.get("n"), int) and v["n"] > 0,
+                     f"scenario.batch_mixing.per_cluster[{k!r}] needs "
+                     "entropy >= 0 and n > 0")
+    # a multi-sample block must carry BOTH halves: a per-batch ARI with
+    # no mixing evidence (or vice versa) is half an integration claim
+    _require((pba is None) == (bm is None),
+             "scenario blocks with batch evidence must carry both "
+             "per_batch_ari and batch_mixing")
+
+
+# --------------------------------------------------------------------------
 # assembly + validation
 # --------------------------------------------------------------------------
 
@@ -665,6 +795,9 @@ def validate_quality(q: Dict[str, Any]) -> None:
             for k in ("nan", "inf"):
                 _require(isinstance(t.get(k, 0), int) and t.get(k, 0) >= 0,
                          f"trips[{i}].{k} must be an int >= 0")
+    sc = q.get("scenario")
+    if sc is not None:
+        validate_scenario_scores(sc)
     lad = q.get("wilcox_ladder")
     if lad is not None:
         _require(isinstance(lad, dict), "wilcox_ladder must be an object")

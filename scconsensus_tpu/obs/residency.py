@@ -182,6 +182,15 @@ BOUNDARIES: Dict[str, str] = {
         "is the streaming contract; this boundary is the declared "
         "drop side, sized per-chunk by construction."
     ),
+    "workload_inputs": (
+        "Workload-zoo input construction (workloads/, round 19): h2d "
+        "staging of scenario embeddings/modalities into the jitted "
+        "cover/Lloyd labelers and the O(N) int label/node-id fetches "
+        "that become consensus INPUT labelings. Scenario setup runs "
+        "before the pipeline's own residency story starts; its "
+        "crossings are declared so audit-mode bench records attribute "
+        "them, never part of the refine stages' transfer budget."
+    ),
     "obs_internal": (
         "Measurement infrastructure's own O(1) transfers: tracer drain "
         "sentinels, sentinel-count fetches. Auto-attributed when the "

@@ -1,0 +1,226 @@
+"""Topology-based input clusterer: a small Two-Tier-Mapper-style
+cover-and-cluster labeler (arXiv:1801.01841 flavor).
+
+The consensus layer's whole premise is combining two *different*
+labelings of the same cells; this module supplies one derived from data
+*topology* rather than a truth perturbation, diversifying the
+unsupervised input of any scenario:
+
+  1. **cover** — greedy farthest-point cover centers over the embedding
+     (deterministic given the seed), every cell a member of its two
+     nearest covers (an overlapping cover — the Mapper pullback);
+  2. **local clustering** — inside each cover element, a masked
+     two-means split (vmapped over covers, fixed shapes, one jit), so a
+     cover patch straddling two arms of the data separates them
+     locally;
+  3. **nerve merge** — local clusters become nodes; a cell's
+     (primary-cover node, secondary-cover node) pair is an edge, edges
+     with at least ``min_overlap`` supporting cells survive, and
+     connected components of that nerve are the final clusters.
+
+All heavy pieces (farthest-point sweep, top-2 cover assignment, masked
+local two-means) are jitted device programs; only the O(N) node ids
+cross to host (declared ``workload_inputs`` boundary) for the tiny
+union-find. The result is a pure function of ``(x, n_covers, seed,
+min_overlap, overlap)`` — the cross-shape determinism the
+``tools/verify_run.py`` topo shapes replay.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["topology_cluster", "topology_labeling"]
+
+_JIT = {}
+
+
+def _kernels():
+    """Build (once) the jitted device pieces; module import stays
+    jax-free."""
+    if _JIT:
+        return _JIT
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from scconsensus_tpu.ops.distance import _sq_dists_raw
+
+    @partial(jax.jit, static_argnames=("n_covers",))
+    def farthest_point(x, start, n_covers):
+        """Greedy farthest-point cover-center indices (n_covers,)."""
+        n = x.shape[0]
+        idx0 = jnp.zeros((n_covers,), jnp.int32).at[0].set(start)
+        mind = jnp.full((n,), jnp.inf, x.dtype)
+
+        def body(i, carry):
+            idx, mind = carry
+            c = x[idx[i - 1]]
+            d = jnp.sum((x - c[None, :]) ** 2, axis=1)
+            mind = jnp.minimum(mind, d)
+            return idx.at[i].set(jnp.argmax(mind).astype(jnp.int32)), mind
+
+        idx, _ = jax.lax.fori_loop(1, n_covers, body, (idx0, mind))
+        return idx
+
+    @jax.jit
+    def top2_covers(x, centers):
+        """Primary/secondary cover of every cell + both distances."""
+        d2 = _sq_dists_raw(x, centers)                   # (N, L)
+        p = jnp.argmin(d2, axis=1)
+        dp = jnp.take_along_axis(d2, p[:, None], axis=1)[:, 0]
+        d2s = d2.at[jnp.arange(d2.shape[0]), p].set(jnp.inf)
+        s = jnp.argmin(d2s, axis=1)
+        ds = jnp.take_along_axis(d2s, s[:, None], axis=1)[:, 0]
+        return p.astype(jnp.int32), s.astype(jnp.int32), dp, ds
+
+    @partial(jax.jit, static_argnames=("n_iter",))
+    def local_two_means(x, member_mask, centers, n_iter):
+        """Per-cover masked two-means: (L, N) local id in {0, 1}.
+        Deterministic init — the member farthest from the cover center,
+        then the member farthest from that one."""
+
+        def per_cover(mask, cent):
+            d0 = jnp.sum((x - cent[None, :]) ** 2, axis=1)
+            a = jnp.argmax(jnp.where(mask > 0, d0, -1.0))
+            da = jnp.sum((x - x[a][None, :]) ** 2, axis=1)
+            b = jnp.argmax(jnp.where(mask > 0, da, -1.0))
+            c = jnp.stack([x[a], x[b]])                  # (2, d)
+
+            def step(c, _):
+                d = _sq_dists_raw(x, c)                  # (N, 2)
+                assign = jnp.argmin(d, axis=1)
+                oh = jax.nn.one_hot(assign, 2, dtype=x.dtype) \
+                    * mask[:, None]
+                cnt = jnp.sum(oh, axis=0)
+                sums = oh.T @ x
+                c2 = jnp.where(cnt[:, None] > 0,
+                               sums / jnp.maximum(cnt, 1.0)[:, None], c)
+                return c2, None
+
+            c, _ = jax.lax.scan(step, c, None, length=n_iter)
+            return jnp.argmin(_sq_dists_raw(x, c), axis=1).astype(
+                jnp.int32
+            )
+
+        return jax.vmap(per_cover)(member_mask, centers)
+
+    _JIT.update(farthest_point=farthest_point, top2_covers=top2_covers,
+                local_two_means=local_two_means)
+    return _JIT
+
+
+class _UnionFind:
+    def __init__(self, n: int):
+        self.parent = list(range(n))
+
+    def find(self, i: int) -> int:
+        while self.parent[i] != i:
+            self.parent[i] = self.parent[self.parent[i]]
+            i = self.parent[i]
+        return i
+
+    def union(self, a: int, b: int) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            self.parent[max(ra, rb)] = min(ra, rb)
+
+
+def topology_cluster(
+    x: np.ndarray,
+    n_covers: int = 16,
+    seed: int = 0,
+    min_overlap: Optional[int] = None,
+    overlap: float = 1.5,
+    local_iters: int = 8,
+    prefix: str = "topo",
+) -> np.ndarray:
+    """Cluster the rows of ``x`` (N, d) by cover → local split → nerve.
+
+    ``min_overlap`` is the cell-support an edge of the nerve needs to
+    survive (default ``max(3, N // (50 * n_covers))`` — scale-free
+    enough that smoke and full shapes use the same recipe);
+    ``overlap`` gates which cells count as genuinely shared between
+    their two covers (secondary distance within ``overlap ×`` primary).
+    Returns string labels ``f"{prefix}{component}"``, a pure function
+    of the inputs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from scconsensus_tpu.obs.residency import boundary
+
+    n = int(x.shape[0])
+    n_covers = int(min(n_covers, max(2, n // 4)))
+    if min_overlap is None:
+        min_overlap = max(3, n // (50 * n_covers))
+    k = _kernels()
+    rng = np.random.default_rng(np.random.SeedSequence([seed, 0x7090]))
+    start = int(rng.integers(0, n))
+
+    with boundary("workload_inputs"):
+        xd = jnp.asarray(np.asarray(x, np.float32))
+        cid = k["farthest_point"](xd, start, n_covers)
+        centers = xd[cid]
+        p, s, dp, ds = k["top2_covers"](xd, centers)
+        # membership: primary always; secondary only when the cell is
+        # genuinely shared (distance ratio inside the overlap gate)
+        shared = jnp.sqrt(ds) <= overlap * jnp.sqrt(jnp.maximum(dp, 1e-12))
+        covers = jnp.arange(n_covers, dtype=jnp.int32)
+        mask = ((p[None, :] == covers[:, None])
+                | ((s[None, :] == covers[:, None]) & shared[None, :])
+                ).astype(xd.dtype)                        # (L, N)
+        local = k["local_two_means"](xd, mask, centers, local_iters)
+        # O(N) int fetches: node ids + the shared gate — the only host
+        # crossings this labeler makes
+        p_h, s_h, shared_h, local_h = jax.device_get(
+            (p, s, shared, local)
+        )
+
+    p_h = np.asarray(p_h, np.int64)
+    s_h = np.asarray(s_h, np.int64)
+    local_h = np.asarray(local_h, np.int64)
+    node_p = 2 * p_h + local_h[p_h, np.arange(n)]
+    node_s = 2 * s_h + local_h[s_h, np.arange(n)]
+
+    # nerve: count supporting cells per (node_p, node_s) edge among the
+    # genuinely shared cells, keep edges with enough support
+    sh = np.asarray(shared_h, bool)
+    edge_key = node_p[sh] * (2 * n_covers) + node_s[sh]
+    keys, counts = np.unique(edge_key, return_counts=True)
+    uf = _UnionFind(2 * n_covers)
+    for key, c in zip(keys.tolist(), counts.tolist()):
+        if c >= min_overlap:
+            uf.union(key // (2 * n_covers), key % (2 * n_covers))
+
+    roots = np.array([uf.find(i) for i in range(2 * n_covers)])
+    # deterministic component ids: order of first appearance by node id
+    uniq = sorted(set(roots[node_p].tolist()))
+    remap = {r: i for i, r in enumerate(uniq)}
+    comp = np.array([remap[r] for r in roots[node_p]])
+    return np.array([f"{prefix}{c}" for c in comp])
+
+
+def topology_labeling(
+    data: np.ndarray,
+    n_pcs: int = 10,
+    n_covers: int = 16,
+    seed: int = 0,
+    prefix: str = "topo",
+    **kw,
+) -> np.ndarray:
+    """Topology labeling straight from a (G, N) expression matrix: the
+    shared rSVD-PCA embed (``workloads.common.pca_embed`` — the same
+    ``ops.pca`` path the pipeline uses), then :func:`topology_cluster`
+    over the embedding. Scenario runners that need the embedding for
+    anything else (the replay pin) call the two pieces themselves."""
+    from scconsensus_tpu.workloads.common import pca_embed
+
+    if hasattr(data, "toarray"):    # scipy sparse input
+        data = data.toarray()
+    emb = pca_embed(data, n_pcs, seed=seed)
+    return topology_cluster(emb, n_covers=n_covers, seed=seed,
+                            prefix=prefix, **kw)

@@ -1,0 +1,456 @@
+"""Workload zoo (round 19): scenario scoring math against hand-computed
+fixtures, topology-clusterer determinism, labeling-strategy
+byte-stability across the bench._labelings move, schema validation for
+the `scenario` record section, and the four registered scenarios run at
+tier-1 smoke shapes end to end.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from scconsensus_tpu.obs.export import (  # noqa: E402
+    build_run_record,
+    validate_run_record,
+)
+from scconsensus_tpu.obs.quality import (  # noqa: E402
+    batch_mixing_entropy,
+    per_batch_ari,
+    validate_scenario_scores,
+)
+from scconsensus_tpu.workloads import (  # noqa: E402
+    SCENARIOS,
+    build_scenario_section,
+    get_scenario,
+    run_scenario,
+    scenario_names,
+    validate_scenario,
+)
+
+
+# --------------------------------------------------------------------------
+# per-batch ARI / batch-mixing entropy vs hand-computed 2-sample fixtures
+# --------------------------------------------------------------------------
+
+class TestPerBatchARI:
+    def test_hand_computed_two_sample(self):
+        """Batch 0: final reproduces truth exactly (ARI 1). Batch 1: the
+        2×2 contingency is all-ones — no same-pair agreement at all
+        (Σ C(n_ij,2) = 0 against an expected 2·2/6), which the ARI
+        normalization maps to exactly (0 − 2/3) / (2 − 2/3) = −0.5."""
+        truth = np.array([0, 0, 1, 1, 0, 0, 1, 1])
+        final = np.array([5, 5, 7, 7, 5, 7, 5, 7])
+        batches = np.array([0, 0, 0, 0, 1, 1, 1, 1])
+        out = per_batch_ari(final, truth, batches)
+        assert out == {"0": 1.0, "1": -0.5}
+
+    def test_relabeling_invariance_within_batch(self):
+        """ARI is permutation-invariant: batch-local label ids (the
+        unaligned per-sample clustering) score identically."""
+        truth = np.array([0, 0, 1, 1, 2, 2])
+        final = np.array(["s0c9", "s0c9", "s0c2", "s0c2", "s0c5",
+                          "s0c5"])
+        out = per_batch_ari(final, truth, np.zeros(6, int))
+        assert out == {"0": 1.0}
+
+    def test_singleton_batch_skipped(self):
+        """ARI of a 1-cell batch is undefined — skipped, never 1.0."""
+        truth = np.array([0, 1, 0, 1, 0])
+        final = np.array([0, 1, 0, 1, 0])
+        batches = np.array([0, 0, 0, 0, 9])
+        out = per_batch_ari(final, truth, batches)
+        assert "9" not in out and out["0"] == 1.0
+
+    def test_size_mismatch_raises(self):
+        with pytest.raises(ValueError, match="size mismatch"):
+            per_batch_ari(np.zeros(4), np.zeros(4), np.zeros(3))
+
+
+class TestBatchMixingEntropy:
+    def test_perfectly_mixed(self):
+        """Every cluster draws equally from both batches: per-cluster
+        entropy ln(2), normalized mean exactly 1.0."""
+        labels = np.array(["a", "a", "b", "b"])
+        batches = np.array([0, 1, 0, 1])
+        out = batch_mixing_entropy(labels, batches)
+        assert out["n_batches"] == 2
+        assert out["mean_norm_entropy"] == pytest.approx(1.0, abs=1e-6)
+        for c in ("a", "b"):
+            assert out["per_cluster"][c]["entropy"] == pytest.approx(
+                float(np.log(2)), abs=1e-6)
+            assert out["per_cluster"][c]["n"] == 2
+
+    def test_batch_pure_clusters(self):
+        """Every cluster is single-batch — the batch effect became the
+        clustering — mixing is exactly 0."""
+        labels = np.array(["a", "a", "b", "b"])
+        batches = np.array([0, 0, 1, 1])
+        out = batch_mixing_entropy(labels, batches)
+        assert out["mean_norm_entropy"] == 0.0
+        assert all(v["entropy"] == 0.0
+                   for v in out["per_cluster"].values())
+
+    def test_weighted_mean_hand_computed(self):
+        """3 cells mixed cluster (entropy of [2,1]) + 1-cell pure
+        cluster: the mean is cluster-SIZE-weighted."""
+        labels = np.array(["m", "m", "m", "p"])
+        batches = np.array([0, 0, 1, 1])
+        out = batch_mixing_entropy(labels, batches)
+        h_m = -(2 / 3) * np.log(2 / 3) - (1 / 3) * np.log(1 / 3)
+        expect = (h_m * 3 + 0.0 * 1) / 4 / np.log(2)
+        assert out["mean_norm_entropy"] == pytest.approx(expect,
+                                                         abs=1e-5)
+
+    def test_size_mismatch_raises(self):
+        with pytest.raises(ValueError, match="size mismatch"):
+            batch_mixing_entropy(np.zeros(4), np.zeros(5))
+
+
+class TestScenarioScoreValidation:
+    def _good(self):
+        return {
+            "name": "multi_sample",
+            "metrics": {"ari_pooled": 0.9},
+            "per_batch_ari": {"0": 0.95, "1": 0.9},
+            "batch_mixing": {
+                "n_batches": 2,
+                "mean_norm_entropy": 0.8,
+                "per_cluster": {"1": {"entropy": 0.5, "n": 10}},
+            },
+        }
+
+    def test_good_block_passes(self):
+        validate_scenario_scores(self._good())
+
+    def test_half_an_integration_claim_rejected(self):
+        s = self._good()
+        del s["batch_mixing"]
+        with pytest.raises(ValueError,
+                           match="per_batch_ari and batch_mixing"):
+            validate_scenario_scores(s)
+        s = self._good()
+        del s["per_batch_ari"]
+        with pytest.raises(ValueError,
+                           match="per_batch_ari and batch_mixing"):
+            validate_scenario_scores(s)
+
+    def test_out_of_range_ari_rejected(self):
+        s = self._good()
+        s["per_batch_ari"]["0"] = 1.5
+        with pytest.raises(ValueError, match=r"ARI"):
+            validate_scenario_scores(s)
+
+    def test_non_finite_metric_rejected(self):
+        s = self._good()
+        s["metrics"]["ari_pooled"] = float("nan")
+        with pytest.raises(ValueError, match="finite"):
+            validate_scenario_scores(s)
+
+    def test_empty_metrics_rejected(self):
+        s = self._good()
+        s["metrics"] = {}
+        with pytest.raises(ValueError, match="metrics"):
+            validate_scenario_scores(s)
+
+
+class TestScenarioSectionValidation:
+    def test_registry_shapes_validate(self):
+        for name, sc in SCENARIOS.items():
+            for params, smoke in ((sc.full, False), (sc.smoke, True)):
+                validate_scenario(
+                    build_scenario_section(name, params, smoke))
+
+    def test_unknown_scenario_rejected(self):
+        with pytest.raises(ValueError, match="unknown scenario"):
+            validate_scenario({"name": "nope", "params": {"a": 1}})
+
+    def test_non_scalar_param_rejected(self):
+        with pytest.raises(ValueError, match="JSON scalar"):
+            validate_scenario({"name": "multi_sample",
+                               "params": {"a": [1, 2]}})
+
+    def test_missing_params_rejected(self):
+        with pytest.raises(ValueError, match="params"):
+            validate_scenario({"name": "multi_sample"})
+
+    def test_get_scenario_unknown_raises(self):
+        with pytest.raises(KeyError, match="unknown scenario"):
+            get_scenario("nope")
+
+    def test_four_scenarios_registered(self):
+        assert scenario_names() == sorted(
+            ["multi_sample", "cite_dual", "atlas_transfer",
+             "topo_inputs"])
+        # the tier-1 lane's promise: every smoke shape is <= 5k cells
+        for sc in SCENARIOS.values():
+            n = sc.smoke.get("n_cells",
+                             sc.smoke.get("n_atlas", 0)
+                             + sc.smoke.get("n_query", 0))
+            assert n <= 5000, f"{sc.name} smoke shape exceeds 5k cells"
+
+
+# --------------------------------------------------------------------------
+# labeling strategies: the bench recipe moved byte-stable
+# --------------------------------------------------------------------------
+
+class TestLabelingStrategies:
+    def test_truth_perturb_matches_historical_bench_recipe(self):
+        """The moved strategy must reproduce the historical bench
+        `_labelings` output BYTE-identically (the fingerprint pins on
+        every existing bench key depend on it)."""
+        from scconsensus_tpu.utils.synthetic import noisy_labeling
+        from scconsensus_tpu.workloads.labelings import truth_perturb
+
+        truth = np.random.default_rng(0).integers(0, 8, size=500)
+        n_clusters = 8
+        # the literal pre-move recipe, inlined
+        expect = [noisy_labeling(truth, 0.05, seed=1, prefix="sup"),
+                  noisy_labeling(truth, 0.10,
+                                 n_out_clusters=max(2, n_clusters - 4),
+                                 seed=2, prefix="uns"),
+                  noisy_labeling(truth, 0.08, seed=3, prefix="t0")]
+        got = truth_perturb(truth, n_clusters, n_way=3)
+        assert len(got) == 3
+        for g, e in zip(got, expect):
+            assert np.array_equal(g, e)
+
+    def test_bench_labelings_delegates(self):
+        import bench
+        from scconsensus_tpu.workloads.labelings import truth_perturb
+
+        truth = np.random.default_rng(1).integers(0, 6, size=300)
+        got = bench._labelings(truth, 6, n_way=2)
+        expect = truth_perturb(truth, 6, n_way=2)
+        for g, e in zip(got, expect):
+            assert np.array_equal(g, e)
+
+    def test_strategy_registry(self):
+        """The named-strategy registry resolves to the real callables —
+        the satellite's contract that bench's recipe is ONE strategy
+        among several, discoverable by name."""
+        from scconsensus_tpu.workloads import labelings
+
+        assert labelings.STRATEGIES["truth_perturb"] \
+            is labelings.truth_perturb
+        assert labelings.STRATEGIES["per_sample"] \
+            is labelings.per_sample_unsupervised
+
+    def test_per_sample_ids_are_sample_local(self):
+        from scconsensus_tpu.workloads.labelings import (
+            per_sample_unsupervised,
+        )
+
+        truth = np.random.default_rng(2).integers(0, 4, size=400)
+        batches = np.random.default_rng(3).integers(0, 3, size=400)
+        lab = per_sample_unsupervised(truth, batches, seed=0)
+        for b in range(3):
+            ids = set(lab[batches == b].tolist())
+            assert all(i.startswith(f"s{b}c") for i in ids)
+        # deterministic in (truth, batches, seed)
+        again = per_sample_unsupervised(truth, batches, seed=0)
+        assert np.array_equal(lab, again)
+
+
+# --------------------------------------------------------------------------
+# topology clusterer: determinism + structure recovery
+# --------------------------------------------------------------------------
+
+class TestTopologyClusterer:
+    def _blobs(self, n=600, k=3, d=6, seed=5, spread=0.5):
+        rng = np.random.default_rng(seed)
+        centers = rng.normal(0.0, 6.0, size=(k, d))
+        lab = rng.integers(0, k, size=n)
+        x = (centers[lab]
+             + rng.normal(0.0, spread, size=(n, d))).astype(np.float32)
+        return x, lab
+
+    def test_pure_function_of_inputs(self):
+        from scconsensus_tpu.workloads.topology import topology_cluster
+
+        x, _ = self._blobs()
+        a = topology_cluster(x, n_covers=10, seed=3)
+        b = topology_cluster(x.copy(), n_covers=10, seed=3)
+        assert np.array_equal(a, b)
+        # a different seed is allowed to change the cover, never crash
+        c = topology_cluster(x, n_covers=10, seed=4)
+        assert c.shape == a.shape
+
+    def test_recovers_separated_blobs(self):
+        from scconsensus_tpu.obs.regress import adjusted_rand_index
+        from scconsensus_tpu.workloads.topology import topology_cluster
+
+        x, lab = self._blobs()
+        got = topology_cluster(x, n_covers=10, seed=3)
+        assert adjusted_rand_index(got, lab) > 0.95
+
+    def test_labeling_from_expression_matrix(self):
+        """The (G, N) convenience entry: shared PCA embed + cluster,
+        matching the two-piece composition exactly."""
+        from scconsensus_tpu.workloads.common import pca_embed
+        from scconsensus_tpu.workloads.topology import (
+            topology_cluster,
+            topology_labeling,
+        )
+
+        rng = np.random.default_rng(9)
+        data = rng.gamma(2.0, size=(50, 400)).astype(np.float32)
+        lab = topology_labeling(data, n_pcs=6, n_covers=8, seed=2)
+        emb = pca_embed(data, 6, seed=2)
+        expect = topology_cluster(emb, n_covers=8, seed=2)
+        assert np.array_equal(lab, expect)
+
+    def test_cross_shape_replay_via_verify_run(self):
+        """tools/verify_run.py topo family: the SAME topology workload
+        under the serial and scan-kernel execution shapes must land ONE
+        sha — the clusterer is a pure function of its inputs, never of
+        the execution shape."""
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "verify_run.py"),
+             "--shapes", "topo,topo_scan", "--cells", "800",
+             "--clusters", "3", "--timeout", "240", "--json"],
+            capture_output=True, text=True, timeout=300, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        verdict = json.loads(proc.stdout)
+        assert verdict["verify"] == "ok"
+        shas = {s["labels_sha"] for s in verdict["shapes"]}
+        assert len(shas) == 1
+        assert verdict["labels_sha_by_family"]["topo"] in shas
+
+
+# --------------------------------------------------------------------------
+# the four scenarios end to end at tier-1 shapes
+# --------------------------------------------------------------------------
+
+# tiny overrides UNDER the registered smoke shapes: the pytest lane
+# proves the wiring (runner -> sections -> validators), the smoke
+# shapes themselves stay the bench/chaos lane's job
+_TINY = {
+    "multi_sample": dict(n_cells=1200, n_genes=120, n_clusters=3,
+                         n_samples=2),
+    "cite_dual": dict(n_cells=1000, n_genes=120, n_adt=12, k_fine=4,
+                      k_coarse=2),
+    "atlas_transfer": dict(n_atlas=900, n_query=600, n_genes=120,
+                           n_clusters=4, cells_per=100),
+    "topo_inputs": dict(n_cells=1000, n_genes=120, n_clusters=3,
+                        n_covers=8),
+}
+
+
+def _run_tiny(name):
+    out = run_scenario(name, overrides=_TINY[name], smoke=True)
+    rec = build_run_record(
+        metric=out.metric, value=out.value, unit=out.unit,
+        extra=dict({k: v for k, v in out.extra.items()
+                    if isinstance(v, (int, float, str, bool))},
+                   config=name, platform="cpu"),
+        spans=out.spans, quality=out.quality, serving=out.serving,
+        scenario=out.scenario, residency=out.residency,
+    )
+    validate_run_record(rec)
+    return out, rec
+
+
+class TestScenariosEndToEnd:
+    def test_multi_sample(self):
+        out, rec = _run_tiny("multi_sample")
+        sc = rec["quality"]["scenario"]
+        # the integration evidence the scenario exists for: BOTH halves
+        assert set(sc["per_batch_ari"]) == {"0", "1"}
+        assert all(-1.0 <= v <= 1.0
+                   for v in sc["per_batch_ari"].values())
+        assert sc["batch_mixing"]["n_batches"] == 2
+        assert rec["scenario"]["name"] == "multi_sample"
+        assert rec["scenario"]["smoke"] is True
+        # the planted structure is recoverable within every sample
+        assert sc["metrics"]["per_batch_ari_mean"] > 0.7
+
+    def test_cite_dual(self):
+        out, rec = _run_tiny("cite_dual")
+        m = rec["quality"]["scenario"]["metrics"]
+        # the ADT labeling carries coarse signal, the RNA labeling fine
+        # signal, and the consensus refinement recovers the fine truth
+        # better than chance from the pair
+        assert m["adt_ari_vs_coarse"] > 0.2
+        assert m["rna_ari_vs_fine"] > 0.2
+        assert m["final_ari_vs_fine"] > 0.5
+        assert rec["scenario"]["name"] == "cite_dual"
+
+    def test_atlas_transfer_through_serve_path(self):
+        out, rec = _run_tiny("atlas_transfer")
+        # the serve driver's validated accounting section IS on the
+        # record (validate_run_record above enforced its rules) with
+        # the latency evidence the serving baselines gate
+        sv = rec["serving"]
+        assert sv["requests"]["submitted"] >= 6
+        assert (sv.get("latency_ms") or {}).get("p99") is not None
+        m = rec["quality"]["scenario"]["metrics"]
+        assert m["answered_frac"] == 1.0
+        assert m["transfer_ari"] > 0.9  # the transfer actually works
+        assert out.unit == "cells/sec" and out.value > 0
+
+    def test_topo_inputs(self):
+        out, rec = _run_tiny("topo_inputs")
+        m = rec["quality"]["scenario"]["metrics"]
+        assert m["topo_replay_identical"] == 1.0
+        assert m["n_topo_clusters"] >= 2
+        assert m["final_ari_vs_truth"] > 0.5
+        assert rec["scenario"]["name"] == "topo_inputs"
+
+
+# --------------------------------------------------------------------------
+# bench / chaos / gate registration
+# --------------------------------------------------------------------------
+
+class TestZooRegistration:
+    def test_bench_configs_registered(self):
+        import bench
+
+        for name in scenario_names():
+            assert name in bench.CONFIGS, name
+            assert bench.CONFIGS[name]["kind"] == "scenario"
+            assert bench.CONFIGS[name]["scenario"] == name
+
+    def test_chaos_workload_matrix(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import chaos_run
+
+        names = [m[0] for m in chaos_run.WORKLOAD_SOAK_MATRIX]
+        assert "workload-kill-resume" in names
+
+    def test_verify_run_topo_family(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import verify_run
+
+        fams = {s[3] for s in verify_run.SHAPES}
+        assert fams == {"refine", "topo"}
+        topo_shapes = [s[0] for s in verify_run.SHAPES
+                       if s[3] == "topo"]
+        assert set(topo_shapes) >= {"topo", "topo_mesh8", "topo_scan"}
+        assert verify_run.FAMILIES["topo"][0] == \
+            "scconsensus_tpu.workloads.soak"
+
+    def test_soak_worker_resume_identity_in_process(self, tmp_path):
+        """The chaos plan's kernel in-process: a second run over the
+        same durable store ADOPTS stage artifacts and reproduces the
+        labels sha byte-identically."""
+        from scconsensus_tpu.workloads.soak import run_workload_soak
+
+        kw = dict(n_cells=900, n_genes=100, n_clusters=3, n_samples=2,
+                  seed=7)
+        first = run_workload_soak(str(tmp_path), fresh=True, **kw)
+        assert first["ok"] and not first["resumed_stages"]
+        second = run_workload_soak(str(tmp_path), **kw)
+        assert second["ok"]
+        assert len(second["resumed_stages"]) >= 1
+        assert second["labels_sha"] == first["labels_sha"]
+        # the summary record is scenario-stamped evidence
+        assert second["record"]["scenario"]["name"] == "multi_sample"

@@ -43,8 +43,15 @@ labels byte-identical to a clean reference run; repeated corruption
 pinned to one device of a forced 8-virtual-device mesh → the elastic
 supervisor evicts the miscomputing chip — mesh shrink recorded — and
 the run still lands byte-identical labels, extending the r14 plan from
-chips that die to chips that lie). ``--soak-plans`` filters all four
-matrices by name (comma-separated) for bounded CI runs.
+chips that die to chips that lie) and :data:`WORKLOAD_SOAK_MATRIX`
+(round 19, the workload-zoo axis, driven through the replayable
+scenario worker ``python -m scconsensus_tpu.workloads.soak``: SIGKILL
+at a pipeline stage site mid-multi-sample-scenario → the resumed run
+adopts the durable stage artifacts and lands labels byte-identical to
+an uninterrupted reference, with the evidence carrying the validated
+``scenario`` section — kill-resume identity proven beyond the anchor
+data geometry). ``--soak-plans`` filters all five matrices by name
+(comma-separated) for bounded CI runs.
 
 Exit codes: 0 chaos contract held; 1 it did not; 2 usage/IO error.
 """
@@ -166,6 +173,24 @@ STREAM_SOAK_MATRIX: List[Tuple[str, List[Dict[str, Any]], str,
      "atlas-device-loss", {"replicas": 2}),
 ]
 
+# The workload-zoo matrix (round 19, ROADMAP item 4): each plan drives
+# the replayable scenario worker (python -m scconsensus_tpu.workloads
+# .soak — the multi-sample scenario's dataset + unaligned per-sample
+# labelings, pure functions of the seed, refined over a DURABLE
+# artifact store). Mirrors STREAM_SOAK_MATRIX's kill-resume contract on
+# a NON-anchor data geometry: a run SIGKILLed at a stage site leaves
+# its completed stage artifacts durable; the resumed run must ADOPT
+# them (resumed_stages >= 1, never a silent from-zero restart) and land
+# a labels_sha byte-identical to an uninterrupted reference — recovery
+# proven on a scenario shape, with the evidence scenario-stamped
+# (validated `scenario` section + per-batch ARI on the record).
+WORKLOAD_SOAK_MATRIX: List[Tuple[str, List[Dict[str, Any]], str,
+                                 Dict[str, Any]]] = [
+    ("workload-kill-resume",
+     [{"site": "stage:tree", "class": "kill", "after": 0}],
+     "workload-kill-resume", {}),
+]
+
 # The computation-integrity matrix (round 18): each plan drives the
 # replayable in-memory worker (python -m scconsensus_tpu.robust.soak —
 # the SAME seed-pure planted-marker workload as the streaming soak)
@@ -232,14 +257,18 @@ def _fleet_worker(workdir: str, timeout_s: float, n_requests: int,
         return rc, None
 
 
-def _serve_worker(workdir: str, plan_path: Optional[str],
-                  timeout_s: float, n_requests: int,
-                  extra_args: Optional[List[str]] = None
-                  ) -> Tuple[int, Optional[Dict[str, Any]]]:
-    """One serve-soak worker subprocess; returns (rc, summary|None).
-    rc -9 (SIGKILL) with no fresh summary is the kill-plan's expected
-    shape."""
-    summary_path = os.path.join(workdir, "SOAK_SUMMARY.json")
+def _soak_subprocess(module: str, summary_name: str, tag: str,
+                     workdir: str, plan_path: Optional[str],
+                     timeout_s: float,
+                     cmd_extra: Optional[List[str]] = None,
+                     env_extra: Optional[Dict[str, str]] = None,
+                     ) -> Tuple[int, Optional[Dict[str, Any]]]:
+    """The shared soak-worker subprocess spine (one copy for all four
+    matrices): stale-summary removal, SCC_FAULT_PLAN arming, CPU
+    platform default, timeout→124, stderr tail under ``tag``, summary
+    JSON read. Returns (rc, summary|None); rc -9 (SIGKILL) with no
+    fresh summary is a kill-plan's expected shape."""
+    summary_path = os.path.join(workdir, summary_name)
     try:
         os.remove(summary_path)
     except OSError:
@@ -249,9 +278,12 @@ def _serve_worker(workdir: str, plan_path: Optional[str],
     if plan_path:
         env["SCC_FAULT_PLAN"] = os.path.abspath(plan_path)
     env.setdefault("JAX_PLATFORMS", "cpu")
-    cmd = [sys.executable, "-m", "scconsensus_tpu.serve.soak",
-           "--dir", workdir, "--requests", str(n_requests),
-           "--summary", summary_path] + list(extra_args or [])
+    for k, v in (env_extra or {}).items():
+        env[k] = (env.get(k, "") + " " + v).strip() \
+            if k == "XLA_FLAGS" else v
+    cmd = [sys.executable, "-m", module,
+           "--dir", workdir, "--summary", summary_path] \
+        + list(cmd_extra or [])
     try:
         proc = subprocess.run(cmd, env=env, capture_output=True,
                               text=True, timeout=timeout_s, cwd=_REPO)
@@ -260,48 +292,36 @@ def _serve_worker(workdir: str, plan_path: Optional[str],
         return 124, None
     if rc != 0 and proc.stderr:
         for ln in proc.stderr.strip().splitlines()[-4:]:
-            print(f"[serve-soak] {ln}", file=sys.stderr)
+            print(f"[{tag}] {ln}", file=sys.stderr)
     try:
         with open(summary_path) as f:
             return rc, json.load(f)
     except (OSError, json.JSONDecodeError):
         return rc, None
+
+
+def _serve_worker(workdir: str, plan_path: Optional[str],
+                  timeout_s: float, n_requests: int,
+                  extra_args: Optional[List[str]] = None
+                  ) -> Tuple[int, Optional[Dict[str, Any]]]:
+    """One serve-soak worker subprocess; returns (rc, summary|None)."""
+    return _soak_subprocess(
+        "scconsensus_tpu.serve.soak", "SOAK_SUMMARY.json", "serve-soak",
+        workdir, plan_path, timeout_s,
+        cmd_extra=["--requests", str(n_requests)] + list(extra_args or []),
+    )
 
 
 def _stream_worker(workdir: str, plan_path: Optional[str],
                    timeout_s: float,
                    extra_args: Optional[List[str]] = None
                    ) -> Tuple[int, Optional[Dict[str, Any]]]:
-    """One streaming-soak worker subprocess; returns (rc, summary|None).
-    rc -9 (SIGKILL) with no fresh summary is the kill-plan's expected
-    shape."""
-    summary_path = os.path.join(workdir, "STREAM_SOAK_SUMMARY.json")
-    try:
-        os.remove(summary_path)
-    except OSError:
-        pass
-    env = dict(os.environ)
-    env.pop("SCC_FAULT_PLAN", None)
-    if plan_path:
-        env["SCC_FAULT_PLAN"] = os.path.abspath(plan_path)
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    cmd = [sys.executable, "-m", "scconsensus_tpu.stream.soak",
-           "--dir", workdir, "--summary", summary_path] \
-        + list(extra_args or [])
-    try:
-        proc = subprocess.run(cmd, env=env, capture_output=True,
-                              text=True, timeout=timeout_s, cwd=_REPO)
-        rc = proc.returncode
-    except subprocess.TimeoutExpired:
-        return 124, None
-    if rc != 0 and proc.stderr:
-        for ln in proc.stderr.strip().splitlines()[-4:]:
-            print(f"[stream-soak] {ln}", file=sys.stderr)
-    try:
-        with open(summary_path) as f:
-            return rc, json.load(f)
-    except (OSError, json.JSONDecodeError):
-        return rc, None
+    """One streaming-soak worker subprocess; returns (rc, summary|None)."""
+    return _soak_subprocess(
+        "scconsensus_tpu.stream.soak", "STREAM_SOAK_SUMMARY.json",
+        "stream-soak", workdir, plan_path, timeout_s,
+        cmd_extra=list(extra_args or []),
+    )
 
 
 def _integrity_worker(workdir: str, plan_path: Optional[str],
@@ -311,39 +331,85 @@ def _integrity_worker(workdir: str, plan_path: Optional[str],
                       ) -> Tuple[int, Optional[Dict[str, Any]]]:
     """One integrity-soak worker subprocess (SCC_INTEGRITY=enforce);
     returns (rc, summary|None)."""
-    summary_path = os.path.join(workdir, "INTEGRITY_SOAK_SUMMARY.json")
-    try:
-        os.remove(summary_path)
-    except OSError:
-        pass
-    env = dict(os.environ)
-    env.pop("SCC_FAULT_PLAN", None)
-    if plan_path:
-        env["SCC_FAULT_PLAN"] = os.path.abspath(plan_path)
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    env["SCC_INTEGRITY"] = "enforce"
+    env_extra: Dict[str, str] = {"SCC_INTEGRITY": "enforce"}
     if mesh8:
-        env["XLA_FLAGS"] = (
-            (env.get("XLA_FLAGS") or "")
-            + " --xla_force_host_platform_device_count=8"
-        ).strip()
-    cmd = [sys.executable, "-m", "scconsensus_tpu.robust.soak",
-           "--dir", workdir, "--summary", summary_path, "--fresh"] \
-        + list(extra_args or [])
-    try:
-        proc = subprocess.run(cmd, env=env, capture_output=True,
-                              text=True, timeout=timeout_s, cwd=_REPO)
-        rc = proc.returncode
-    except subprocess.TimeoutExpired:
-        return 124, None
-    if rc != 0 and proc.stderr:
-        for ln in proc.stderr.strip().splitlines()[-4:]:
-            print(f"[integrity-soak] {ln}", file=sys.stderr)
-    try:
-        with open(summary_path) as f:
-            return rc, json.load(f)
-    except (OSError, json.JSONDecodeError):
-        return rc, None
+        env_extra["XLA_FLAGS"] = \
+            "--xla_force_host_platform_device_count=8"
+    return _soak_subprocess(
+        "scconsensus_tpu.robust.soak", "INTEGRITY_SOAK_SUMMARY.json",
+        "integrity-soak", workdir, plan_path, timeout_s,
+        cmd_extra=["--fresh"] + list(extra_args or []),
+        env_extra=env_extra,
+    )
+
+
+def _workload_worker(workdir: str, plan_path: Optional[str],
+                     timeout_s: float,
+                     extra_args: Optional[List[str]] = None,
+                     ) -> Tuple[int, Optional[Dict[str, Any]]]:
+    """One workload-zoo soak worker subprocess; returns
+    (rc, summary|None)."""
+    return _soak_subprocess(
+        "scconsensus_tpu.workloads.soak", "WORKLOAD_SOAK_SUMMARY.json",
+        "workload-soak", workdir, plan_path, timeout_s,
+        cmd_extra=list(extra_args or []),
+    )
+
+
+def run_workload_plan(name: str, rules: List[Dict[str, Any]],
+                      mode: str, extra: Dict[str, Any], tmp: str,
+                      timeout_s: float, ref_cache: Dict[str, Any]
+                      ) -> int:
+    """Run one workload-zoo plan; 0 = the scenario chaos contract held.
+    ``ref_cache`` shares ONE uninterrupted reference run's labels_sha
+    (the scenario is a pure function of the seed)."""
+    workdir = os.path.join(tmp, name)
+    os.makedirs(workdir, exist_ok=True)
+    plan_path = os.path.join(workdir, "plan.json")
+    with open(plan_path, "w") as f:
+        json.dump({"faults": rules}, f)
+    checks: List[Tuple[str, bool]] = []
+    deadline = time.monotonic() + timeout_s
+
+    def _left() -> float:
+        return max(deadline - time.monotonic(), 1.0)
+
+    if "sha" not in ref_cache:
+        ref_dir = os.path.join(tmp, "workload-reference")
+        os.makedirs(ref_dir, exist_ok=True)
+        rc, ref = _workload_worker(ref_dir, None, _left(), ["--fresh"])
+        ref_cache["sha"] = (ref or {}).get("labels_sha") \
+            if rc == 0 and ref and ref.get("ok") else None
+    ref_sha = ref_cache["sha"]
+    checks.append(("reference scenario run clean", ref_sha is not None))
+    rc1, _ = _workload_worker(workdir, plan_path, _left(), ["--fresh"])
+    checks.append(("kill plan killed the worker mid-pipeline",
+                   rc1 != 0))
+    rc2, resumed = _workload_worker(workdir, None, _left())
+    checks.append(("resume run clean (scenario record validated)",
+                   rc2 == 0 and bool(resumed) and resumed.get("ok")))
+    checks.append((
+        "resume ADOPTED durable stage artifacts (did not restart "
+        "from zero)",
+        bool(resumed) and len(resumed.get("resumed_stages") or []) >= 1,
+    ))
+    checks.append((
+        "killed-and-resumed scenario produced byte-identical labels",
+        bool(resumed) and ref_sha is not None
+        and resumed.get("labels_sha") == ref_sha,
+    ))
+    checks.append((
+        "record carries the validated scenario section + per-batch ARI",
+        bool(resumed)
+        and ((resumed.get("record") or {}).get("scenario") or {}
+             ).get("name") == "multi_sample"
+        and bool(resumed.get("per_batch_ari")),
+    ))
+    ok = all(c for _, c in checks)
+    for label, c in checks:
+        print(f"[chaos:{name}] {'ok  ' if c else 'FAIL'} {label}",
+              file=sys.stderr)
+    return 0 if ok else 1
 
 
 def run_integrity_plan(name: str, rules: List[Dict[str, Any]],
@@ -715,12 +781,15 @@ def run_soak(config: str, evidence_dir: str, budget_s: float,
                      if not only or m[0] in only]
     integrity_matrix = [m for m in INTEGRITY_SOAK_MATRIX
                         if not only or m[0] in only]
+    workload_matrix = [m for m in WORKLOAD_SOAK_MATRIX
+                       if not only or m[0] in only]
     if not matrix and not serve_matrix and not stream_matrix \
-            and not integrity_matrix:
+            and not integrity_matrix and not workload_matrix:
         known = ([m[0] for m in SOAK_MATRIX]
                  + [m[0] for m in SERVE_SOAK_MATRIX]
                  + [m[0] for m in STREAM_SOAK_MATRIX]
-                 + [m[0] for m in INTEGRITY_SOAK_MATRIX])
+                 + [m[0] for m in INTEGRITY_SOAK_MATRIX]
+                 + [m[0] for m in WORKLOAD_SOAK_MATRIX])
         print(f"chaos_run: --soak-plans matched nothing "
               f"(known: {known})", file=sys.stderr)
         return 2
@@ -782,6 +851,21 @@ def run_soak(config: str, evidence_dir: str, budget_s: float,
             t_plan = time.monotonic()
             rc = run_stream_plan(name, rules, mode, extra, tmp,
                                  remaining, stream_ref)
+            results.append({
+                "plan": name, "ok": rc == 0,
+                "outcome": "ok" if rc == 0 else f"rc={rc}",
+                "elapsed_s": round(time.monotonic() - t_plan, 1),
+            })
+        workload_ref: Dict[str, Any] = {}  # one shared reference sha
+        for name, rules, mode, extra in workload_matrix:
+            remaining = budget_s - (time.monotonic() - t0)
+            if remaining <= 0:
+                results.append({"plan": name, "ok": False,
+                                "outcome": "budget-exhausted"})
+                continue
+            t_plan = time.monotonic()
+            rc = run_workload_plan(name, rules, mode, extra, tmp,
+                                   remaining, workload_ref)
             results.append({
                 "plan": name, "ok": rc == 0,
                 "outcome": "ok" if rc == 0 else f"rc={rc}",

@@ -538,6 +538,82 @@ def _smoke(fixtures: str, as_json: bool) -> int:
         and bool((fl.get("fleet") or {}).get("submitted_by_owner")),
     ))
 
+    # workload-zoo scenario records (round 19): a multi_sample candidate
+    # with a validated top-level `scenario` section and a full
+    # quality.scenario block (per-batch ARI + batch-mixing) validates
+    # and gates normally — its key has no fixture history, so it SEEDS
+    # (a first run cannot regress)...
+    verdict_sc, _ = run_gate(
+        os.path.join(fixtures, "candidate_scenario_clean.json"),
+        evidence,
+    )
+    sc_rec = _load_json(
+        os.path.join(fixtures, "candidate_scenario_clean.json")
+    )
+    sc_q = ((sc_rec.get("quality") or {}).get("scenario")) or {}
+    checks.append((
+        "multi_sample scenario candidate validates and seeds its key "
+        "(scenario section + per-batch ARI + batch-mixing present)",
+        verdict_sc.ok
+        and (sc_rec.get("scenario") or {}).get("name") == "multi_sample"
+        and bool(sc_q.get("per_batch_ari"))
+        and bool(sc_q.get("batch_mixing")),
+    ))
+    # ...the atlas_transfer candidate additionally carries the serve
+    # driver's validated serving section — the first serve-latency
+    # evidence on a non-anchor key...
+    verdict_at, _ = run_gate(
+        os.path.join(fixtures, "candidate_scenario_atlas.json"),
+        evidence,
+    )
+    at_rec = _load_json(
+        os.path.join(fixtures, "candidate_scenario_atlas.json")
+    )
+    checks.append((
+        "atlas_transfer scenario candidate validates with a serving "
+        "section (p99 + accounting) on a non-anchor key",
+        verdict_at.ok
+        and (at_rec.get("scenario") or {}).get("name") == "atlas_transfer"
+        and ((at_rec.get("serving") or {}).get("latency_ms") or {}
+             ).get("p99") is not None,
+    ))
+    # ...while a scenario block carrying per-batch ARI WITHOUT mixing
+    # evidence is REJECTED naming the rule — half an integration claim
+    # must not gate as if it were whole...
+    try:
+        run_gate(os.path.join(fixtures, "candidate_scenario_bad.json"),
+                 evidence)
+        sc_rejected = False
+    except ValueError as e:
+        sc_rejected = "per_batch_ari and batch_mixing" in str(e)
+    checks.append((
+        "scenario block with per-batch ARI but no batch-mixing "
+        "evidence rejected naming the rule",
+        sc_rejected,
+    ))
+    # ...and a record claiming an UNREGISTERED scenario is equally a
+    # schema violation (a scenario key outside the zoo has no baseline
+    # semantics); scratch file to a temp dir like the twins above
+    import copy as _copy_sc
+    import tempfile as _tempfile_sc
+
+    bad_sc = _copy_sc.deepcopy(sc_rec)
+    bad_sc["scenario"]["name"] = "no_such_scenario"
+    bad_sc["quality"]["scenario"]["name"] = "no_such_scenario"
+    with _tempfile_sc.TemporaryDirectory(prefix="scc-gate-smoke-") as tsc:
+        bad_path = os.path.join(tsc, "candidate_scenario_unknown.json")
+        with open(bad_path, "w") as f:
+            json.dump(bad_sc, f)
+        try:
+            run_gate(bad_path, evidence)
+            sc_unknown_rejected = False
+        except ValueError as e:
+            sc_unknown_rejected = "unknown scenario" in str(e)
+    checks.append((
+        "record claiming an unregistered scenario rejected",
+        sc_unknown_rejected,
+    ))
+
     # a serving section that lost a request is a SCHEMA violation, not a
     # gateable record (the accounting rule is the serve contract);
     # scratch file goes to a temp dir — the committed fixture tree may

@@ -33,6 +33,13 @@ Shapes (``--shapes`` filters; default runs all):
   resume      stream32 run twice over the same stage store: the second
               run adopts every durable chunk (full resume) and must
               reproduce the sha without recomputing
+  topo        the workload zoo's topology clusterer (workloads.soak
+              --topo) on a seeded embedding — reference of the "topo"
+              family (families pin shas independently: a topology
+              labeling is a different answer than the refine workload)
+  topo_mesh8  the same topology workload under a forced
+              8-virtual-device CPU mesh
+  topo_scan   ... under the scan kernel family (SCC_NO_RUNSPACE=1)
 
 ``--integrity`` additionally arms the SCC_INTEGRITY sentinels inside
 every worker (default: inherit the environment), so the audit can run
@@ -56,21 +63,41 @@ from typing import Any, Dict, List, Optional, Tuple
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-# name -> (worker args, env overrides)
-SHAPES: List[Tuple[str, List[str], Dict[str, str]]] = [
-    ("serial", [], {}),
+# name -> (worker args, env overrides, family). Shapes in the same
+# FAMILY replay the same workload and must agree on one sha; families
+# have independent references (a topology labeling's sha is a different
+# answer than the refine workload's — comparing them would prove
+# nothing). "refine" shapes drive robust.soak; "topo" shapes drive the
+# workload zoo's topology clusterer (workloads.soak --topo) under the
+# same execution-shape axes — the cross-shape determinism pin ISSUE 14
+# asks of the Mapper-style labeler.
+SHAPES: List[Tuple[str, List[str], Dict[str, str], str]] = [
+    ("serial", [], {}, "refine"),
     ("mesh8", ["--mesh", "auto"],
-     {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}),
-    ("scan", [], {"SCC_NO_RUNSPACE": "1"}),
-    ("stream32", ["--stream", "--stream-window", "32"], {}),
-    ("stream16", ["--stream", "--stream-window", "16"], {}),
-    ("resume", ["--stream", "--stream-window", "32"], {}),
+     {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+     "refine"),
+    ("scan", [], {"SCC_NO_RUNSPACE": "1"}, "refine"),
+    ("stream32", ["--stream", "--stream-window", "32"], {}, "refine"),
+    ("stream16", ["--stream", "--stream-window", "16"], {}, "refine"),
+    ("resume", ["--stream", "--stream-window", "32"], {}, "refine"),
+    ("topo", [], {}, "topo"),
+    ("topo_mesh8", [],
+     {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+     "topo"),
+    ("topo_scan", [], {"SCC_NO_RUNSPACE": "1"}, "topo"),
 ]
+
+# family -> (worker module, reference shape)
+FAMILIES: Dict[str, Tuple[str, str]] = {
+    "refine": ("scconsensus_tpu.robust.soak", "serial"),
+    "topo": ("scconsensus_tpu.workloads.soak", "topo"),
+}
 
 
 def run_shape(name: str, extra_args: List[str], env_over: Dict[str, str],
               workdir: str, shape_args: List[str], timeout_s: float,
               integrity: Optional[str], fresh: bool = True,
+              module: str = "scconsensus_tpu.robust.soak",
               ) -> Tuple[bool, Optional[Dict[str, Any]], str]:
     """One worker subprocess; returns (ok, summary|None, note)."""
     summary_path = os.path.join(workdir, f"VERIFY_{name}.json")
@@ -82,7 +109,7 @@ def run_shape(name: str, extra_args: List[str], env_over: Dict[str, str],
     for k, v in env_over.items():
         env[k] = (env.get(k, "") + " " + v).strip() \
             if k == "XLA_FLAGS" else v
-    cmd = [sys.executable, "-m", "scconsensus_tpu.robust.soak",
+    cmd = [sys.executable, "-m", module,
            "--dir", os.path.join(workdir, name),
            "--summary", summary_path] + shape_args + extra_args
     if fresh:
@@ -134,10 +161,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     t0 = time.monotonic()
     results: List[Dict[str, Any]] = []
     with tempfile.TemporaryDirectory(prefix="scc-verify-") as tmp:
-        for name, extra, env_over in shapes:
+        for name, extra, env_over, family in shapes:
+            module, _ = FAMILIES[family]
+            if family == "topo":
+                extra = list(extra) + ["--topo"]
             left = args.timeout - (time.monotonic() - t0)
             if left <= 0:
-                results.append({"shape": name, "ok": False,
+                results.append({"shape": name, "family": family,
+                                "ok": False, "labels_sha": None,
                                 "note": "budget-exhausted"})
                 continue
             t_s = time.monotonic()
@@ -146,16 +177,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # every chunk — a full resume must reproduce the sha
                 ok0, _, note0 = run_shape(
                     name, extra, env_over, tmp, shape_args, left,
-                    args.integrity, fresh=True,
+                    args.integrity, fresh=True, module=module,
                 )
                 left = args.timeout - (time.monotonic() - t0)
                 if not ok0 or left <= 0:
-                    results.append({"shape": name, "ok": False,
+                    results.append({"shape": name, "family": family,
+                                    "ok": False, "labels_sha": None,
                                     "note": f"prime failed: {note0}"})
                     continue
                 ok, summary, note = run_shape(
                     name, extra, env_over, tmp, shape_args, left,
-                    args.integrity, fresh=False,
+                    args.integrity, fresh=False, module=module,
                 )
                 if ok and summary is not None and not (
                         (summary.get("record") or {}).get(
@@ -165,30 +197,48 @@ def main(argv: Optional[List[str]] = None) -> int:
             else:
                 ok, summary, note = run_shape(
                     name, extra, env_over, tmp, shape_args, left,
-                    args.integrity,
+                    args.integrity, module=module,
                 )
             results.append({
                 "shape": name,
+                "family": family,
                 "ok": bool(ok and summary and summary.get("ok")),
                 "labels_sha": (summary or {}).get("labels_sha"),
                 "note": note,
                 "elapsed_s": round(time.monotonic() - t_s, 1),
             })
-    ref = next((r["labels_sha"] for r in results
-                if r["shape"] == "serial" and r["labels_sha"]),
-               next((r["labels_sha"] for r in results
-                     if r["labels_sha"]), None))
+    # one reference PER FAMILY: shapes only ever pin against shapes
+    # replaying the same workload
+    refs: Dict[str, Optional[str]] = {}
+    for fam, (_, ref_shape) in FAMILIES.items():
+        fam_results = [r for r in results if r["family"] == fam]
+        if not fam_results:
+            continue
+        refs[fam] = next(
+            (r["labels_sha"] for r in fam_results
+             if r["shape"] == ref_shape and r.get("labels_sha")),
+            next((r["labels_sha"] for r in fam_results
+                  if r.get("labels_sha")), None),
+        )
     for r in results:
-        if r["ok"] and ref is not None and r["labels_sha"] != ref:
+        ref = refs.get(r["family"])
+        if r["ok"] and ref is not None and r.get("labels_sha") != ref:
             r["ok"] = False
             r["note"] = (f"labels diverged from reference "
                          f"({(r['labels_sha'] or '?')[:16]} != "
                          f"{ref[:16]}) — a shape-dependent answer")
     ok_all = bool(results) and all(r["ok"] for r in results) \
-        and ref is not None
+        and bool(refs) and all(v is not None for v in refs.values())
+    # top-level labels_sha keeps the pre-family contract: the refine
+    # sha when refine shapes ran, else the sole family's sha (a
+    # topo-only audit must not print null for a passing run)
+    top_sha = refs.get("refine")
+    if top_sha is None and len(refs) == 1:
+        top_sha = next(iter(refs.values()))
     verdict = {
         "verify": "ok" if ok_all else "FAIL",
-        "labels_sha": ref,
+        "labels_sha": top_sha,
+        "labels_sha_by_family": refs,
         "shapes": results,
         "consumed_s": round(time.monotonic() - t0, 1),
     }
@@ -198,7 +248,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         for r in results:
             mark = "ok  " if r["ok"] else "FAIL"
             print(f"[verify:{r['shape']}] {mark} "
-                  f"sha={(r['labels_sha'] or '?')[:16]}"
+                  f"sha={(r.get('labels_sha') or '?')[:16]}"
                   + (f"  ({r['note']})" if r.get("note") else ""))
         print(json.dumps({k: verdict[k] for k in
                           ("verify", "labels_sha", "consumed_s")}))
