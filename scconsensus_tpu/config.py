@@ -274,6 +274,38 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "server lifetime (ledger LINES keep appending past it; "
                 "only the .npy cell payloads stop): the reconsensus "
                 "material stays bounded under a drift storm."),
+        # --- telemetry plane (serve/slo.py, obs/) ---
+        EnvFlag("SCC_OBS_TRACE", bool, True,
+                "Request tracing: mint a trace id at the wire front (or "
+                "driver admission), propagate it through routing, the "
+                "serve_request span, the response header/body "
+                "(X-SCC-Trace-Id), the quarantine ledger row, and the "
+                "heartbeat stream's recent-request ring — one id "
+                "recovers a request's cross-process story (the "
+                "postmortem bundle joins on it). Set 0 to run the "
+                "plane dark (the obs-overhead gauge's baseline)."),
+        EnvFlag("SCC_SLO_AVAIL_TARGET", float, 0.999,
+                "Availability SLO target: the good share of non-client-"
+                "fault wire outcomes (2xx good, 4xx excluded from the "
+                "denominator, 5xx burn the error budget). Stamped onto "
+                "the record's slo.objectives so the perf gate reads the "
+                "record, never this process's env."),
+        EnvFlag("SCC_SLO_P99_MS", float, 250.0,
+                "Tail-latency SLO target (ms): the slo section's "
+                "latency.met compares the measured p99 against it; the "
+                "perf-gate slo lane fails a record whose own target is "
+                "missed."),
+        EnvFlag("SCC_SLO_WINDOWS_S", str, "300,3600",
+                "Comma-separated trailing windows (seconds) for the "
+                "multi-window SLO burn rates, computed from the same "
+                "cumulative outcome counters the accounting contract "
+                "validates (burn 1.0 = consuming the error budget "
+                "exactly at the exhaust-by-window-end rate)."),
+        EnvFlag("SCC_SLO_BURN_LIMIT", float, 14.4,
+                "Burn-rate gate threshold (the classic fast-burn page "
+                "level: 14.4x eats a 30-day budget in ~2 days): a "
+                "record whose worst window burn exceeds its own "
+                "declared limit FAILS the perf-gate slo lane."),
         # --- serving fleet (serve/fleet/) ---
         EnvFlag("SCC_FLEET_REPLICAS", int, 2,
                 "Default replica count for serve.fleet.ReplicaPool: N "

@@ -66,6 +66,23 @@ schema ``scc-run-record`` version 1 — top-level keys:
                     serve.metrics.validate_serving — a section whose
                     outcome counters do not sum to its submissions
                     (a lost request) is rejected.
+  slo               OPTIONAL (still schema version 1 — additive): the
+                    telemetry-plane SLO section (serve.slo, round 20) —
+                    declared objectives (availability target, p99
+                    target, burn windows, burn limit), availability
+                    counts over the wire/serving outcome counters (2xx
+                    good, 4xx excluded, 5xx burn the budget),
+                    multi-window burn rates, per-outcome and per-stage
+                    fixed-bucket latency histograms (mergeable across
+                    replicas by the frozen bucket grid), and the
+                    optional obs-overhead gauge (plane on vs off).
+                    Validated by serve.slo.validate_slo — a section
+                    whose availability counts don't sum, whose burn
+                    rates contradict their own error ratios, or whose
+                    histogram buckets don't sum to their count is
+                    rejected; tools/perf_gate.py additionally FAILS a
+                    record whose worst burn exceeds its own declared
+                    burn_limit or whose p99 misses its own target.
   streaming         OPTIONAL (still schema version 1 — additive): the
                     out-of-core trail (stream.record) — chunk counters
                     (planned/fresh/resumed/recomputed/quarantined), the
@@ -159,6 +176,7 @@ def build_run_record(
     kernels: Optional[Dict[str, Any]] = None,
     robustness: Optional[Dict[str, Any]] = None,
     serving: Optional[Dict[str, Any]] = None,
+    slo: Optional[Dict[str, Any]] = None,
     streaming: Optional[Dict[str, Any]] = None,
     integrity: Optional[Dict[str, Any]] = None,
     scenario: Optional[Dict[str, Any]] = None,
@@ -214,6 +232,8 @@ def build_run_record(
         rec["robustness"] = robustness
     if serving is not None:
         rec["serving"] = serving
+    if slo is not None:
+        rec["slo"] = slo
     if streaming is not None:
         rec["streaming"] = streaming
     if integrity is not None:
@@ -326,6 +346,12 @@ def validate_run_record(rec: Dict[str, Any]) -> None:
         from scconsensus_tpu.serve.metrics import validate_serving
 
         validate_serving(sv)
+    slo = rec.get("slo")
+    if slo is not None:
+        # jax-free import (serve.slo is stdlib-only by contract)
+        from scconsensus_tpu.serve.slo import validate_slo
+
+        validate_slo(slo)
     sm = rec.get("streaming")
     if sm is not None:
         # jax-free import (stream.record is stdlib-only by contract)

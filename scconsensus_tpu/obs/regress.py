@@ -366,6 +366,25 @@ class ServingVerdict:
 
 
 @dataclasses.dataclass
+class SLOVerdict:
+    """SLO-lane verdict (round 20): the candidate's ``slo`` section
+    judged against its OWN declared objectives — no history needed,
+    because the record carries its targets (burn_limit, p99 target).
+    A clean-walls candidate whose error-budget burn breached its limit,
+    or whose p99 missed its own target, fails on THIS verdict alone."""
+
+    metric: str                    # "worst_burn" | "p99_ms"
+    value: float
+    limit: float
+    regressed: bool
+    detail: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None}
+
+
+@dataclasses.dataclass
 class StreamingVerdict:
     """Out-of-core memory verdict (candidate streaming section's peak
     RSS vs the key's ledger-stamped baselines) — a peak-RSS blowout is
@@ -410,6 +429,10 @@ class GateVerdict:
     streaming: List[StreamingVerdict] = dataclasses.field(
         default_factory=list
     )
+    # SLO verdicts (round 20; empty when the candidate carried no slo
+    # section) — judged against the record's OWN declared objectives,
+    # so they apply even to a key with zero history
+    slo: List[SLOVerdict] = dataclasses.field(default_factory=list)
 
     @property
     def regressions(self) -> List[StageVerdict]:
@@ -426,6 +449,10 @@ class GateVerdict:
     @property
     def streaming_regressions(self) -> List[StreamingVerdict]:
         return [s for s in self.streaming if s.regressed]
+
+    @property
+    def slo_regressions(self) -> List[SLOVerdict]:
+        return [s for s in self.slo if s.regressed]
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -449,7 +476,52 @@ class GateVerdict:
             "streaming_regressions": [
                 s.to_dict() for s in self.streaming_regressions
             ],
+            "slo": [s.to_dict() for s in self.slo],
+            "slo_regressions": [
+                s.to_dict() for s in self.slo_regressions
+            ],
         }
+
+
+def slo_verdicts(candidate: Dict[str, Any]) -> List[SLOVerdict]:
+    """SLO-lane verdicts for one candidate: the ``slo`` section judged
+    against its OWN declared objectives. Unlike every other lane this
+    needs no history — a record whose worst window burn exceeds its
+    declared burn_limit, or whose p99 misses its own target, fails
+    outright (the section's internal arithmetic was already enforced by
+    serve.slo.validate_slo before gating)."""
+    slo = candidate.get("slo")
+    if not isinstance(slo, dict):
+        return []
+    out: List[SLOVerdict] = []
+    obj = slo.get("objectives") or {}
+    worst = slo.get("worst_burn")
+    limit = obj.get("burn_limit")
+    if isinstance(worst, (int, float)) and isinstance(limit, (int, float)):
+        breach = None
+        for b in slo.get("burn_rates") or []:
+            if (isinstance(b, dict)
+                    and float(b.get("burn", 0.0)) > float(limit)):
+                breach = (f"window {b.get('window_s')}s burned "
+                          f"{b.get('burn')}x its error budget "
+                          f"({b.get('bad')}/{b.get('total')} bad)")
+                break
+        out.append(SLOVerdict(
+            metric="worst_burn", value=round(float(worst), 4),
+            limit=float(limit),
+            regressed=float(worst) > float(limit),
+            detail=breach,
+        ))
+    lat = slo.get("latency") or {}
+    p99 = lat.get("p99_ms")
+    target = lat.get("target_ms", obj.get("p99_ms"))
+    if isinstance(p99, (int, float)) and isinstance(target, (int, float)):
+        out.append(SLOVerdict(
+            metric="p99_ms", value=round(float(p99), 4),
+            limit=float(target),
+            regressed=float(p99) > float(target),
+        ))
+    return out
 
 
 def _efficiency(cand_cost: Optional[Dict[str, Any]],
@@ -501,13 +573,20 @@ def gate_record(candidate: Dict[str, Any],
                 f"{cand_term}): reported only — it must never be ingested "
                 "as a baseline anchor")
     history = [e for e in history if not is_partial_entry(e)]
+    # the SLO lane needs no history: the record carries its own targets
+    # (burn_limit, p99), so the verdict applies even on a seeding run —
+    # a first record that already burned through its error budget must
+    # not seed as if it were clean
+    slo = slo_verdicts(candidate)
     if not history:
-        return GateVerdict(ok=True, key=key, n_history=0, stages=[],
+        return GateVerdict(ok=not any(s.regressed for s in slo),
+                           key=key, n_history=0, stages=[],
                            note=note or
                            "no baseline history for this key; "
                            "candidate seeds the baseline",
                            n_partial_excluded=n_partial,
-                           candidate_termination=cand_term)
+                           candidate_termination=cand_term,
+                           slo=slo)
     baselines = stage_baselines(history)
     if cand_term is not None:
         # "completed stages still compare": OPEN span snapshots in a
@@ -638,13 +717,14 @@ def gate_record(candidate: Dict[str, Any],
     ok = (not any(s.regressed for s in stages)
           and not any(t.regressed for t in transfers)
           and not any(s.regressed for s in serving)
-          and not any(s.regressed for s in streaming))
+          and not any(s.regressed for s in streaming)
+          and not any(s.regressed for s in slo))
     return GateVerdict(ok=ok, key=key, n_history=len(history),
                        stages=stages, note=note,
                        n_partial_excluded=n_partial,
                        candidate_termination=cand_term,
                        transfers=transfers, serving=serving,
-                       streaming=streaming)
+                       streaming=streaming, slo=slo)
 
 
 # --------------------------------------------------------------------------

@@ -45,7 +45,33 @@ __all__ = [
     "last_tracer",
     "device_drain",
     "summarize_record",
+    "new_trace_id",
 ]
+
+
+# Trace-id state: one random process prefix (minted lazily, ONE urandom
+# syscall per process) + a monotone counter. Deliberately NOT uuid4 per
+# request: os.urandom releases the GIL every call, which measurably
+# perturbs the admission/worker scheduling the serve driver's
+# backpressure behavior (and its tests) depend on — the telemetry plane
+# must observe the system, not reschedule it.
+_TRACE_PREFIX: Optional[str] = None
+_TRACE_SEQ = itertools.count(1)
+
+
+def new_trace_id() -> str:
+    """Mint one request trace id (16 hex chars: 8-hex process prefix +
+    8-hex sequence): issued at the wire front (or driver admission when
+    no front is upstream), propagated through routing, the
+    serve_request span, the response header, the quarantine ledger row,
+    and the heartbeat stream — one id recovers a request's whole
+    cross-process story (tools/postmortem.py joins on it)."""
+    global _TRACE_PREFIX
+    if _TRACE_PREFIX is None:
+        import uuid
+
+        _TRACE_PREFIX = uuid.uuid4().hex[:8]
+    return f"{_TRACE_PREFIX}{next(_TRACE_SEQ) & 0xFFFFFFFF:08x}"
 
 _ACTIVE: contextvars.ContextVar = contextvars.ContextVar(
     "scc_active_tracer", default=None

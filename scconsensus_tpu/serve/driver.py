@@ -138,6 +138,10 @@ class ServeResponse:
     # purity check reads it off every response (a request is never split
     # across models, and this proves WHICH model served it)
     model_fp: Optional[str] = None
+    # the request's trace id (round 20): minted at the wire front (or
+    # here at admission), carried through the span/ledger/heartbeat so
+    # one id recovers the request's whole story
+    trace_id: Optional[str] = None
 
 
 class RequestHandle:
@@ -146,16 +150,17 @@ class RequestHandle:
     request's typed error."""
 
     __slots__ = ("req_id", "cells", "n", "deadline_mono", "enqueued_mono",
-                 "_event", "_response", "_error")
+                 "trace_id", "_event", "_response", "_error")
 
     def __init__(self, req_id: int, cells: np.ndarray,
-                 deadline_mono: float):
+                 deadline_mono: float, trace_id: Optional[str] = None):
         # monotonic stamps: deadlines and latencies are DURATIONS, and a
         # wall-clock step (NTP) must not expire a queue or stretch a p99
         self.req_id = req_id
         self.cells = cells
         self.n = int(cells.shape[0])
         self.deadline_mono = float(deadline_mono)
+        self.trace_id = trace_id
         self.enqueued_mono = time.monotonic()
         self._event = threading.Event()
         self._response: Optional[ServeResponse] = None
@@ -351,9 +356,12 @@ class ConsensusServer:
 
     # -- admission ---------------------------------------------------------
     def submit(self, cells: np.ndarray,
-               deadline_s: Optional[float] = None) -> RequestHandle:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> RequestHandle:
         """Enqueue one request ((n, G) genes-length rows). Typed refusals:
         ServerClosed, RequestInvalid, QueueFull(retry_after_s).
+        ``trace_id`` rides in from the wire front (or is minted here
+        with SCC_OBS_TRACE on, so a bare-driver request still has one).
 
         Guard overhead is self-measured in per-thread CPU time
         (``time.thread_time``, the r9 sampler-guard precedent): wall
@@ -361,6 +369,10 @@ class ConsensusServer:
         compute and overstate the guard cost by >10x on a busy
         interpreter."""
         t0 = time.thread_time()
+        if trace_id is None and env_flag("SCC_OBS_TRACE"):
+            from scconsensus_tpu.obs.trace import new_trace_id
+
+            trace_id = new_trace_id()
         try:
             if self._closed:
                 raise ServerClosed("server is not accepting requests")
@@ -408,7 +420,8 @@ class ConsensusServer:
                                     retry_after_s=retry)
                 self._req_seq += 1
                 req = RequestHandle(self._req_seq, x,
-                                    time.monotonic() + dl)
+                                    time.monotonic() + dl,
+                                    trace_id=trace_id)
                 self._queue.append(req)
                 self.stats.note_submit(len(self._queue))
                 self._not_empty.notify()
@@ -552,6 +565,12 @@ class ConsensusServer:
             if not live:
                 return
             self.stats.note_batch(len(live), sum(r.n for r in live))
+            for r in live:
+                # queue_wait stage histogram: dequeue minus enqueue per
+                # request — the half of the p99 batching owns
+                self.stats.note_stage_latency(
+                    "queue_wait", now - r.enqueued_mono
+                )
             try:
                 # batching-layer fault site: kill/stall/corrupt plans
                 # land between dequeue and dispatch — mid-batch
@@ -616,6 +635,8 @@ class ConsensusServer:
             batch_wall = time.perf_counter() - t_dev0
             classify_cpu = time.thread_time() - t_dev0_cpu
             self.stats.add_classify_wall(batch_wall)
+            # compute stage histogram: the classify wall this batch paid
+            self.stats.note_stage_latency("compute", batch_wall)
             self._batch_wall_ewma = (0.7 * self._batch_wall_ewma
                                      + 0.3 * batch_wall)
 
@@ -657,6 +678,7 @@ class ConsensusServer:
                         latency_s=now2 - r.enqueued_mono,
                         batch_seq=self._batch_seq,
                         model_fp=self.model.fingerprint(),
+                        trace_id=r.trace_id,
                     ), outcome="quarantined")
                     continue
                 self._finish(r, response=ServeResponse(
@@ -667,6 +689,7 @@ class ConsensusServer:
                     latency_s=now2 - r.enqueued_mono,
                     batch_seq=self._batch_seq,
                     model_fp=self.model.fingerprint(),
+                    trace_id=r.trace_id,
                 ), outcome="degraded" if degraded else "ok")
             if any_drift:
                 self.stats.note_drift_batch(quarantined=quarantined_n)
@@ -681,17 +704,25 @@ class ConsensusServer:
                 error: Optional[BaseException] = None,
                 outcome: str = "ok") -> None:
         """Resolve one request: stats outcome + a back-dated
-        ``serve_request`` span so every request rides the trace."""
+        ``serve_request`` span so every request rides the trace — span
+        and stats both carry the request's trace id, which is how the
+        heartbeat stream and the partial record join the wire story."""
         latency = time.monotonic() - r.enqueued_mono
-        self.stats.note_outcome(outcome, latency_s=latency)
+        self.stats.note_outcome(outcome, latency_s=latency,
+                                trace_id=r.trace_id)
         try:
             from scconsensus_tpu.obs import trace as obs_trace
 
             tr = obs_trace.last_tracer()
             if tr is not None:
+                attrs: Dict[str, Any] = dict(
+                    outcome=outcome, n_cells=r.n, req_id=r.req_id,
+                )
+                if r.trace_id:
+                    attrs["trace_id"] = r.trace_id
                 tr.add_completed_span(
                     "serve_request", wall_s=latency, kind="detail",
-                    outcome=outcome, n_cells=r.n, req_id=r.req_id,
+                    **attrs,
                 )
         except Exception:
             pass  # tracing must never cost a response
@@ -710,6 +741,10 @@ class ConsensusServer:
         entry = {
             "ts": round(time.time(), 3),
             "req_id": r.req_id,
+            # the trace id joins this ledger row to the wire response,
+            # the serve_request span, and the heartbeat stream — the
+            # postmortem bundle's key
+            "trace_id": r.trace_id,
             "n_cells": r.n,
             "drift_fraction": round(float(frac), 6),
             "threshold": round(float(self.model.drift_threshold), 6),

@@ -86,7 +86,14 @@ class ReplicaPool:
         self._pool_stats = serve_metrics.ServingStats(queue_capacity=0)
         self._retired_sections: List[Dict[str, Any]] = []
         self._retired_samples: List[List[float]] = []
+        self._retired_expo: List[Dict[str, Any]] = []
+        # replicas removed from routing but not yet banked (stop() can
+        # take seconds): the telemetry snapshot still counts them, so
+        # fleet-aggregate counters never dip and rebound mid-retire —
+        # a scraper would read the dip as a counter reset
+        self._dying: List[Replica] = []
         self._swaps: List[Dict[str, Any]] = []
+        self._kills: List[Dict[str, Any]] = []
         self._started_unix = time.time()
         first = self._load(model, readonly)
         self._models: Dict[str, ConsensusModel] = {
@@ -138,6 +145,12 @@ class ReplicaPool:
             self._closed = True
             groups = self._groups
             self._groups = {fp: [] for fp in groups}
+            # dying registration happens under the SAME lock hold that
+            # removes the replicas from routing (here and in every
+            # retire caller): a telemetry snapshot can never catch a
+            # replica in neither the live nor the retired bucket
+            for g in groups.values():
+                self._dying.extend(g)
         for group in groups.values():
             self._retire_group(group, drain=drain)
         if self._register_live:
@@ -162,11 +175,15 @@ class ReplicaPool:
 
     def submit(self, cells: np.ndarray,
                deadline_s: Optional[float] = None,
-               model_fp: Optional[str] = None) -> RequestHandle:
+               model_fp: Optional[str] = None,
+               trace_id: Optional[str] = None) -> RequestHandle:
         """Route one request to exactly one replica of the addressed
         model (default: the active fingerprint). Typed refusals:
         ServerClosed (fleet closed), RequestInvalid (unknown model),
-        plus everything the replica's own admission can raise."""
+        plus everything the replica's own admission can raise.
+        ``trace_id`` (from the wire front) rides through routing to the
+        owning replica's admission unchanged — admission must never
+        re-mint an id the front already issued."""
         from scconsensus_tpu.robust import faults
 
         faults.fault_point("fleet_route")
@@ -187,7 +204,8 @@ class ReplicaPool:
             # same lock, so a request either lands on v1 before the flip
             # (the drain serves it) or routes to v2 after — never to a
             # replica already marked for draining
-            return rep.server.submit(cells, deadline_s=deadline_s)
+            return rep.server.submit(cells, deadline_s=deadline_s,
+                                     trace_id=trace_id)
 
     @staticmethod
     def _least_depth(group: List[Replica]) -> Replica:
@@ -267,6 +285,7 @@ class ReplicaPool:
                     self._groups[new_fp] = group
                     self._models[new_fp] = new_model
                 old_group = self._groups.pop(old_fp, [])
+                self._dying.extend(old_group)
                 self._active_fp = new_fp
                 swap = {"from_fp": old_fp, "to_fp": new_fp,
                         "ts": round(time.time(), 3)}
@@ -319,9 +338,83 @@ class ReplicaPool:
                 )
             group = self._groups.pop(fp, None)
             self._models.pop(fp, None)
+            if group:
+                self._dying.extend(group)
         if group:
             self._retire_group(group, drain=True,
                                timeout_s=drain_timeout_s)
+
+    def kill_replica(self, index: Optional[int] = None,
+                     respawn: bool = True) -> Dict[str, Any]:
+        """Hard-kill one live replica of the ACTIVE model (no drain —
+        its queued requests resolve as typed ServerClosed, exactly what
+        a process death looks like one layer up) and, by default,
+        respawn a fresh replica of the same model so the fleet returns
+        to width. The killed replica's stats are banked into the
+        retired accounting — a kill loses zero requests AND zero
+        evidence — and the kill is stamped into ``fleet.kills``.
+        Returns the kill record. The soak's replica-kill plan drives
+        this; a client that retries its refused request with the SAME
+        trace id produces the two-attempts-one-trace story the
+        postmortem bundle proves."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("fleet is not accepting a kill")
+            group = self._groups.get(self._active_fp) or []
+            if not group:
+                raise ValueError("no live replica of the active model "
+                                 "to kill")
+            if index is None:
+                # default to the DEEPEST queue: a kill exists to prove
+                # queued requests refuse typed and retry clean, so aim
+                # it where the requests are
+                rep = max(group,
+                          key=lambda r: r.server.stats.queue_depth)
+            else:
+                matches = [r for r in group if r.index == int(index)]
+                if not matches:
+                    raise ValueError(
+                        f"no live replica {index!r} in the active group "
+                        f"(have {[r.index for r in group]})"
+                    )
+                rep = matches[0]
+            group.remove(rep)
+            self._dying.append(rep)
+            model = self._models[self._active_fp]
+            fp = self._active_fp
+        # stop OUTSIDE the lock, without drain: queued requests resolve
+        # typed rejected_closed on the dead replica's own stats
+        rep.server.stop(drain=False, timeout_s=5.0)
+        sec = rep.server.stats.section()
+        with self._lock:
+            self._retired_sections.append(sec)
+            self._retired_samples.append(
+                rep.server.stats.latency_samples()
+            )
+            self._retired_expo.append(rep.server.stats.expo_snapshot())
+            self._dying.remove(rep)
+        kill: Dict[str, Any] = {
+            "replica": rep.index,
+            "model_fp": fp,
+            "refused": int(sec["requests"]["rejected_closed"]),
+            "ts": round(time.time(), 3),
+        }
+        if respawn:
+            new_group = self._build_group(model, 1)
+            for nr in new_group:
+                nr.server.start()
+            with self._lock:
+                if self._closed or self._active_fp != fp:
+                    # the fleet moved on mid-respawn: the fresh replica
+                    # never routed, stop it without banking
+                    for nr in new_group:
+                        nr.server.stop(drain=False)
+                else:
+                    self._groups[fp].extend(new_group)
+                    kill["respawned"] = new_group[0].index
+        with self._lock:
+            self._kills.append(kill)
+        return kill
 
     def _retire_group(self, group: List[Replica], drain: bool,
                       timeout_s: Optional[float] = None) -> int:
@@ -337,10 +430,19 @@ class ReplicaPool:
             rep.server.stop(drain=drain, timeout_s=left)
             sec = rep.server.stats.section()
             samples = rep.server.stats.latency_samples()
+            expo = rep.server.stats.expo_snapshot()
             total += int(sec["requests"]["submitted"])
             with self._lock:
                 self._retired_sections.append(sec)
                 self._retired_samples.append(samples)
+                # histograms survive retirement too: the fleet-merged
+                # exposition/slo series must not lose a killed or
+                # swapped-out replica's observations
+                self._retired_expo.append(expo)
+                # the caller registered the group as dying under the
+                # lock that unrouted it; banking supersedes that
+                if rep in self._dying:
+                    self._dying.remove(rep)
         return total
 
     # -- introspection -----------------------------------------------------
@@ -383,6 +485,8 @@ class ReplicaPool:
             + [self._pool_stats.latency_samples()],
             window_s=time.time() - self._started_unix,
         )
+        with self._lock:
+            kills = [dict(k) for k in self._kills]
         sec["fleet"] = {
             # configured fleet width — the replica-keyed baseline key (a
             # workload property, stable across stop/drain)...
@@ -393,6 +497,7 @@ class ReplicaPool:
             "active_fp": active,
             "models": models,
             "swaps": swaps,
+            "kills": kills,
             "submitted_by_owner": {
                 "replicas": sum(s["requests"]["submitted"]
                                 for s in live_secs),
@@ -416,35 +521,189 @@ class ReplicaPool:
         }
         return sec
 
+    # -- the shared telemetry snapshot (round 20) --------------------------
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        """One internally consistent fleet telemetry snapshot, taken
+        UNDER the admission/swap lock: the replica table and every
+        per-replica stats snapshot are read while no hot-swap cutover
+        (or kill/respawn) can flip the groups mid-read. Both consumers
+        — the ``/metrics`` OpenMetrics exposition and the JSON
+        ``live_summary`` panel — assemble from THIS one structure, so
+        the two can never disagree on per-replica keys while a swap is
+        in flight (the pre-r20 exposition read the replica list under
+        the lock but the stats after releasing it — torn exactly when
+        a scrape races a cutover)."""
+        with self._lock:
+            live = [r for g in self._groups.values() for r in g]
+            reps = [{
+                "replica": rep.index,
+                "model_fp": rep.model_fp,
+                "expo": rep.server.stats.expo_snapshot(),
+                "lat": rep.server.stats.latency_ms(),
+                "samples": rep.server.stats.latency_samples(),
+            } for rep in live]
+            # mid-retire replicas (removed from routing, stop() still
+            # running) count as already-retired evidence: aggregate
+            # counters stay monotonic through a kill or swap
+            dying_expo = [r.server.stats.expo_snapshot()
+                          for r in self._dying]
+            dying_samples = [r.server.stats.latency_samples()
+                             for r in self._dying]
+            return {
+                "active_fp": self._active_fp,
+                "replicas": reps,
+                "retired_expo": [dict(e) for e in self._retired_expo]
+                + dying_expo,
+                "retired_samples": [list(s)
+                                    for s in self._retired_samples]
+                + dying_samples,
+                "pool_expo": self._pool_stats.expo_snapshot(),
+                "kills": [dict(k) for k in self._kills],
+            }
+
+    def expo_scopes(self, snap: Optional[Dict[str, Any]] = None
+                    ) -> List[Dict[str, Any]]:
+        """Exposition scopes for ``serve.slo.render_openmetrics``: one
+        per live replica plus the ``replica="fleet"`` aggregate whose
+        counters are exact sums (live + retired + pool boundary) and
+        whose histograms are per-bucket merges — mergeable by the frozen
+        bucket grid."""
+        from scconsensus_tpu.serve import slo as serve_slo
+
+        snap = snap or self.telemetry_snapshot()
+        scopes: List[Dict[str, Any]] = []
+        for r in snap["replicas"]:
+            e = r["expo"]
+            scopes.append({
+                "labels": {"replica": str(r["replica"]),
+                           "model": r["model_fp"][:8]},
+                "counts": e["counts"],
+                "queue_depth": e["queue_depth"],
+                "queue_cap": e["queue_cap"],
+                "breaker": e["breaker"],
+                "trips": e["trips"],
+                "latency_hist": e["latency_hist"],
+                "stage_hist": e["stage_hist"],
+            })
+        all_expo = ([r["expo"] for r in snap["replicas"]]
+                    + snap["retired_expo"] + [snap["pool_expo"]])
+        counts: Dict[str, int] = {o: 0 for o in serve_metrics.OUTCOMES}
+        for e in all_expo:
+            for o in serve_metrics.OUTCOMES:
+                counts[o] += int((e.get("counts") or {}).get(o, 0))
+        lat_hist = {
+            o: serve_slo.merge_histogram_dicts([
+                (e.get("latency_hist") or {}).get(o)
+                or serve_slo.LatencyHistogram().to_dict()
+                for e in all_expo
+            ]) for o in serve_metrics.OUTCOMES
+        }
+        stage_hist = {
+            s: serve_slo.merge_histogram_dicts([
+                (e.get("stage_hist") or {}).get(s)
+                or serve_slo.LatencyHistogram().to_dict()
+                for e in all_expo
+            ]) for s in serve_metrics.STAGE_HIST_STAGES
+        }
+        live_expo = [r["expo"] for r in snap["replicas"]]
+        worst = "closed"
+        for e in live_expo:
+            if (_BREAKER_RANK.get(e["breaker"], 0)
+                    > _BREAKER_RANK[worst]):
+                worst = e["breaker"]
+        scopes.append({
+            "labels": {"replica": "fleet"},
+            "counts": counts,
+            "queue_depth": sum(e["queue_depth"] for e in live_expo),
+            "queue_cap": sum(e["queue_cap"] for e in live_expo),
+            "breaker": worst,
+            "trips": sum(e["trips"] for e in all_expo),
+            "latency_hist": lat_hist,
+            "stage_hist": stage_hist,
+        })
+        return scopes
+
+    def slo_section(self, snap: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+        """The fleet-level validated ``slo`` run-record section:
+        availability over the SAME cumulative counters the accounting
+        rule validates (live + retired + pool boundary — a killed
+        replica's refusals still burn the budget), p99 from the merged
+        raw sample rings, burn windows from the live replicas' + pool
+        boundary's summed window deltas."""
+        from scconsensus_tpu.serve import slo as serve_slo
+
+        snap = snap or self.telemetry_snapshot()
+        scopes = self.expo_scopes(snap)
+        fleet = scopes[-1]
+        # retired/killed replicas' raw samples stay in the gated tail:
+        # a kill must lose zero latency evidence, or the record's p99
+        # understates exactly the incident it should report
+        merged = [ms for r in snap["replicas"] for ms in r["samples"]]
+        for samples in snap.get("retired_samples") or []:
+            merged.extend(samples)
+        p99 = serve_slo.p99_ms(merged)
+        # live + RETIRED trackers both burn: a killed replica's typed
+        # refusals must show in the burn windows, not just availability
+        live_deltas = ([r["expo"]["window_deltas"]
+                        for r in snap["replicas"]]
+                       + [e.get("window_deltas") or []
+                          for e in snap.get("retired_expo") or []]
+                       + [snap["pool_expo"]["window_deltas"]])
+        # window order follows the trackers' declared objectives order
+        # (first-seen), NOT numeric sort: validate_slo pins burn_rates
+        # positionally against objectives.windows_s
+        order: List[float] = []
+        windows: Dict[float, Dict[str, int]] = {}
+        for deltas in live_deltas:
+            for wd in deltas:
+                w = float(wd["window_s"])
+                agg = windows.get(w)
+                if agg is None:
+                    agg = windows[w] = {"bad": 0, "total": 0}
+                    order.append(w)
+                agg["bad"] += int(wd["bad"])
+                agg["total"] += int(wd["total"])
+        window_deltas = [
+            {"window_s": w, **windows[w]} for w in order
+        ]
+        return serve_slo.build_slo_section(
+            fleet["counts"], p99, window_deltas,
+            latency_hist=fleet["latency_hist"],
+            stage_hist=fleet["stage_hist"],
+            obs_overhead=serve_slo.obs_overhead(),
+        )
+
     def _live_summary(self) -> Dict[str, Any]:
         """One heartbeat tick (``serve.metrics.live_summary`` delegates
         here while the pool is registered): aggregated vitals plus the
-        per-replica fleet panel tail_run renders."""
-        with self._lock:
-            live = [r for g in self._groups.values() for r in g]
-            active = self._active_fp
+        per-replica fleet panel tail_run renders — assembled from the
+        same swap-lock snapshot the exposition reads."""
+        from scconsensus_tpu.serve import slo as serve_slo
+
+        snap = self.telemetry_snapshot()
         out: Dict[str, Any] = {"queue_depth": 0, "queue_cap": 0,
                                "breaker": "closed", "ok": 0}
         agg: Dict[str, int] = {}
         trips_total = 0
         merged: List[float] = []
         reps: List[Dict[str, Any]] = []
-        for rep in live:
-            st = rep.server.stats
-            lat = st.latency_ms()
-            with st._lock:
-                depth = st.queue_depth
-                cap = st.queue_capacity
-                counts = dict(st.counts)
-                bstate = st.breaker_state
-                trips = st.breaker_trips
-            out["queue_depth"] += depth
-            out["queue_cap"] += cap
+        recent: List[Dict[str, Any]] = []
+        hist_src: Dict[str, List[Dict[str, Any]]] = {}
+        counts_sum: Dict[str, int] = {o: 0
+                                      for o in serve_metrics.OUTCOMES}
+        window_order: List[float] = []
+        window_sum: Dict[float, Dict[str, int]] = {}
+        for r in snap["replicas"]:
+            e = r["expo"]
+            counts = e["counts"]
+            out["queue_depth"] += e["queue_depth"]
+            out["queue_cap"] += e["queue_cap"]
             out["ok"] += counts["ok"]
-            if (_BREAKER_RANK.get(bstate, 0)
+            if (_BREAKER_RANK.get(e["breaker"], 0)
                     > _BREAKER_RANK[out["breaker"]]):
-                out["breaker"] = bstate
-            trips_total += trips
+                out["breaker"] = e["breaker"]
+            trips_total += e["trips"]
             for key in ("degraded", "quarantined", "deadline_exceeded",
                         "failed"):
                 agg[key] = agg.get(key, 0) + counts[key]
@@ -452,26 +711,57 @@ class ReplicaPool:
                                + counts["rejected_queue"]
                                + counts["rejected_invalid"]
                                + counts["rejected_closed"])
-            merged.extend(st.latency_samples())
+            merged.extend(r["samples"])
+            recent.extend(e.get("recent") or [])
+            for o in serve_metrics.OUTCOMES:
+                counts_sum[o] += int(counts.get(o, 0))
+                h = (e.get("latency_hist") or {}).get(o)
+                if h and h.get("count"):
+                    hist_src.setdefault(o, []).append(h)
+            for wd in e.get("window_deltas") or []:
+                w = float(wd["window_s"])
+                a = window_sum.get(w)
+                if a is None:
+                    a = window_sum[w] = {"bad": 0, "total": 0}
+                    window_order.append(w)
+                a["bad"] += int(wd["bad"])
+                a["total"] += int(wd["total"])
             entry: Dict[str, Any] = {
-                "replica": rep.index,
-                "model_fp": rep.model_fp[:8],
-                "queue_depth": depth,
-                "breaker": bstate,
+                "replica": r["replica"],
+                "model_fp": r["model_fp"][:8],
+                "queue_depth": e["queue_depth"],
+                "breaker": e["breaker"],
             }
-            if trips:
-                entry["trips"] = trips
-            if lat.get("p99") is not None:
-                entry["p99_ms"] = lat["p99"]
+            if e["trips"]:
+                entry["trips"] = e["trips"]
+            if r["lat"].get("p99") is not None:
+                entry["p99_ms"] = r["lat"]["p99"]
             reps.append(entry)
         for key, v in agg.items():
             if v:
                 out[key] = v
         if trips_total:
             out["breaker_trips"] = trips_total
-        if merged:
-            s = sorted(merged)
-            out["p99_ms"] = round(s[min(int(0.99 * len(s)),
-                                        len(s) - 1)], 4)
-        out["fleet"] = {"active_fp": active[:8], "replicas": reps}
+        p99 = serve_slo.p99_ms(merged)
+        if p99 is not None:
+            out["p99_ms"] = round(p99, 4)
+        av = serve_slo.classify_counts(counts_sum)
+        out["slo"] = serve_metrics.slo_summary(av, [
+            {"window_s": w, **window_sum[w]} for w in window_order
+        ])
+        # panel histograms through the ONE merge implementation (the
+        # exposition's), reshaped to the heartbeat's compact {n,
+        # buckets} form
+        hist = {
+            o: {"n": m["count"], "buckets": list(m["buckets"])}
+            for o, m in ((o, serve_slo.merge_histogram_dicts(hs))
+                         for o, hs in hist_src.items())
+        }
+        if hist:
+            out["lat_hist"] = hist
+        if recent:
+            recent.sort(key=lambda x: x.get("ts") or 0)
+            out["recent"] = recent[-8:]
+        out["fleet"] = {"active_fp": snap["active_fp"][:8],
+                        "replicas": reps}
         return out

@@ -18,6 +18,19 @@ writes one summary JSON. The exit code IS the chaos contract:
   1  the contract broke (a request vanished, validation failed, a
      response crossed models).
 
+Round 20 (the telemetry plane's proving ground): ``--kill-after K``
+hard-kills one replica (no drain) once K requests resolved — its queued
+requests resolve typed ``rejected_closed`` and the pumps RETRY them with
+the SAME trace id (``X-SCC-Trace-Id``), so the summary's per-attempt
+evidence shows both attempts under one trace and the postmortem bundle
+(tools/postmortem.py) can prove trace continuity across the kill →
+respawn → retry arc. ``--heartbeat S`` arms an obs.live flight recorder
+over the soak (heartbeat stream + partial record — the bundle's other
+inputs), and the quarantine ledger lands under ``DIR/ledger`` so its
+rows are trace-joinable too. ``--obs-overhead M`` measures the plane's
+own cost (median wire latency over M requests, tracing+scrapes on vs
+off) and stamps the gauge onto the record's validated ``slo`` section.
+
 Because the atlas build, the request set, and classify are all seeded,
 the per-request labels are a pure function of (model, request): the
 ``replay-across-replicas`` chaos plan runs the same set through 1 and N
@@ -139,36 +152,102 @@ def make_query_batches(n_requests: int, cells_per: int, seed: int,
 # the soak
 # --------------------------------------------------------------------------
 
-def _fast_cfg(deadline_s: Optional[float], ledger_dir: Optional[str]):
+def _fast_cfg(deadline_s: Optional[float], ledger_dir: Optional[str],
+              batch_window_s: float = 0.001):
     from scconsensus_tpu.serve.driver import ServeConfig
 
     return ServeConfig(
-        batch_window_s=0.001,
+        batch_window_s=batch_window_s,
         default_deadline_s=deadline_s,
         ledger_dir=ledger_dir,
     )
+
+
+def _measure_overhead(port: int, batch: np.ndarray, m: int,
+                      concurrency: int = 4) -> Dict[str, Any]:
+    """The plane accounting for itself: mean per-request WALL over a
+    concurrent burst of ``m`` identical requests with the telemetry
+    plane ON (trace minting + one /metrics scrape per ~8 requests — the
+    always-on cost profile) vs OFF (SCC_OBS_TRACE=0, no scrapes). A
+    burst, not sequential pings: sequential latency phase-locks with
+    the batch window (bimodal by ± one window), while burst throughput
+    amortizes batching and isolates the plane's own cost. Returns the
+    gauge dict the ``slo`` section carries; BASELINE.md pins the
+    ratio's noise band."""
+    import http.client
+
+    body = json.dumps({"cells": batch.tolist()})
+
+    def _pump_n(n: int, scrape: bool) -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        for i in range(n):
+            conn.request("POST", "/classify", body=body,
+                         headers={"Content-Type": "application/json"})
+            conn.getresponse().read()
+            if scrape and i % 16 == 0:
+                conn.request("GET", "/metrics")
+                conn.getresponse().read()
+        conn.close()
+
+    def _run(scrape: bool) -> float:
+        _pump_n(2, scrape=False)  # settle caches outside the clock
+        per = max(m // concurrency, 1)
+        threads = [threading.Thread(target=_pump_n,
+                                    args=(per, scrape), daemon=True)
+                   for _ in range(concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        return (time.perf_counter() - t0) * 1e3 / (per * concurrency)
+
+    prev = os.environ.get("SCC_OBS_TRACE")
+    try:
+        os.environ["SCC_OBS_TRACE"] = "0"
+        off_ms = _run(scrape=False)
+        os.environ["SCC_OBS_TRACE"] = "1"
+        on_ms = _run(scrape=True)
+    finally:
+        if prev is None:
+            os.environ.pop("SCC_OBS_TRACE", None)
+        else:
+            os.environ["SCC_OBS_TRACE"] = prev
+    return {"on_ms": round(on_ms, 4), "off_ms": round(off_ms, 4),
+            "ratio": round(on_ms / off_ms, 4) if off_ms else None,
+            "n": int(m)}
 
 
 def run_fleet_soak(workdir: str, n_requests: int = 24,
                    cells_per: int = 16, seed: int = 7,
                    replicas: Optional[int] = None,
                    swap_after: Optional[int] = None,
+                   kill_after: Optional[int] = None,
                    n_ood: int = 0, n_genes: int = 120,
                    n_clusters: int = 4, n_train: int = 360,
                    fresh: bool = False, concurrency: int = 4,
-                   deadline_s: Optional[float] = None) -> Dict[str, Any]:
+                   deadline_s: Optional[float] = None,
+                   heartbeat_s: Optional[float] = None,
+                   obs_overhead_requests: int = 0,
+                   batch_window_s: float = 0.001) -> Dict[str, Any]:
     """Drive the request set through the wire front over a replica pool;
     returns the summary dict (see module doc). With ``swap_after``, the
     fleet hot-swaps to the v2 model once that many requests have
-    resolved — mid-traffic, while the pumps keep pumping."""
+    resolved — mid-traffic, while the pumps keep pumping. With
+    ``kill_after``, one replica is hard-killed (and respawned) once that
+    many requests have resolved; refused requests are retried with the
+    SAME trace id."""
     import http.client
 
+    from scconsensus_tpu.obs import trace as obs_trace
     from scconsensus_tpu.obs.export import (
         build_run_record,
         validate_run_record,
     )
+    from scconsensus_tpu.obs.live import LiveRecorder
+    from scconsensus_tpu.serve import slo as serve_slo
     from scconsensus_tpu.serve.fleet.pool import ReplicaPool
-    from scconsensus_tpu.serve.fleet.wire import WireFront
+    from scconsensus_tpu.serve.fleet.wire import TRACE_HEADER, WireFront
     from scconsensus_tpu.serve.model import MODEL_STAGE
     from scconsensus_tpu.utils.artifacts import ArtifactStore
 
@@ -189,9 +268,11 @@ def run_fleet_soak(workdir: str, n_requests: int = 24,
                                   n_genes=n_genes, n_clusters=n_clusters,
                                   n_ood=n_ood)
     outcomes: List[Optional[Dict[str, Any]]] = [None] * len(requests)
+    attempts: List[Dict[str, Any]] = []
     label_blobs: List[bytes] = [b""] * len(requests)
     resolved = [0]
     swap_state: Dict[str, Any] = {"done": False, "to_fp": None}
+    kill_state: Dict[str, Any] = {"done": False, "kills": []}
     lock = threading.Lock()
     next_i = [0]
     # swap mode reserves a TAIL of the request set until the cutover
@@ -202,11 +283,29 @@ def run_fleet_soak(workdir: str, n_requests: int = 24,
                      len(requests) - max(len(requests) // 3, 2))
                  if swap_after is not None else None)
 
+    # flight recorder over the soak (round 20): the tracer catches each
+    # replica's serve_request spans (trace ids included), the recorder
+    # streams heartbeats whose serving panel carries the recent-trace
+    # ring — the postmortem bundle's per-process inputs. Ledger rows
+    # land under DIR/ledger, trace-keyed.
+    tracer = obs_trace.Tracer(sync="off")
+    recorder = LiveRecorder(
+        os.path.join(workdir, "FLEET_SOAK"),
+        metric="fleet soak flight record",
+        extra={"config": "fleet-soak", "platform": "cpu"},
+        heartbeat_s=heartbeat_s,
+    )
+    recorder.start(install_signals=False)
+    ledger_dir = os.path.join(workdir, "ledger")
+
     pool = ReplicaPool(v1_dir, n_replicas=replicas,
-                       config=_fast_cfg(deadline_s, None))
+                       config=_fast_cfg(deadline_s, ledger_dir,
+                                        batch_window_s=batch_window_s))
     fp1 = pool.active_fingerprint()
     front = WireFront(pool)
-    with pool, front:
+    obs_overhead: Optional[Dict[str, Any]] = None
+    try:
+      with pool, front:
         port = front.port
 
         def _pump():
@@ -226,33 +325,62 @@ def run_fleet_soak(workdir: str, n_requests: int = 24,
                 if i is None:
                     time.sleep(0.002)
                     continue
-                post_swap = bool(swap_state["done"])
                 body = json.dumps({"cells": requests[i].tolist()})
-                try:
-                    conn.request("POST", "/classify", body=body,
-                                 headers={"Content-Type":
-                                          "application/json"})
-                    r = conn.getresponse()
-                    doc = json.loads(r.read())
-                    outcomes[i] = {
-                        "i": i, "status": r.status,
-                        "outcome": doc.get("outcome"),
-                        "model_fp": doc.get("model_fp"),
-                        "post_swap": post_swap,
-                    }
-                    if doc.get("labels") is not None:
-                        label_blobs[i] = np.asarray(
-                            doc["labels"], np.int64
-                        ).tobytes()
-                except (OSError, http.client.HTTPException,
-                        json.JSONDecodeError) as e:
-                    outcomes[i] = {"i": i, "status": None,
-                                   "outcome": "wire-error",
-                                   "error": str(e)[:200],
-                                   "post_swap": post_swap}
-                    conn.close()
-                    conn = http.client.HTTPConnection("127.0.0.1", port,
-                                                      timeout=60)
+                trace_id: Optional[str] = None
+                attempt = 0
+                while True:
+                    attempt += 1
+                    post_swap = bool(swap_state["done"])
+                    headers = {"Content-Type": "application/json"}
+                    if trace_id:
+                        # the retry carries the SAME id: both attempts
+                        # tell one story under one trace
+                        headers[TRACE_HEADER] = trace_id
+                    try:
+                        conn.request("POST", "/classify", body=body,
+                                     headers=headers)
+                        r = conn.getresponse()
+                        doc = json.loads(r.read())
+                        tid = (doc.get("trace_id")
+                               or r.getheader(TRACE_HEADER))
+                        out = {
+                            "i": i, "status": r.status,
+                            "outcome": doc.get("outcome"),
+                            "model_fp": doc.get("model_fp"),
+                            "post_swap": post_swap,
+                            "trace_id": tid,
+                            "attempt": attempt,
+                            "ts": round(time.time(), 3),
+                        }
+                        if doc.get("labels") is not None:
+                            label_blobs[i] = np.asarray(
+                                doc["labels"], np.int64
+                            ).tobytes()
+                    except (OSError, http.client.HTTPException,
+                            json.JSONDecodeError) as e:
+                        out = {"i": i, "status": None,
+                               "outcome": "wire-error",
+                               "error": str(e)[:200],
+                               "post_swap": post_swap,
+                               "trace_id": trace_id,
+                               "attempt": attempt,
+                               "ts": round(time.time(), 3)}
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", port, timeout=60)
+                    with lock:
+                        attempts.append(out)
+                    trace_id = out.get("trace_id") or trace_id
+                    if (kill_after is not None and attempt < 5
+                            and out["outcome"] in ("rejected_queue",
+                                                   "rejected_closed")):
+                        # a kill-refused request is resubmitted under
+                        # its original trace id — the respawned replica
+                        # serves attempt 2
+                        time.sleep(0.05)
+                        continue
+                    outcomes[i] = out
+                    break
                 with lock:
                     resolved[0] += 1
 
@@ -271,16 +399,56 @@ def run_fleet_soak(workdir: str, n_requests: int = 24,
             to_fp = pool.hot_swap(v2_dir)
             swap_state["to_fp"] = to_fp
             swap_state["done"] = True
+        if kill_after is not None:
+            # hard-kill one replica mid-traffic (no drain: its queued
+            # requests refuse typed and the pumps retry them). Up to 3
+            # kills until one actually catches queued requests — each
+            # kill respawns, so the fleet is back at width either way.
+            gate = min(int(kill_after), len(requests) - 1)
+            while True:
+                with lock:
+                    if resolved[0] >= gate:
+                        break
+                time.sleep(0.002)
+            for _ in range(3):
+                kill = pool.kill_replica()
+                kill_state["kills"].append(kill)
+                with lock:
+                    remaining = len(requests) - resolved[0]
+                if kill["refused"] or remaining <= 2:
+                    break
+                time.sleep(0.01)
+            kill_state["done"] = True
         for t in threads:
             t.join(timeout=180.0)
+        # sections FIRST: the record's p99/availability/burn describe
+        # the soak under test, not the synthetic overhead burst (which
+        # also toggles tracing off for half its requests)
         section = front.serving_section()
+        slo_section = front.slo_section()
+        if obs_overhead_requests > 0:
+            obs_overhead = _measure_overhead(port, requests[0],
+                                             obs_overhead_requests)
+            serve_slo.set_obs_overhead(obs_overhead)
+            slo_section["obs_overhead"] = dict(obs_overhead)
+    except BaseException:
+        # the postmortem's own input must not lie: a soak that died
+        # mid-run leaves a crash-stamped partial, never a clean one
+        recorder.stop("crash")
+        serve_slo.set_obs_overhead(None)
+        raise
+    else:
+        recorder.stop("clean")
+        serve_slo.set_obs_overhead(None)
 
     rec = build_run_record(
         metric="fleet soak wire p99 latency",
         value=(section.get("latency_ms") or {}).get("p99"),
         unit="ms",
         extra={"config": "fleet-soak", "platform": "cpu"},
+        spans=tracer.live_span_records(),
         serving=section,
+        slo=slo_section,
     )
     accounting_ok = True
     try:
@@ -301,11 +469,38 @@ def run_fleet_soak(workdir: str, n_requests: int = 24,
     counts: Dict[str, int] = {}
     for o in done:
         counts[str(o["outcome"])] = counts.get(str(o["outcome"]), 0) + 1
+    # trace evidence (round 20): every attempt carries a trace id; a
+    # request that took >1 attempt must have kept ONE id across them —
+    # the continuity contract the postmortem bundle proves end to end
+    by_req: Dict[int, List[Dict[str, Any]]] = {}
+    for a in attempts:
+        by_req.setdefault(int(a["i"]), []).append(a)
+    retried = {
+        i: [{"attempt": a["attempt"], "outcome": a["outcome"],
+             "status": a["status"], "trace_id": a["trace_id"],
+             "ts": a["ts"]} for a in sorted(atts,
+                                            key=lambda x: x["attempt"])]
+        for i, atts in by_req.items() if len(atts) > 1
+    }
+    trace_continuity = all(
+        len({a["trace_id"] for a in atts if a["trace_id"]}) == 1
+        for atts in retried.values()
+    ) if retried else None
+    traced = [o for o in done if o.get("trace_id")]
     ok = (len(done) == len(requests)
           and accounting_ok
           and not any(o["outcome"] == "wire-error" for o in done)
-          and (post_swap_pure is not False))
-    return {
+          and (post_swap_pure is not False)
+          and (trace_continuity is not False))
+    if kill_after is not None:
+        # the kill contract: the kill landed, the fleet respawned back
+        # to width, and every request STILL ended served (retries
+        # rescued the refused ones) — zero lost requests across a
+        # replica death
+        ok = (ok and kill_state["done"]
+              and all(o["outcome"] in ("ok", "degraded", "quarantined")
+                      for o in done))
+    summary: Dict[str, Any] = {
         "ok": ok,
         "requests": len(requests),
         "resolved": len(done),
@@ -320,9 +515,22 @@ def run_fleet_soak(workdir: str, n_requests: int = 24,
         "labels_sha": h.hexdigest(),
         "outcome_counts": counts,
         "accounting_ok": accounting_ok,
+        "traced_responses": len(traced),
+        "trace_continuity": trace_continuity,
+        "retried": retried,
+        "kills": list(kill_state["kills"]),
+        "spans_done": len(tracer.spans),
         "outcomes": done,
+        "attempts": attempts,
         "record": rec,
     }
+    if obs_overhead is not None:
+        summary["obs_overhead"] = obs_overhead
+    if recorder.enabled:
+        summary["heartbeat_stream"] = os.path.basename(recorder.hb_path)
+        summary["partial_record"] = os.path.basename(
+            recorder.partial_path)
+    return summary
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -335,6 +543,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--swap-after", type=int, default=None,
                     help="hot-swap to the v2 model once this many "
                          "requests resolved (mid-traffic)")
+    ap.add_argument("--kill-after", type=int, default=None,
+                    help="hard-kill (and respawn) one replica once this "
+                         "many requests resolved; refused requests are "
+                         "retried under their original trace id")
+    ap.add_argument("--heartbeat", type=float, default=None,
+                    help="flight-recorder heartbeat cadence in seconds "
+                         "(default: SCC_OBS_HEARTBEAT; 0 disables)")
+    ap.add_argument("--obs-overhead", type=int, default=0,
+                    help="measure the telemetry plane's own cost over "
+                         "this many extra requests (plane on vs off) "
+                         "and stamp the gauge onto the slo section")
+    ap.add_argument("--window", type=float, default=0.001,
+                    help="replica batch window (s)")
+    ap.add_argument("--concurrency", type=int, default=4,
+                    help="client pump threads")
     ap.add_argument("--ood-requests", type=int, default=0)
     ap.add_argument("--genes", type=int, default=120)
     ap.add_argument("--clusters", type=int, default=4)
@@ -350,9 +573,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     summary = run_fleet_soak(
         args.dir, n_requests=args.requests, cells_per=args.cells,
         seed=args.seed, replicas=args.replicas,
-        swap_after=args.swap_after, n_ood=args.ood_requests,
+        swap_after=args.swap_after, kill_after=args.kill_after,
+        n_ood=args.ood_requests,
         n_genes=args.genes, n_clusters=args.clusters, n_train=args.train,
-        fresh=args.fresh, deadline_s=args.deadline,
+        fresh=args.fresh, concurrency=args.concurrency,
+        deadline_s=args.deadline,
+        heartbeat_s=args.heartbeat,
+        obs_overhead_requests=args.obs_overhead,
+        batch_window_s=args.window,
     )
     with open(summary_path, "w") as f:
         json.dump(summary, f, indent=1, default=str)
@@ -363,6 +591,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         "replicas": summary["replicas"],
         "swapped": summary["swapped"],
         "post_swap_pure": summary["post_swap_pure"],
+        "kills": len(summary["kills"]),
+        "retried": len(summary["retried"]),
+        "trace_continuity": summary["trace_continuity"],
         "outcome_counts": summary["outcome_counts"],
         "labels_sha": summary["labels_sha"][:16],
     }))

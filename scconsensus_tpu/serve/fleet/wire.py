@@ -25,9 +25,19 @@ record's ``serving.wire`` subsection is validated):
     ==================  ======  =======================================
 
 ``GET /healthz`` answers 200 while the backend accepts traffic and 503
-once it is closed/unhealthy; ``GET /metrics`` returns the live summary
+once it is closed/unhealthy; ``GET /metrics`` returns the OpenMetrics
+text exposition (round 20: per-outcome counters, per-stage fixed-bucket
+latency histograms, queue/breaker gauges — per replica and
+fleet-aggregated from ONE swap-lock snapshot, plus the wire counters and
+the live SLO); ``GET /metrics.json`` keeps the pre-r20 JSON live summary
 (``serve.metrics.live_summary`` — the same feed the heartbeat panel
 reads, fleet panel included).
+
+Every classify response (success or typed refusal) carries the request's
+trace id in ``X-SCC-Trace-Id`` and the JSON body: minted here at the
+front (``SCC_OBS_TRACE``), or adopted from the client's header — which
+is how a retried request keeps its id and the postmortem bundle shows
+both attempts under one trace.
 
 ``POST /classify`` accepts two bodies:
 
@@ -51,7 +61,9 @@ from __future__ import annotations
 import io
 import json
 import math
+import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
@@ -59,6 +71,7 @@ import numpy as np
 
 from scconsensus_tpu.config import env_flag
 from scconsensus_tpu.serve import metrics as serve_metrics
+from scconsensus_tpu.serve import slo as serve_slo
 from scconsensus_tpu.serve.driver import ServeResponse
 from scconsensus_tpu.serve.errors import (
     DeadlineExceeded,
@@ -68,19 +81,33 @@ from scconsensus_tpu.serve.errors import (
     ServerClosed,
 )
 
-__all__ = ["OUTCOME_STATUS", "WireFront"]
+__all__ = ["OUTCOME_STATUS", "TRACE_HEADER", "WireFront"]
 
 # THE mapping (BASELINE.md "Fleet policy"): one outcome, one status code.
-OUTCOME_STATUS: Dict[str, int] = {
-    "ok": 200,
-    "degraded": 200,
-    "quarantined": 409,
-    "rejected_queue": 429,
-    "rejected_invalid": 422,
-    "rejected_closed": 503,
-    "deadline_exceeded": 504,
-    "failed": 500,
-}
+# One copy, owned by serve.slo so the exposition and the availability
+# classification can never drift from the wire's table (re-exported here
+# because this is where callers historically import it from).
+OUTCOME_STATUS: Dict[str, int] = serve_slo.OUTCOME_STATUS
+
+# The trace-id header, both directions: a client (or a retrying client —
+# the resubmit keeps its id) sends it; every response echoes the id that
+# actually traced the request.
+TRACE_HEADER = "X-SCC-Trace-Id"
+
+# Adopted (client-supplied) ids must look like ids: bounded length,
+# header-safe charset. The id is echoed into a response header and
+# appended to the shared quarantine ledger / heartbeat ring, so an
+# unvalidated value would let one client split responses (CRLF) or
+# bloat cross-request evidence. Anything else is ignored and a fresh
+# id is minted.
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
+
+
+def _clean_trace_id(raw) -> Optional[str]:
+    if not raw:
+        return None
+    raw = str(raw).strip()
+    return raw if _TRACE_ID_RE.match(raw) else None
 
 # Extra margin past the request deadline before the wire gives up on the
 # handle: the backend resolves typed DeadlineExceeded itself; this only
@@ -151,19 +178,83 @@ class WireFront:
         sec["wire"] = self.wire_stats.section()
         return sec
 
+    def slo_section(self, snap: Optional[Dict[str, Any]] = None,
+                    wire_expo: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+        """The validated ``slo`` run-record section, anchored at the
+        wire: availability and burn windows over the WIRE outcome
+        counters (the one stream every fleet request passes), end-to-end
+        per-outcome latency histograms from the wire's observations,
+        per-stage histograms from the backend's merged replicas, p99
+        from the backend's merged raw sample rings. ``snap``/
+        ``wire_expo`` let telemetry_text build counters, gauges, and
+        SLO from the SAME instant."""
+        we = wire_expo or self.wire_stats.expo_snapshot()
+        b = self.backend
+        stage_hist = None
+        p99 = None
+        if hasattr(b, "telemetry_snapshot"):
+            snap = snap or b.telemetry_snapshot()
+            merged = [ms for r in snap["replicas"] for ms in r["samples"]]
+            for samples in snap.get("retired_samples") or []:
+                # killed/swapped-out replicas' tails stay in the gated
+                # p99 — retirement must lose zero latency evidence
+                merged.extend(samples)
+            p99 = serve_slo.p99_ms(merged)
+            stage_hist = b.expo_scopes(snap)[-1]["stage_hist"]
+        else:
+            p99 = b.stats.latency_ms().get("p99")
+            stage_hist = b.stats.expo_snapshot()["stage_hist"]
+        return serve_slo.build_slo_section(
+            we["counts"], p99, we["window_deltas"],
+            latency_hist=we["latency_hist"],
+            stage_hist=stage_hist,
+            obs_overhead=serve_slo.obs_overhead(),
+        )
+
+    def telemetry_text(self) -> str:
+        """The OpenMetrics exposition, assembled from ONE backend
+        telemetry snapshot (taken under the pool's swap lock) and ONE
+        wire snapshot, both shared with the SLO gauges — a scrape
+        racing a hot-swap can never see a torn replica table, and a
+        scrape's SLO gauges can never disagree with its own counters."""
+        b = self.backend
+        we = self.wire_stats.expo_snapshot()
+        snap = None
+        if hasattr(b, "telemetry_snapshot"):
+            snap = b.telemetry_snapshot()
+            scopes = b.expo_scopes(snap)
+        else:
+            e = b.stats.expo_snapshot()
+            scope = {
+                "labels": {"replica": "0",
+                           "model": b.model.fingerprint()[:8]},
+                "counts": e["counts"], "queue_depth": e["queue_depth"],
+                "queue_cap": e["queue_cap"], "breaker": e["breaker"],
+                "trips": e["trips"], "latency_hist": e["latency_hist"],
+                "stage_hist": e["stage_hist"],
+            }
+            scopes = [scope, {**scope, "labels": {"replica": "fleet"}}]
+        return serve_slo.render_openmetrics({
+            "scopes": scopes,
+            "wire": we,
+            "slo": self.slo_section(snap=snap, wire_expo=we),
+        })
+
     # -- backend adapter ---------------------------------------------------
     def _submit(self, cells: np.ndarray, deadline_s: Optional[float],
-                model_fp: Optional[str]):
+                model_fp: Optional[str],
+                trace_id: Optional[str] = None):
         b = self.backend
         if hasattr(b, "hot_swap"):  # a ReplicaPool routes by fingerprint
             return b.submit(cells, deadline_s=deadline_s,
-                            model_fp=model_fp)
+                            model_fp=model_fp, trace_id=trace_id)
         if model_fp and model_fp != b.model.fingerprint():
             raise RequestInvalid(
                 f"this server holds model {b.model.fingerprint()!r}, "
                 f"not {model_fp!r}"
             )
-        return b.submit(cells, deadline_s=deadline_s)
+        return b.submit(cells, deadline_s=deadline_s, trace_id=trace_id)
 
 
 def _parse_deadline(dl) -> Optional[float]:
@@ -189,6 +280,7 @@ def _response_body(resp: ServeResponse) -> Dict[str, Any]:
         "drift_fraction": round(float(resp.drift_fraction), 6),
         "latency_s": round(float(resp.latency_s), 6),
         "model_fp": resp.model_fp,
+        "trace_id": resp.trace_id,
     }
 
 
@@ -218,6 +310,17 @@ class _WireHandler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):
             pass  # client gone; the outcome is already accounted
 
+    def _send_text(self, status: int, text: str, ctype: str) -> None:
+        payload = text.encode("utf-8")
+        self.send_response(int(status))
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        try:
+            self.wfile.write(payload)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
     # -- GET: health + metrics ---------------------------------------------
     def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
         path = self.path.split("?")[0]
@@ -229,6 +332,21 @@ class _WireHandler(BaseHTTPRequestHandler):
                     "queue_depth": live.get("queue_depth")}
             self._send_json(503 if closed else 200, body)
         elif path == "/metrics":
+            # OpenMetrics text exposition (round 20) — per-replica and
+            # fleet-aggregated series from ONE swap-lock snapshot; the
+            # pre-r20 ad-hoc JSON summary moved to /metrics.json
+            try:
+                text = self.front.telemetry_text()
+            except Exception as e:  # noqa: BLE001 - scrape must answer
+                self._send_json(500,
+                                {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send_text(
+                200, text,
+                "application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8",
+            )
+        elif path == "/metrics.json":
             live = serve_metrics.live_summary()
             self._send_json(200, live if live is not None
                             else {"serving": "idle"})
@@ -238,13 +356,28 @@ class _WireHandler(BaseHTTPRequestHandler):
     # -- POST: classify ----------------------------------------------------
     def _finish_wire(self, outcome: str, status: int,
                      body: Dict[str, Any],
-                     headers: Optional[Dict[str, str]] = None) -> None:
-        self.front.wire_stats.note(outcome, status)
+                     headers: Optional[Dict[str, str]] = None,
+                     trace_id: Optional[str] = None,
+                     t0: Optional[float] = None) -> None:
+        if trace_id is None and env_flag("SCC_OBS_TRACE"):
+            # refusal paths (including a body that never parsed) still
+            # get a traceable typed response
+            from scconsensus_tpu.obs.trace import new_trace_id
+
+            trace_id = new_trace_id()
+        latency = (time.monotonic() - t0) if t0 is not None else None
+        self.front.wire_stats.note(outcome, status, latency_s=latency,
+                                   trace_id=trace_id)
         body.setdefault("outcome", outcome)
+        if trace_id:
+            # the response carries the id BOTH ways (header for bulk
+            # clients that drop the body, body for everyone else)
+            body.setdefault("trace_id", trace_id)
+            headers = {**(headers or {}), TRACE_HEADER: trace_id}
         self._send_json(status, body, headers)
 
     def _parse_body(self) -> Tuple[np.ndarray, Optional[float],
-                                   Optional[str]]:
+                                   Optional[str], Optional[str]]:
         n = int(self.headers.get("Content-Length") or 0)
         if n <= 0:
             raise RequestInvalid("empty request body")
@@ -257,7 +390,7 @@ class _WireHandler(BaseHTTPRequestHandler):
                 raise RequestInvalid(f"unparseable npy payload: {e}")
             dl = self.headers.get("X-SCC-Deadline-S")
             fp = self.headers.get("X-SCC-Model-FP")
-            return cells, _parse_deadline(dl), (fp or None)
+            return cells, _parse_deadline(dl), (fp or None), None
         try:
             doc = json.loads(raw)
         except (json.JSONDecodeError, UnicodeDecodeError) as e:
@@ -270,7 +403,7 @@ class _WireHandler(BaseHTTPRequestHandler):
             raise RequestInvalid(f"cells is not a numeric matrix: {e}")
         return cells, _parse_deadline(doc.get("deadline_s")), (
             doc.get("model_fp") or None
-        )
+        ), (str(doc["trace_id"]) if doc.get("trace_id") else None)
 
     def do_POST(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
         path = self.path.split("?")[0]
@@ -280,17 +413,34 @@ class _WireHandler(BaseHTTPRequestHandler):
         from scconsensus_tpu.robust import faults
 
         front = self.front
+        t0 = time.monotonic()
+        # adoption order: header, then JSON-body trace_id, then mint —
+        # a client-supplied id wins either way (that is how a retry
+        # keeps its id across attempts; the postmortem bundle shows
+        # both under one trace). Minting waits until after the body
+        # parse so a body-supplied id is never shadowed; _finish_wire
+        # mints for the refusal paths, so even a malformed request
+        # still gets a traceable response.
+        trace_id = _clean_trace_id(self.headers.get(TRACE_HEADER))
         try:
             faults.fault_point("wire_request")
-            cells, deadline_s, model_fp = self._parse_body()
-            handle = front._submit(cells, deadline_s, model_fp)
+            cells, deadline_s, model_fp, body_trace = self._parse_body()
+            if trace_id is None:
+                trace_id = _clean_trace_id(body_trace)
+            if trace_id is None and env_flag("SCC_OBS_TRACE"):
+                from scconsensus_tpu.obs.trace import new_trace_id
+
+                trace_id = new_trace_id()
+            handle = front._submit(cells, deadline_s, model_fp,
+                                   trace_id=trace_id)
             wait = ((deadline_s
                      if deadline_s is not None
                      else getattr(front.backend, "config", None)
                      and front.backend.config.default_deadline_s) or 30.0)
             resp = handle.result(timeout=float(wait) + _RESULT_SLACK_S)
             self._finish_wire(resp.outcome, OUTCOME_STATUS[resp.outcome],
-                              _response_body(resp))
+                              _response_body(resp),
+                              trace_id=resp.trace_id or trace_id, t0=t0)
         except QueueFull as e:
             self._finish_wire(
                 "rejected_queue", 429,
@@ -298,23 +448,29 @@ class _WireHandler(BaseHTTPRequestHandler):
                  "retry_after_s": round(e.retry_after_s, 4)},
                 headers={"Retry-After":
                          str(max(1, math.ceil(e.retry_after_s)))},
+                trace_id=trace_id, t0=t0,
             )
         except RequestInvalid as e:
-            self._finish_wire("rejected_invalid", 422, {"error": str(e)})
+            self._finish_wire("rejected_invalid", 422, {"error": str(e)},
+                              trace_id=trace_id, t0=t0)
         except ServerClosed as e:
-            self._finish_wire("rejected_closed", 503, {"error": str(e)})
+            self._finish_wire("rejected_closed", 503, {"error": str(e)},
+                              trace_id=trace_id, t0=t0)
         except DeadlineExceeded as e:
             self._finish_wire(
                 "deadline_exceeded", 504,
                 {"error": str(e), "late_by_s": round(e.late_by_s, 4)},
+                trace_id=trace_id, t0=t0,
             )
         except RequestFailed as e:
             self._finish_wire("failed", 500,
                               {"error": str(e),
-                               "error_class": e.error_class})
+                               "error_class": e.error_class},
+                              trace_id=trace_id, t0=t0)
         except Exception as e:  # noqa: BLE001
             # the last-ditch guard: even a wire/driver bug resolves as a
             # counted typed outcome — a socket that dies uncounted is the
             # dropped-request failure mode one layer up
             self._finish_wire("failed", 500,
-                              {"error": f"{type(e).__name__}: {e}"})
+                              {"error": f"{type(e).__name__}: {e}"},
+                              trace_id=trace_id, t0=t0)

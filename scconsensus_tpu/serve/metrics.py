@@ -16,14 +16,18 @@ harness load this without jax).
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+from scconsensus_tpu.serve import slo as serve_slo
 
 __all__ = [
     "OUTCOMES",
     "BREAKER_STATES",
     "BREAKER_SEVERITY",
+    "STAGE_HIST_STAGES",
     "ServingStats",
     "WireStats",
     "merge_serving_sections",
@@ -53,6 +57,16 @@ BREAKER_STATES = ("closed", "open", "half_open")
 # and the section both read it), bounded so a soak cannot grow the record.
 _LATENCY_RING = 4096
 
+# The per-stage latency histogram vocabulary (serve.slo fixed-bucket
+# grids): queue_wait is dequeue-minus-enqueue per request, compute is the
+# batch classify wall — the two halves a p99 decomposes into.
+STAGE_HIST_STAGES = ("queue_wait", "compute")
+
+# Recent-request telemetry ring per stats object: the heartbeat stream's
+# trace-id evidence (tools/postmortem.py joins heartbeat lines to wire/
+# ledger rows through these ids) — bounded so a tick stays small.
+_RECENT_RING = 8
+
 
 class ServingStats:
     """Thread-safe counters for one serving driver's lifetime."""
@@ -78,6 +92,25 @@ class ServingStats:
         self._lat_n = 0
         self._lat_sum = 0.0
         self._lat_max = 0.0
+        # telemetry plane (round 20): per-outcome + per-stage fixed-
+        # bucket histograms (mergeable across replicas by construction),
+        # the multi-window SLO tracker, and the recent-trace ring the
+        # heartbeat stream carries
+        self.lat_hist: Dict[str, serve_slo.LatencyHistogram] = {
+            o: serve_slo.LatencyHistogram() for o in OUTCOMES
+        }
+        self.stage_hist: Dict[str, serve_slo.LatencyHistogram] = {
+            s: serve_slo.LatencyHistogram() for s in STAGE_HIST_STAGES
+        }
+        self.slo_track = serve_slo.SLOTracker()
+        self.recent: "collections.deque" = collections.deque(
+            maxlen=_RECENT_RING
+        )
+        # running availability counters (good+bad=total, client-fault
+        # excluded): kept incrementally so the per-request note is O(1)
+        # — this path sits inside the <2% driver overhead guard
+        self._av_bad = 0
+        self._av_total = 0
         self._lock = threading.Lock()
 
     # -- notes -------------------------------------------------------------
@@ -93,7 +126,8 @@ class ServingStats:
             self.queue_peak = max(self.queue_peak, int(depth))
 
     def note_outcome(self, outcome: str,
-                     latency_s: Optional[float] = None) -> None:
+                     latency_s: Optional[float] = None,
+                     trace_id: Optional[str] = None) -> None:
         if outcome not in OUTCOMES:
             raise ValueError(f"unknown serving outcome {outcome!r}")
         with self._lock:
@@ -108,6 +142,29 @@ class ServingStats:
                 self._lat_n += 1
                 self._lat_sum += ms
                 self._lat_max = max(self._lat_max, ms)
+                self.lat_hist[outcome].observe(ms)
+            cls = serve_slo.OUTCOME_CLASS.get(outcome)
+            if cls == "good":
+                self._av_total += 1
+            elif cls == "bad":
+                self._av_bad += 1
+                self._av_total += 1
+            self.slo_track.note(self._av_bad, self._av_total)
+            if trace_id:
+                self.recent.append({
+                    "trace_id": trace_id, "outcome": outcome,
+                    "latency_ms": (round(float(latency_s) * 1e3, 3)
+                                   if latency_s is not None else None),
+                    "ts": round(time.time(), 3),
+                })
+
+    def note_stage_latency(self, stage: str, seconds: float) -> None:
+        """Observe one per-stage latency (queue_wait / compute) into the
+        stage's fixed-bucket histogram."""
+        if stage not in STAGE_HIST_STAGES:
+            raise ValueError(f"unknown latency stage {stage!r}")
+        with self._lock:
+            self.stage_hist[stage].observe(max(float(seconds), 0.0) * 1e3)
 
     def note_batch(self, n_requests: int, n_cells: int) -> None:
         with self._lock:
@@ -142,6 +199,45 @@ class ServingStats:
         from averaging quantiles (which is statistically meaningless)."""
         with self._lock:
             return list(self._lat_ms)
+
+    def expo_snapshot(self) -> Dict[str, Any]:
+        """One internally consistent exposition snapshot (counters,
+        gauges, serialized histograms, the recent-trace ring, and the
+        SLO window deltas) taken under this stats object's lock — the
+        unit the pool's swap-lock snapshot and the wire's exposition
+        are assembled from."""
+        with self._lock:
+            av = serve_slo.classify_counts(self.counts)
+            return {
+                "counts": dict(self.counts),
+                "submitted": self.submitted,
+                "queue_depth": self.queue_depth,
+                "queue_cap": self.queue_capacity,
+                "breaker": self.breaker_state,
+                "trips": self.breaker_trips,
+                "latency_hist": {o: h.to_dict()
+                                 for o, h in self.lat_hist.items()},
+                "stage_hist": {s: h.to_dict()
+                               for s, h in self.stage_hist.items()},
+                "recent": list(self.recent),
+                "window_deltas": self.slo_track.window_deltas(
+                    av["bad"], av["total"]
+                ),
+            }
+
+    def slo_section(self, obs_overhead: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+        """The validated ``slo`` run-record section for this driver's
+        lifetime (single-driver case; a fleet builds the merged twin via
+        ``ReplicaPool.slo_section``)."""
+        snap = self.expo_snapshot()
+        p99 = self.latency_ms().get("p99")
+        return serve_slo.build_slo_section(
+            snap["counts"], p99, snap["window_deltas"],
+            latency_hist=snap["latency_hist"],
+            stage_hist=snap["stage_hist"],
+            obs_overhead=obs_overhead or serve_slo.obs_overhead(),
+        )
 
     # -- reads -------------------------------------------------------------
     def latency_ms(self) -> Dict[str, Any]:
@@ -206,9 +302,25 @@ class WireStats:
         self.submitted = 0
         self.counts: Dict[str, int] = {o: 0 for o in OUTCOMES}
         self.status_codes: Dict[str, int] = {}
+        # wire-level telemetry (round 20): the front is the one place
+        # every request of the whole fleet passes, so the formal SLO
+        # (availability + burn windows) and the end-to-end per-outcome
+        # latency histograms anchor HERE; replicas keep their own for
+        # the per-replica exposition and the merge proof
+        self.lat_hist: Dict[str, serve_slo.LatencyHistogram] = {
+            o: serve_slo.LatencyHistogram() for o in OUTCOMES
+        }
+        self.slo_track = serve_slo.SLOTracker()
+        self.recent: "collections.deque" = collections.deque(
+            maxlen=_RECENT_RING
+        )
+        self._av_bad = 0
+        self._av_total = 0
         self._lock = threading.Lock()
 
-    def note(self, outcome: str, status: int) -> None:
+    def note(self, outcome: str, status: int,
+             latency_s: Optional[float] = None,
+             trace_id: Optional[str] = None) -> None:
         if outcome not in OUTCOMES:
             raise ValueError(f"unknown wire outcome {outcome!r}")
         with self._lock:
@@ -216,6 +328,23 @@ class WireStats:
             self.counts[outcome] += 1
             key = str(int(status))
             self.status_codes[key] = self.status_codes.get(key, 0) + 1
+            if latency_s is not None:
+                self.lat_hist[outcome].observe(
+                    max(float(latency_s), 0.0) * 1e3
+                )
+            cls = serve_slo.OUTCOME_CLASS.get(outcome)
+            if cls == "good":
+                self._av_total += 1
+            elif cls == "bad":
+                self._av_bad += 1
+                self._av_total += 1
+            self.slo_track.note(self._av_bad, self._av_total)
+            if trace_id:
+                self.recent.append({
+                    "trace_id": trace_id, "outcome": outcome,
+                    "status": int(status),
+                    "ts": round(time.time(), 3),
+                })
 
     def section(self) -> Dict[str, Any]:
         with self._lock:
@@ -223,6 +352,23 @@ class WireStats:
                 "requests": {"submitted": self.submitted,
                              **dict(self.counts)},
                 "status_codes": dict(self.status_codes),
+            }
+
+    def expo_snapshot(self) -> Dict[str, Any]:
+        """Wire-scope exposition snapshot (counters + status codes +
+        end-to-end histograms + SLO window deltas), one lock hold."""
+        with self._lock:
+            av = serve_slo.classify_counts(self.counts)
+            return {
+                "counts": dict(self.counts),
+                "submitted": self.submitted,
+                "status_codes": dict(self.status_codes),
+                "latency_hist": {o: h.to_dict()
+                                 for o, h in self.lat_hist.items()},
+                "recent": list(self.recent),
+                "window_deltas": self.slo_track.window_deltas(
+                    av["bad"], av["total"]
+                ),
             }
 
 
@@ -378,9 +524,45 @@ def live_summary() -> Optional[Dict[str, Any]]:
             out["rejected"] = rejected
         if st.breaker_trips:
             out["breaker_trips"] = st.breaker_trips
+        # telemetry-plane panel feed (round 20): per-outcome histogram
+        # counts, the live SLO (availability + burn per window), and the
+        # recent-trace ring — tail_run renders these instead of raw
+        # counter deltas, and the postmortem joins heartbeats on the ids
+        av = serve_slo.classify_counts(st.counts)
+        deltas = st.slo_track.window_deltas(av["bad"], av["total"])
+        hist = {o: {"n": h.n, "buckets": list(h.counts)}
+                for o, h in st.lat_hist.items() if h.n}
+        recent = list(st.recent)
+    out["slo"] = slo_summary(av, deltas)
+    if hist:
+        out["lat_hist"] = hist
+    if recent:
+        out["recent"] = recent
     if lat.get("p99") is not None:
         out["p99_ms"] = lat["p99"]
     return out
+
+
+def slo_summary(avail: Dict[str, int],
+                window_deltas: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Compact live-SLO tick: availability ratio + burn per window —
+    the heartbeat-sized view of the full slo section (one formula with
+    build_slo_section, shared via classify_counts/window_deltas)."""
+    budget = max(1.0 - float(env_or_default_avail()), 1e-9)
+    ratio = ((avail["good"] / avail["total"]) if avail["total"] else 1.0)
+    burns = {}
+    for wd in window_deltas:
+        err = (wd["bad"] / wd["total"]) if wd["total"] else 0.0
+        # %g keying: int() would collide the sub-second test-scale
+        # windows ("0.1" and "0.5" both -> "0")
+        burns[f"{float(wd['window_s']):g}"] = round(err / budget, 3)
+    return {"availability": round(ratio, 6), "burn": burns}
+
+
+def env_or_default_avail() -> float:
+    from scconsensus_tpu.config import env_flag
+
+    return float(env_flag("SCC_SLO_AVAIL_TARGET"))
 
 
 # -- schema validation ------------------------------------------------------

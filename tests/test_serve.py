@@ -797,7 +797,7 @@ class TestTooling:
                 "artifact:consensus_model"} <= sites
         for _, rules, mode, _ in chaos_run.SERVE_SOAK_MATRIX:
             assert mode in ("soak", "refusal", "kill-restart",
-                            "fleet-swap", "fleet-replay")
+                            "fleet-swap", "fleet-replay", "fleet-kill")
             for r in rules:
                 assert r["class"] in chaos_run_fault_classes()
 

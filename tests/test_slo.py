@@ -1,0 +1,456 @@
+"""Telemetry plane (round 20): SLO math, OpenMetrics exposition, the
+outcome↔metric parity lint, trace ids, and the postmortem bundle.
+
+The contracts under test: the latency-histogram bucket grid is frozen
+and merges by per-bucket addition (the fleet series IS the sum of its
+replicas' — proven through the same text parser a scraper would use);
+the validated ``slo`` run-record section carries its own arithmetic
+(availability counts sum, burn rates equal their own error ratios,
+histogram buckets sum to their count) and is judged against its OWN
+declared objectives by the gate (no history needed); every
+``serve.metrics.OUTCOMES`` entry maps to exactly one counter and one
+latency-histogram series per scope, and every wire outcome to exactly
+one status code (the accounting contract extended to the metrics
+plane); trace ids are process-unique and syscall-free after the first;
+and the postmortem bundle joins heartbeat / ledger / wire evidence into
+one per-trace story — a retried request shows both attempts under one
+id."""
+
+import json
+import os
+
+import pytest
+
+from scconsensus_tpu.obs import regress
+from scconsensus_tpu.obs.trace import new_trace_id
+from scconsensus_tpu.serve import metrics as serve_metrics
+from scconsensus_tpu.serve import slo as serve_slo
+from scconsensus_tpu.serve.slo import (
+    LATENCY_BUCKETS_MS,
+    OUTCOME_CLASS,
+    OUTCOME_STATUS,
+    LatencyHistogram,
+    SLOTracker,
+    build_slo_section,
+    classify_counts,
+    merge_histogram_dicts,
+    parse_openmetrics,
+    render_openmetrics,
+    validate_slo,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _section(counts=None, p99=12.0, deltas=None, **kw):
+    return build_slo_section(
+        counts if counts is not None else {"ok": 98, "failed": 2},
+        p99,
+        deltas if deltas is not None
+        else [{"window_s": 300.0, "bad": 2, "total": 100}],
+        objectives={"availability": 0.99, "p99_ms": 50.0,
+                    "windows_s": [300.0], "burn_limit": 14.4},
+        **kw,
+    )
+
+
+class TestHistogram:
+    def test_observe_lands_in_the_right_bucket(self):
+        h = LatencyHistogram()
+        h.observe(0.5)      # <= 1.0
+        h.observe(3.0)      # <= 5.0
+        h.observe(99999.0)  # overflow
+        assert h.counts[0] == 1
+        assert h.counts[LATENCY_BUCKETS_MS.index(5.0)] == 1
+        assert h.counts[-1] == 1
+        assert h.n == 3 == sum(h.counts)
+
+    def test_merge_is_per_bucket_addition(self):
+        a, b = LatencyHistogram(), LatencyHistogram()
+        for ms in (0.5, 3.0, 40.0):
+            a.observe(ms)
+        for ms in (3.0, 7000.0, 99999.0):
+            b.observe(ms)
+        merged = merge_histogram_dicts([a.to_dict(), b.to_dict()])
+        assert merged["count"] == 6
+        assert merged["buckets"] == [
+            x + y for x, y in zip(a.counts, b.counts)
+        ]
+        assert merged["sum_ms"] == pytest.approx(a.sum_ms + b.sum_ms)
+
+    def test_dict_roundtrip(self):
+        h = LatencyHistogram()
+        h.observe(12.0)
+        again = LatencyHistogram.from_dict(h.to_dict())
+        assert again.counts == h.counts
+        assert again.n == h.n
+
+
+class TestSLOSection:
+    def test_burn_is_error_ratio_over_budget(self):
+        sec = _section()
+        # 2 bad / 100 total against a 1% budget = burning exactly 2x
+        assert sec["burn_rates"][0]["burn"] == pytest.approx(2.0)
+        assert sec["worst_burn"] == pytest.approx(2.0)
+        assert sec["availability"]["ratio"] == pytest.approx(0.98)
+        validate_slo(sec)
+
+    def test_client_faults_excluded_from_the_denominator(self):
+        av = classify_counts({"ok": 10, "rejected_invalid": 5,
+                              "rejected_queue": 3, "failed": 2})
+        assert av == {"good": 10, "bad": 2, "client": 8, "total": 12}
+
+    def test_validate_rejects_broken_availability_sum(self):
+        sec = _section()
+        sec["availability"]["good"] += 1  # one request vanishes
+        with pytest.raises(ValueError, match="accounting broken"):
+            validate_slo(sec)
+
+    def test_validate_rejects_burn_contradicting_its_ratio(self):
+        sec = _section()
+        sec["burn_rates"][0]["burn"] = 9.9
+        with pytest.raises(ValueError, match="contradicts"):
+            validate_slo(sec)
+
+    def test_validate_rejects_wrong_worst_burn(self):
+        sec = _section()
+        sec["worst_burn"] = 0.0
+        with pytest.raises(ValueError, match="worst_burn"):
+            validate_slo(sec)
+
+    def test_validate_rejects_histogram_not_summing(self):
+        h = LatencyHistogram()
+        h.observe(5.0)
+        sec = _section(latency_hist={"ok": h.to_dict()})
+        sec["latency_hist"]["ok"]["count"] = 7
+        with pytest.raises(ValueError, match="account for every"):
+            validate_slo(sec)
+
+    def test_validate_rejects_foreign_bucket_grid(self):
+        sec = _section()
+        sec["bucket_bounds_ms"] = [1.0, 2.0, 3.0]
+        with pytest.raises(ValueError, match="frozen grid"):
+            validate_slo(sec)
+
+    def test_validate_rejects_met_contradicting_p99(self):
+        sec = _section(p99=80.0)  # target is 50
+        assert sec["latency"]["met"] is False
+        sec["latency"]["met"] = True
+        with pytest.raises(ValueError, match="met contradicts"):
+            validate_slo(sec)
+
+    def test_tracker_window_deltas_are_vs_window_start(self):
+        tr = SLOTracker(windows_s=[10.0])
+        tr.note(0, 10, now=100.0)
+        tr.note(2, 30, now=104.0)
+        # inside the 10s window only the 104.0 snapshot is older than
+        # "now - window"? No: cutoff=98 < 100 — both snaps are inside,
+        # so the base is the process origin (0, 0)
+        d = tr.window_deltas(5, 50, now=108.0)
+        assert d == [{"window_s": 10.0, "bad": 5, "total": 50}]
+        # once the first snapshot ages out it becomes the base
+        d = tr.window_deltas(5, 50, now=112.0)
+        assert d == [{"window_s": 10.0, "bad": 5, "total": 40}]
+
+
+class TestGateLane:
+    def test_burn_breach_fails_with_zero_history(self):
+        sec = _section()  # burning 2x...
+        sec["objectives"]["burn_limit"] = 1.5  # ...over a 1.5x limit
+        rec = {"extra": {"config": "slo-test", "platform": "cpu"},
+               "unit": "seconds", "slo": sec}
+        verdict = regress.gate_record(rec, history=[])
+        assert not verdict.ok
+        bad = [s for s in verdict.slo_regressions
+               if s.metric == "worst_burn"]
+        assert bad and bad[0].value == pytest.approx(2.0)
+        assert bad[0].detail  # names the breaching window
+
+    def test_p99_miss_fails_against_its_own_target(self):
+        sec = _section(counts={"ok": 100}, p99=80.0,
+                       deltas=[{"window_s": 300.0, "bad": 0,
+                                "total": 100}])
+        rec = {"extra": {"config": "slo-test", "platform": "cpu"},
+               "unit": "seconds", "slo": sec}
+        verdict = regress.gate_record(rec, history=[])
+        assert not verdict.ok
+        assert any(s.metric == "p99_ms" for s in verdict.slo_regressions)
+
+    def test_clean_section_passes_and_seeds(self):
+        sec = _section(counts={"ok": 100}, p99=12.0,
+                       deltas=[{"window_s": 300.0, "bad": 0,
+                                "total": 100}])
+        rec = {"extra": {"config": "slo-test", "platform": "cpu"},
+               "unit": "seconds", "slo": sec}
+        verdict = regress.gate_record(rec, history=[])
+        assert verdict.ok
+        assert {s.metric for s in verdict.slo} == {"worst_burn",
+                                                   "p99_ms"}
+
+
+def _scope(label, seed):
+    lat = {}
+    for i, o in enumerate(serve_metrics.OUTCOMES):
+        h = LatencyHistogram()
+        for k in range(seed + i):
+            h.observe(0.7 * (k + 1) * (i + 1))
+        lat[o] = h.to_dict()
+    stage = {}
+    for s in serve_metrics.STAGE_HIST_STAGES:
+        h = LatencyHistogram()
+        h.observe(2.0 * seed)
+        stage[s] = h.to_dict()
+    return {
+        "labels": {"replica": label, "model": "fixture01"},
+        "counts": {o: seed + i
+                   for i, o in enumerate(serve_metrics.OUTCOMES)},
+        "queue_depth": seed, "queue_cap": 32,
+        "breaker": "closed", "trips": 0,
+        "latency_hist": lat, "stage_hist": stage,
+    }
+
+
+def _fleet_snapshot():
+    r0, r1 = _scope("0", 1), _scope("1", 3)
+    fleet = {
+        "labels": {"replica": "fleet"},
+        "counts": {o: r0["counts"][o] + r1["counts"][o]
+                   for o in serve_metrics.OUTCOMES},
+        "queue_depth": 4, "queue_cap": 64,
+        "breaker": "closed", "trips": 0,
+        "latency_hist": {
+            o: merge_histogram_dicts([r0["latency_hist"][o],
+                                      r1["latency_hist"][o]])
+            for o in serve_metrics.OUTCOMES
+        },
+        "stage_hist": {
+            s: merge_histogram_dicts([r0["stage_hist"][s],
+                                      r1["stage_hist"][s]])
+            for s in serve_metrics.STAGE_HIST_STAGES
+        },
+    }
+    return {
+        "scopes": [r0, r1, fleet],
+        "wire": {"counts": {o: r0["counts"][o] + r1["counts"][o]
+                            for o in serve_metrics.OUTCOMES}},
+        "slo": _section(),
+    }
+
+
+class TestOpenMetrics:
+    def test_roundtrip_parses_and_terminates(self):
+        text = render_openmetrics(_fleet_snapshot())
+        assert text.endswith("# EOF\n")
+        doc = parse_openmetrics(text)
+        assert doc["types"]["scc_requests_total"] == "counter"
+        assert doc["types"]["scc_request_latency_ms"] == "histogram"
+
+    def test_parser_is_strict(self):
+        with pytest.raises(ValueError, match="EOF"):
+            parse_openmetrics("scc_x 1\n")
+        with pytest.raises(ValueError, match="duplicate"):
+            parse_openmetrics('scc_x{a="1"} 1\nscc_x{a="1"} 2\n# EOF\n')
+        with pytest.raises(ValueError, match="bad sample value"):
+            parse_openmetrics("scc_x one\n# EOF\n")
+
+    def test_fleet_histogram_series_is_the_sum_of_replicas(self):
+        # the merge proof THROUGH the text format: for every outcome and
+        # every bucket boundary, fleet _bucket == replica0 + replica1
+        doc = parse_openmetrics(render_openmetrics(_fleet_snapshot()))
+        samples = doc["samples"]
+        bounds = [f"{b:g}" if b != int(b) else str(int(b))
+                  for b in LATENCY_BUCKETS_MS] + ["+Inf"]
+        checked = 0
+        for o in serve_metrics.OUTCOMES:
+            for le in bounds:
+                def k(rep):
+                    return ("scc_request_latency_ms_bucket",
+                            tuple(sorted({"replica": rep, "outcome": o,
+                                          "le": le,
+                                          **({"model": "fixture01"}
+                                             if rep != "fleet"
+                                             else {})}.items())))
+                assert samples[k("fleet")] == (samples[k("0")]
+                                               + samples[k("1")])
+                checked += 1
+        assert checked == len(serve_metrics.OUTCOMES) * len(bounds)
+
+    def test_parity_every_outcome_has_one_counter_one_histogram(self):
+        # the outcome<->metric parity lint: per scope, EXACTLY one
+        # counter sample and one histogram series (its _count sample)
+        # per OUTCOMES entry — zero-valued series emitted on purpose
+        doc = parse_openmetrics(render_openmetrics(_fleet_snapshot()))
+        samples = doc["samples"]
+        for rep in ("0", "1", "fleet"):
+            labels = {"replica": rep}
+            if rep != "fleet":
+                labels["model"] = "fixture01"
+            for o in serve_metrics.OUTCOMES:
+                counters = [k for k in samples
+                            if k[0] == "scc_requests_total"
+                            and dict(k[1]).get("replica") == rep
+                            and dict(k[1]).get("outcome") == o]
+                hists = [k for k in samples
+                         if k[0] == "scc_request_latency_ms_count"
+                         and dict(k[1]).get("replica") == rep
+                         and dict(k[1]).get("outcome") == o]
+                assert len(counters) == 1, (rep, o)
+                assert len(hists) == 1, (rep, o)
+
+    def test_parity_wire_outcomes_cover_the_status_table(self):
+        # every wire outcome maps to exactly one (outcome, code) series,
+        # and the code IS the one the status table declares
+        doc = parse_openmetrics(render_openmetrics(_fleet_snapshot()))
+        wire = {k for k in doc["samples"]
+                if k[0] == "scc_wire_requests_total"}
+        assert len(wire) == len(OUTCOME_STATUS)
+        for k in wire:
+            lbl = dict(k[1])
+            assert int(lbl["code"]) == OUTCOME_STATUS[lbl["outcome"]]
+
+    def test_outcome_tables_agree_statically(self):
+        # ONE source of truth: serve.metrics.OUTCOMES, the wire status
+        # table, and the availability classes must cover the same set
+        from scconsensus_tpu.serve.fleet import wire as fleet_wire
+
+        assert set(OUTCOME_STATUS) == set(serve_metrics.OUTCOMES)
+        assert set(OUTCOME_CLASS) == set(serve_metrics.OUTCOMES)
+        assert fleet_wire.OUTCOME_STATUS is OUTCOME_STATUS
+
+    def test_obs_overhead_gauge_rides_the_exposition(self):
+        snap = _fleet_snapshot()
+        snap["slo"]["obs_overhead"] = {"on_ms": 5.2, "off_ms": 5.0,
+                                       "ratio": 1.04}
+        doc = parse_openmetrics(render_openmetrics(snap))
+        assert doc["samples"][("scc_obs_overhead_ratio", ())] \
+            == pytest.approx(1.04)
+
+
+class TestTraceIds:
+    def test_unique_and_hex(self):
+        ids = {new_trace_id() for _ in range(512)}
+        assert len(ids) == 512
+        for tid in list(ids)[:8]:
+            assert len(tid) == 16
+            int(tid, 16)
+
+    def test_shared_process_prefix(self):
+        a, b = new_trace_id(), new_trace_id()
+        assert a[:8] == b[:8]
+        assert a != b
+
+
+class TestPostmortemBundle:
+    def _tool(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "scc_postmortem", os.path.join(REPO, "tools",
+                                           "postmortem.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _workdir(self, tmp_path):
+        tid = "aabbccdd00000001"
+        hb = [
+            {"t": "header", "ts": 100.0, "pid": 42,
+             "metric": "fixture soak"},
+            {"t": "hb", "ts": 101.0, "seq": 1,
+             "serving": {"recent": [
+                 {"trace_id": tid, "outcome": "ok", "latency_ms": 2.0,
+                  "ts": 100.9}],
+                 "slo": {"availability": 0.5,
+                         "burn": {"300": 500.0}}}},
+            {"t": "end", "ts": 102.0, "cause": "clean", "ticks": 2,
+             "stalls": 0},
+        ]
+        with open(tmp_path / "X_heartbeat.jsonl", "w") as f:
+            for ln in hb:
+                f.write(json.dumps(ln) + "\n")
+        with open(tmp_path / "X_partial.json", "w") as f:
+            json.dump({
+                "termination": {"cause": "clean",
+                                "flushed_unix": 102.0},
+                "spans": [{"name": "serve_request", "kind": "detail",
+                           "wall_submitted_s": 0.002,
+                           "attrs": {"trace_id": tid, "outcome": "ok",
+                                     "req_id": 7}}],
+            }, f)
+        with open(tmp_path / "Q_LEDGER.jsonl", "w") as f:
+            f.write(json.dumps({"ts": 100.95, "req_id": 7,
+                                "trace_id": tid,
+                                "drift_fraction": 0.5}) + "\n")
+        with open(tmp_path / "FIX_SUMMARY.json", "w") as f:
+            json.dump({"attempts": [
+                {"i": 0, "status": 503, "outcome": "rejected_closed",
+                 "trace_id": tid, "attempt": 1, "ts": 100.5},
+                {"i": 0, "status": 200, "outcome": "ok",
+                 "trace_id": tid, "attempt": 2, "ts": 100.9},
+            ], "record": {"serving": {"wire": {
+                "status_codes": {"200": 1, "503": 1}}}}}, f)
+        return tid
+
+    def test_bundle_joins_one_trace_across_all_sources(self, tmp_path):
+        tid = self._workdir(tmp_path)
+        pm = self._tool()
+        bundle = pm.build_bundle([str(tmp_path)])
+        story = bundle["traces"][tid]
+        kinds = {e["kind"] for e in story}
+        assert {"request", "span", "quarantine",
+                "wire_response"} <= kinds
+        srcs = {e["src"] for e in story}
+        assert len(srcs) == 4  # heartbeat, partial, ledger, summary
+        # both attempts under the one id, refusal first
+        wire = [e for e in story if e["kind"] == "wire_response"]
+        assert [e["attempt"] for e in wire] == [1, 2]
+        assert wire[0]["status"] == 503 and wire[1]["status"] == 200
+
+    def test_timeline_sorted_and_processes_stamped(self, tmp_path):
+        self._workdir(tmp_path)
+        pm = self._tool()
+        bundle = pm.build_bundle([str(tmp_path)])
+        ts = [e["ts"] for e in bundle["timeline"]]
+        assert ts == sorted(ts)
+        assert bundle["processes"][0]["cause"] == "clean"
+        # the slo-burn mark made the timeline (budget burning at 500x)
+        assert any(e["kind"] == "slo_burn" for e in bundle["timeline"])
+
+    def test_trace_filter_keeps_context_events(self, tmp_path):
+        tid = self._workdir(tmp_path)
+        pm = self._tool()
+        bundle = pm.build_bundle([str(tmp_path)], trace=tid)
+        kinds = {e["kind"] for e in bundle["timeline"]}
+        assert "process_start" in kinds and "termination" in kinds
+        assert set(bundle["traces"]) == {tid}
+        text = pm.render_text(bundle)
+        assert tid in text and "2 wire attempts" in text
+
+
+class TestReviewRegressions:
+    """Pins for the round-20 review findings."""
+
+    def test_label_unescape_is_left_to_right(self):
+        # a literal backslash-then-n in a label value must round-trip,
+        # not decode into a newline (sequential str.replace would)
+        raw = "a\\nb"  # backslash, n — NOT a newline
+        text = ('# TYPE scc_x counter\n'
+                'scc_x{v="' + raw.replace("\\", "\\\\") + '"} 1\n'
+                '# EOF\n')
+        doc = parse_openmetrics(text)
+        (key,) = doc["samples"]
+        assert dict(key[1])["v"] == raw
+
+    def test_esc_unescape_roundtrip(self):
+        from scconsensus_tpu.serve.slo import _esc, _unescape
+
+        for v in ("plain", 'qu"ote', "new\nline", "back\\slash",
+                  "a\\nb", "\\\\n", 'mix\\"\n\\'):
+            assert _unescape(_esc(v)) == v
+
+    def test_p99_helper(self):
+        from scconsensus_tpu.serve.slo import p99_ms
+
+        assert p99_ms([]) is None
+        assert p99_ms([5.0]) == 5.0
+        assert p99_ms(list(range(100))) == 99.0

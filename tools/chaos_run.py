@@ -139,6 +139,15 @@ SERVE_SOAK_MATRIX: List[Tuple[str, List[Dict[str, Any]], str,
     ("swap-under-load", [], "fleet-swap",
      {"replicas": 3, "swap_after_frac": 0.33}),
     ("replay-across-replicas", [], "fleet-replay", {"replicas": 3}),
+    # round 20 (telemetry plane): hard-kill one replica mid-traffic.
+    # The contract is trace-id CONTINUITY across kill → pool respawn →
+    # retry: the killed replica's queued requests refuse typed, the
+    # pumps resubmit them under their ORIGINAL trace id, and the
+    # postmortem bundle (tools/postmortem.py over the workdir's
+    # heartbeat stream + partial record + summary) shows both attempts
+    # under one trace — plus the kill itself on the merged timeline.
+    ("kill-replica-under-load", [], "fleet-kill",
+     {"replicas": 2, "kill_after_frac": 0.2}),
 ]
 
 # The out-of-core streaming matrix (round 17): each plan drives the
@@ -671,6 +680,89 @@ def run_serve_plan(name: str, rules: List[Dict[str, Any]], mode: str,
         checks.append((
             "swap recorded in the fleet section",
             len((sv.get("fleet") or {}).get("swaps") or []) >= 1,
+        ))
+    elif mode == "fleet-kill":
+        # replica kill under load: trace-id continuity across kill →
+        # respawn → retry, proven twice — once on the worker's own
+        # attempt log, once through the postmortem bundle's merged
+        # cross-process timeline
+        n_fleet = max(int(n_requests), 30)
+        kill_after = max(int(n_fleet * float(
+            extra.get("kill_after_frac", 0.2))), 1)
+        rc, summary = _fleet_worker(
+            workdir, _left(), n_fleet,
+            ["--fresh", "--replicas", str(extra.get("replicas", 2)),
+             "--kill-after", str(kill_after), "--heartbeat", "0.15",
+             # heavy payloads + extra pumps keep the replicas
+             # compute-bound, so their queues hold real depth and the
+             # kill deterministically catches queued requests (the
+             # refusal -> retry arc under test; the worker retries the
+             # kill up to 3x if the first one caught nothing)
+             "--cells", "256", "--concurrency", "6"],
+        )
+        kills = (summary or {}).get("kills") or []
+        retried = (summary or {}).get("retried") or {}
+        counts = (summary or {}).get("outcome_counts") or {}
+        checks.append(("worker exited 0 (accounting held across the "
+                       "kill, serving + slo sections validated)",
+                       rc == 0))
+        checks.append(("replica killed AND respawned back to width",
+                       any(k.get("respawned") is not None
+                           for k in kills)))
+        checks.append((
+            "zero lost requests: every request ended served despite "
+            "the kill",
+            bool(summary) and summary.get("resolved")
+            == summary.get("requests")
+            and all(k in ("ok", "degraded", "quarantined")
+                    for k in counts),
+        ))
+        checks.append((
+            "refused requests were retried and KEPT their trace id "
+            "(continuity across kill -> respawn -> retry)",
+            len(retried) >= 1
+            and summary.get("trace_continuity") is True,
+        ))
+        # the postmortem bundle over the workdir: both attempts of a
+        # retried request under ONE trace, joined across the summary's
+        # wire log and the replica process's heartbeat/span evidence
+        bundle_path = os.path.join(workdir, "POSTMORTEM_BUNDLE.json")
+        pm = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools",
+                                          "postmortem.py"),
+             workdir, "--out", bundle_path, "--json"],
+            capture_output=True, text=True, timeout=_left(), cwd=_REPO,
+        )
+        bundle: Dict[str, Any] = {}
+        try:
+            with open(bundle_path) as f:
+                bundle = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            pass
+        checks.append(("postmortem bundle built", pm.returncode == 0
+                       and bool(bundle.get("traces"))))
+        two_attempt = {
+            tid: evs for tid, evs in (bundle.get("traces") or {}).items()
+            if len([e for e in evs
+                    if e.get("kind") == "wire_response"]) >= 2
+        }
+        retried_ids = {atts[0].get("trace_id")
+                       for atts in retried.values() if atts}
+        checks.append((
+            "bundle shows BOTH attempts of a retried request under one "
+            "trace id",
+            any(tid in two_attempt for tid in retried_ids if tid),
+        ))
+        checks.append((
+            "retried trace joined across sources (wire log + replica "
+            "heartbeat/span evidence)",
+            any(len({e.get("src") for e in evs}) >= 2
+                for evs in two_attempt.values()),
+        ))
+        checks.append((
+            "the kill itself is on the merged timeline",
+            any(e.get("kind") == "replica_kill"
+                for e in bundle.get("timeline") or []),
         ))
     elif mode == "fleet-replay":
         # same request set through 1 vs N replicas: identical label sha

@@ -199,6 +199,14 @@ def _report(verdict: regress.GateVerdict, drifts: List[Dict[str, Any]],
             if sv.regressed:
                 line += f"  (+{sv.excess_mb:.1f}MB past band)"
             print(line)
+        for sv in verdict.slo:
+            mark = "BREACHED" if sv.regressed else "ok"
+            unit = "x" if sv.metric == "worst_burn" else "ms"
+            line = (f"  slo   {sv.metric:<20} {sv.value:>9.3f}{unit} "
+                    f"limit {sv.limit:.3f}{unit}  {mark}")
+            if sv.regressed and sv.detail:
+                line += f"  <- {sv.detail}"
+            print(line)
         for d in drifts:
             state = "acknowledged" if d["acknowledged"] else "UNACKNOWLEDGED"
             src = d.get("pins_source")
@@ -612,6 +620,64 @@ def _smoke(fixtures: str, as_json: bool) -> int:
     checks.append((
         "record claiming an unregistered scenario rejected",
         sc_unknown_rejected,
+    ))
+
+    # SLO lane (round 20): a candidate whose slo section is internally
+    # consistent AND inside its own declared objectives (burn under
+    # burn_limit, p99 under target) passes, with both verdicts present
+    verdict_slo, _ = run_gate(
+        os.path.join(fixtures, "candidate_slo_clean.json"), evidence
+    )
+    slo_rec = _load_json(
+        os.path.join(fixtures, "candidate_slo_clean.json")
+    )
+    checks.append((
+        "clean slo candidate passes with burn + p99 judged against its "
+        "own objectives",
+        verdict_slo.ok
+        and {s.metric for s in verdict_slo.slo} == {"worst_burn",
+                                                    "p99_ms"}
+        and not any(s.regressed for s in verdict_slo.slo),
+    ))
+    # ...a candidate with CLEAN walls whose error-budget burn breached
+    # its own declared limit must fail on the slo verdict ALONE — the
+    # record carries its targets, so this lane needs no history
+    verdict_sb, _ = run_gate(
+        os.path.join(fixtures, "candidate_slo_burn_regressed.json"),
+        evidence,
+    )
+    sbreg = verdict_sb.slo_regressions
+    checks.append((
+        "burn-breached candidate fails on the slo verdict alone "
+        "(clean walls, clean serving latency)",
+        (not verdict_sb.ok)
+        and any(s.metric == "worst_burn" for s in sbreg)
+        and not any(s.metric == "p99_ms" for s in sbreg)
+        and not any(s.regressed for s in verdict_sb.stages)
+        and not any(s.regressed for s in verdict_sb.serving),
+    ))
+    # ...and an slo section whose histogram buckets do not sum to their
+    # count is a SCHEMA violation (a histogram must account for every
+    # observation), rejected before gating — same scratch-dir pattern
+    import copy as _copy_slo
+    import tempfile as _tempfile_slo
+
+    bad_slo = _copy_slo.deepcopy(slo_rec)
+    bad_slo["slo"]["latency_hist"]["ok"]["count"] += 1
+    with _tempfile_slo.TemporaryDirectory(
+            prefix="scc-gate-smoke-") as tslo:
+        bad_path = os.path.join(tslo, "candidate_slo_bad_hist.json")
+        with open(bad_path, "w") as f:
+            json.dump(bad_slo, f)
+        try:
+            run_gate(bad_path, evidence)
+            slo_rejected = False
+        except ValueError as e:
+            slo_rejected = "account for every" in str(e)
+    checks.append((
+        "slo histogram whose buckets do not sum to its count rejected "
+        "naming the rule",
+        slo_rejected,
     ))
 
     # a serving section that lost a request is a SCHEMA violation, not a

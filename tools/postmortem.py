@@ -1,0 +1,439 @@
+#!/usr/bin/env python
+"""Cross-process postmortem bundle: one merged incident timeline.
+
+A wire request that goes wrong leaves its evidence scattered across
+processes: the front counts a typed outcome, the replica's heartbeat
+stream logs the request in its recent-trace ring, the quarantine ledger
+gets a row, a killed process leaves a ``*_partial.json`` termination
+stamp. This tool joins all of it — keyed on the round-20 trace id — into
+one bundle:
+
+  * every ``*_heartbeat.jsonl`` stream under the given roots (process
+    starts/ends, stall events, and each tick's ``serving.recent``
+    trace-id ring, deduplicated across ticks);
+  * every ``*_partial.json`` flight-record (termination stamps, plus any
+    ``serve_request`` spans carrying a ``trace_id`` attr);
+  * every ``*LEDGER*.jsonl`` (the quarantine/drift ledger rows, trace-id
+    keyed since round 20);
+  * every ``*SUMMARY*.json`` / run-record JSON with per-request
+    ``outcomes`` entries or a ``serving`` section (the wire's view:
+    status codes, attempts, trace ids).
+
+Output: one JSON bundle (``--out``) and a rendered text timeline. The
+bundle's ``traces`` index maps each trace id to its merged cross-process
+story — a retried request shows BOTH attempts under one id, which is the
+kill-under-load soak's acceptance check (tools/chaos_run.py
+``kill-replica-under-load``).
+
+Usage:
+  python tools/postmortem.py DIR [DIR2 ...] [--trace ID] [--out PATH]
+      [--json] [--max-events N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+__all__ = [
+    "collect_sources",
+    "build_bundle",
+    "render_text",
+    "main",
+]
+
+
+def _read_jsonl(path: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path, "r", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # a torn mid-append line is expected
+                if isinstance(doc, dict):
+                    out.append(doc)
+    except OSError:
+        pass
+    return out
+
+
+def _read_json(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def collect_sources(roots: List[str]) -> Dict[str, List[str]]:
+    """Classified evidence files under the roots (recursive):
+    ``{"heartbeat": [...], "partial": [...], "ledger": [...],
+    "summary": [...]}`` — each list sorted for deterministic bundles."""
+    hb: List[str] = []
+    partial: List[str] = []
+    ledger: List[str] = []
+    summary: List[str] = []
+    for root in roots:
+        if os.path.isfile(root):
+            cands = [root]
+        else:
+            cands = glob.glob(os.path.join(root, "**", "*"),
+                              recursive=True)
+        for p in cands:
+            if not os.path.isfile(p):
+                continue
+            name = os.path.basename(p)
+            if name.endswith("_heartbeat.jsonl"):
+                hb.append(p)
+            elif name.endswith("_partial.json"):
+                partial.append(p)
+            elif "LEDGER" in name.upper() and name.endswith(".jsonl"):
+                ledger.append(p)
+            elif name.endswith(".json") and ("SUMMARY" in name.upper()
+                                             or name.startswith("RUN_")):
+                summary.append(p)
+    return {"heartbeat": sorted(hb), "partial": sorted(partial),
+            "ledger": sorted(ledger), "summary": sorted(summary)}
+
+
+def _rel(path: str, roots: List[str]) -> str:
+    for root in roots:
+        if os.path.isdir(root):
+            try:
+                r = os.path.relpath(path, root)
+                if not r.startswith(".."):
+                    return r
+            except ValueError:
+                pass
+    return os.path.basename(path)
+
+
+def _heartbeat_events(path: str, src: str) -> Tuple[
+        List[Dict[str, Any]], Optional[Dict[str, Any]]]:
+    """(events, process summary) from one heartbeat stream. Request
+    events come from each tick's ``serving.recent`` ring, deduplicated
+    across ticks on (trace_id, ts, outcome) — the ring is cumulative
+    evidence, not per-tick increments."""
+    events: List[Dict[str, Any]] = []
+    proc: Dict[str, Any] = {"stream": src}
+    seen: set = set()
+    last_hb_ts = None
+    for ln in _read_jsonl(path):
+        t = ln.get("t")
+        ts = ln.get("ts")
+        if t == "header":
+            proc.update({"pid": ln.get("pid"),
+                         "metric": ln.get("metric"),
+                         "started": ts})
+            events.append({"ts": ts, "src": src, "kind": "process_start",
+                           "pid": ln.get("pid"),
+                           "metric": ln.get("metric")})
+        elif t == "hb":
+            last_hb_ts = ts
+            sv = ln.get("serving") or {}
+            recents = list(sv.get("recent") or [])
+            for rep in (sv.get("fleet") or {}).get("replicas") or []:
+                recents.extend(rep.get("recent") or [])
+            for r in recents:
+                tid = r.get("trace_id")
+                key = (tid, r.get("ts"), r.get("outcome"))
+                if not tid or key in seen:
+                    continue
+                seen.add(key)
+                ev = {"ts": r.get("ts"), "src": src, "kind": "request",
+                      "trace_id": tid, "outcome": r.get("outcome")}
+                for k in ("latency_ms", "status"):
+                    if r.get(k) is not None:
+                        ev[k] = r[k]
+                events.append(ev)
+            slo = sv.get("slo") or {}
+            burns = slo.get("burn") or {}
+            worst = max((float(v) for v in burns.values()), default=0.0)
+            if worst > 1.0:
+                # budget burning faster than it replenishes: worth a
+                # timeline mark even without a failed request in the ring
+                events.append({"ts": ts, "src": src, "kind": "slo_burn",
+                               "availability": slo.get("availability"),
+                               "burn": burns})
+        elif t == "stall":
+            events.append({"ts": ts, "src": src, "kind": "stall",
+                           "since_progress_s": ln.get("since_progress_s"),
+                           "stalls": ln.get("stalls")})
+        elif t == "end":
+            proc.update({"ended": ts, "cause": ln.get("cause"),
+                         "ticks": ln.get("ticks"),
+                         "stalls": ln.get("stalls")})
+            events.append({"ts": ts, "src": src, "kind": "process_end",
+                           "cause": ln.get("cause")})
+    proc.setdefault("last_heartbeat", last_hb_ts)
+    return events, (proc if proc.get("pid") is not None
+                    or proc.get("ended") else None)
+
+
+def _partial_events(path: str, src: str) -> List[Dict[str, Any]]:
+    """Termination stamp + trace-carrying serve_request spans of one
+    ``*_partial.json`` flight record."""
+    rec = _read_json(path)
+    if rec is None:
+        return []
+    events: List[Dict[str, Any]] = []
+    term = rec.get("termination")
+    if isinstance(term, dict):
+        events.append({
+            "ts": term.get("flushed_unix"), "src": src,
+            "kind": "termination", "cause": term.get("cause"),
+            "last_span": term.get("last_span"),
+            "stalls": term.get("stall_count"),
+        })
+    for sp in rec.get("spans") or []:
+        if not isinstance(sp, dict):
+            continue
+        attrs = sp.get("attrs") or {}
+        tid = attrs.get("trace_id")
+        if sp.get("name") == "serve_request" and tid:
+            # span t0 is tracer-relative: the span proves WHICH process
+            # served the trace (and its outcome/wall); the wall-clock
+            # ordering comes from the heartbeat/ledger twins
+            events.append({
+                "ts": None, "src": src, "kind": "span",
+                "trace_id": tid, "outcome": attrs.get("outcome"),
+                "wall_s": sp.get("wall_submitted_s"),
+                "req_id": attrs.get("req_id"),
+            })
+    return events
+
+
+def _ledger_events(path: str, src: str) -> List[Dict[str, Any]]:
+    events = []
+    for row in _read_jsonl(path):
+        ev = {"ts": row.get("ts"), "src": src, "kind": "quarantine",
+              "trace_id": row.get("trace_id"),
+              "req_id": row.get("req_id"),
+              "drift_fraction": row.get("drift_fraction")}
+        if row.get("cells_path"):
+            ev["cells_path"] = row["cells_path"]
+        events.append(ev)
+    return events
+
+
+def _summary_events(path: str, src: str) -> Tuple[
+        List[Dict[str, Any]], Dict[str, Any]]:
+    """Per-request wire outcomes (+ kill stamps) from a soak summary or
+    run record; the record-level wire/serving/slo sections ride the
+    bundle's ``sections`` index."""
+    doc = _read_json(path)
+    if doc is None:
+        return [], {}
+    events: List[Dict[str, Any]] = []
+    # prefer the per-ATTEMPT log when the summary carries one: a retried
+    # request's refused first attempt is exactly the evidence a
+    # postmortem exists to surface
+    for o in doc.get("attempts") or doc.get("outcomes") or []:
+        if not isinstance(o, dict) or not o.get("trace_id"):
+            continue
+        ev = {"ts": o.get("ts"), "src": src, "kind": "wire_response",
+              "trace_id": o["trace_id"], "outcome": o.get("outcome"),
+              "status": o.get("status")}
+        if o.get("attempt") is not None:
+            ev["attempt"] = o["attempt"]
+        events.append(ev)
+    rec = doc.get("record") if isinstance(doc.get("record"), dict) \
+        else doc
+    serving = rec.get("serving") if isinstance(rec, dict) else None
+    sections: Dict[str, Any] = {}
+    if isinstance(serving, dict):
+        sec = {}
+        for k in ("wire", "latency_ms", "requests"):
+            if serving.get(k) is not None:
+                sec[k] = serving[k]
+        for kill in (serving.get("fleet") or {}).get("kills") or []:
+            events.append({"ts": kill.get("ts"), "src": src,
+                           "kind": "replica_kill",
+                           "replica": kill.get("replica"),
+                           "respawned": kill.get("respawned"),
+                           "refused": kill.get("refused")})
+        if sec:
+            sections["serving"] = sec
+    if isinstance(rec, dict) and isinstance(rec.get("slo"), dict):
+        slo = rec["slo"]
+        sections["slo"] = {
+            "availability": slo.get("availability"),
+            "worst_burn": slo.get("worst_burn"),
+            "latency": slo.get("latency"),
+            "obs_overhead": slo.get("obs_overhead"),
+        }
+    return events, sections
+
+
+def build_bundle(roots: List[str],
+                 trace: Optional[str] = None) -> Dict[str, Any]:
+    """The merged incident bundle for every evidence file under the
+    roots. With ``trace``, the timeline and trace index are filtered to
+    that id (sources and processes stay complete — the surrounding
+    context is the point of a postmortem)."""
+    sources = collect_sources(roots)
+    events: List[Dict[str, Any]] = []
+    processes: List[Dict[str, Any]] = []
+    sections: Dict[str, Dict[str, Any]] = {}
+    for p in sources["heartbeat"]:
+        evs, proc = _heartbeat_events(p, _rel(p, roots))
+        events.extend(evs)
+        if proc:
+            processes.append(proc)
+    for p in sources["partial"]:
+        events.extend(_partial_events(p, _rel(p, roots)))
+    for p in sources["ledger"]:
+        events.extend(_ledger_events(p, _rel(p, roots)))
+    for p in sources["summary"]:
+        evs, secs = _summary_events(p, _rel(p, roots))
+        events.extend(evs)
+        if secs:
+            sections[_rel(p, roots)] = secs
+    if trace:
+        events = [e for e in events
+                  if e.get("trace_id") in (None, trace)
+                  and (e.get("trace_id") == trace
+                       or e["kind"] in ("process_start", "process_end",
+                                        "termination", "stall",
+                                        "replica_kill"))]
+    # timestamped events sort by wall clock; timestamp-less span
+    # evidence sinks to the end of its trace's story, never the timeline
+    timeline = sorted(
+        (e for e in events if e.get("ts") is not None),
+        key=lambda e: (float(e["ts"]), e["src"]),
+    )
+    traces: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        tid = e.get("trace_id")
+        if tid:
+            traces.setdefault(tid, []).append(e)
+    for tid, evs in traces.items():
+        evs.sort(key=lambda e: (e.get("ts") is None,
+                                float(e.get("ts") or 0.0), e["src"]))
+    return {
+        "schema": "scc-postmortem-bundle",
+        "schema_version": 1,
+        "roots": [os.path.abspath(r) for r in roots],
+        "sources": {k: [_rel(p, roots) for p in v]
+                    for k, v in sources.items()},
+        "processes": processes,
+        "sections": sections,
+        "n_events": len(timeline),
+        "timeline": timeline,
+        "traces": traces,
+    }
+
+
+def _fmt_ev(e: Dict[str, Any], t0: float) -> str:
+    ts = e.get("ts")
+    reltime = f"+{float(ts) - t0:8.3f}s" if ts is not None else "   (span)"
+    bits = [reltime, f"[{e['src']}]", e["kind"]]
+    for k in ("trace_id", "outcome", "status", "attempt", "latency_ms",
+              "cause", "replica", "respawned", "drift_fraction",
+              "last_span", "wall_s"):
+        if e.get(k) is not None:
+            bits.append(f"{k}={e[k]}")
+    if e.get("kind") == "slo_burn":
+        bits.append(f"burn={e.get('burn')}")
+    return "  ".join(bits)
+
+
+def render_text(bundle: Dict[str, Any], max_events: int = 200) -> str:
+    """The human timeline: processes, merged events, per-trace stories."""
+    out: List[str] = ["postmortem bundle"]
+    for proc in bundle["processes"]:
+        bits = [f"  process {proc.get('stream')}"]
+        if proc.get("pid") is not None:
+            bits.append(f"pid {proc['pid']}")
+        if proc.get("ended") is not None:
+            bits.append(f"ended cause={proc.get('cause')}")
+        elif proc.get("last_heartbeat") is not None:
+            bits.append("no end stamp (died hard?)")
+        out.append("  ".join(bits))
+    for src, secs in sorted(bundle.get("sections", {}).items()):
+        sv = secs.get("serving") or {}
+        wire = sv.get("wire") or {}
+        if wire:
+            out.append(f"  wire [{src}]: "
+                       + " ".join(f"{k}={v}" for k, v in sorted(
+                           (wire.get("status_codes") or {}).items())))
+        slo = secs.get("slo") or {}
+        if slo and slo.get("worst_burn") is not None:
+            avail = (slo.get("availability") or {}).get("ratio")
+            out.append(f"  slo  [{src}]: availability={avail}"
+                       f" worst_burn={slo['worst_burn']}x")
+    timeline = bundle["timeline"]
+    t0 = float(timeline[0]["ts"]) if timeline else 0.0
+    out.append(f"  timeline ({len(timeline)} event(s)"
+               + (f", showing last {max_events}"
+                  if len(timeline) > max_events else "") + "):")
+    for e in timeline[-max_events:]:
+        out.append("    " + _fmt_ev(e, t0))
+    traces = bundle["traces"]
+    multi = {tid: evs for tid, evs in traces.items() if len(evs) > 1}
+    out.append(f"  traces: {len(traces)} id(s), "
+               f"{len(multi)} with a cross-source story")
+    for tid in sorted(traces):
+        evs = traces[tid]
+        srcs = {e["src"] for e in evs}
+        attempts = [e for e in evs if e["kind"] == "wire_response"]
+        story = f"  trace {tid}: {len(evs)} event(s) / {len(srcs)} source(s)"
+        if len(attempts) > 1:
+            story += f"  ({len(attempts)} wire attempts)"
+        out.append(story)
+        for e in evs:
+            out.append("    " + _fmt_ev(e, t0))
+    return "\n".join(out) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge cross-process serving evidence into one "
+                    "incident timeline")
+    ap.add_argument("roots", nargs="+",
+                    help="directories (or files) holding heartbeat "
+                         "streams, partial records, ledgers, summaries")
+    ap.add_argument("--trace", default=None,
+                    help="filter the timeline to one trace id")
+    ap.add_argument("--out", default=None,
+                    help="write the JSON bundle here")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the JSON bundle instead of text")
+    ap.add_argument("--max-events", type=int, default=200)
+    args = ap.parse_args(argv)
+
+    for root in args.roots:
+        if not os.path.exists(root):
+            print(f"postmortem: no such path {root}", file=sys.stderr)
+            return 2
+    bundle = build_bundle(args.roots, trace=args.trace)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(bundle, f, indent=1, default=str)
+    if args.as_json:
+        print(json.dumps(bundle, indent=1, default=str))
+    else:
+        sys.stdout.write(render_text(bundle,
+                                     max_events=args.max_events))
+    if not bundle["timeline"] and not bundle["traces"]:
+        print("postmortem: no evidence found under the given roots",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -51,6 +51,28 @@ def _fmt_bytes(n: Optional[float]) -> str:
     return "?"
 
 
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(buckets: List[int]) -> str:
+    """Unicode sparkline over histogram bucket counts (log-ish scale so
+    a dominant bucket doesn't flatten the tail into invisibility)."""
+    import math
+
+    peak = max(buckets) if buckets else 0
+    if peak <= 0:
+        return "▁" * len(buckets)
+    out = []
+    for c in buckets:
+        if c <= 0:
+            out.append("▁")
+        else:
+            frac = math.log1p(c) / math.log1p(peak)
+            out.append(_SPARK[min(int(frac * (len(_SPARK) - 1) + 0.5),
+                                  len(_SPARK) - 1)])
+    return "".join(out)
+
+
 def _fmt_dur(s: Optional[float]) -> str:
     if s is None:
         return "?"
@@ -367,6 +389,41 @@ def render(lines: List[Dict[str, Any]],
             if sv.get("failed"):
                 bits.append(f"failed {sv['failed']}")
             out.append("  serving: " + "   ".join(bits))
+            slo = sv.get("slo") or {}
+            if slo:
+                # live SLO panel (round 20): availability + per-window
+                # error-budget burn, straight off the heartbeat's
+                # cumulative counters — burn >= 1 means the budget is
+                # being eaten at least as fast as it replenishes
+                sbits = [f"availability {slo.get('availability')}"]
+                for w, b in sorted((slo.get("burn") or {}).items(),
+                                   key=lambda kv: float(kv[0])):
+                    sbits.append((f"BURN {w}s {b}x" if float(b) >= 1.0
+                                  else f"burn {w}s {b}x"))
+                out.append("  slo: " + "   ".join(sbits))
+            hist = sv.get("lat_hist") or {}
+            if hist:
+                # per-outcome latency histograms (round 20): fixed
+                # bucket grid (serve.slo.LATENCY_BUCKETS_MS + overflow)
+                # rendered as sparklines — the latency SHAPE live, not
+                # just a p99 scalar
+                try:
+                    from scconsensus_tpu.serve.slo import (
+                        LATENCY_BUCKETS_MS,
+                    )
+
+                    lo, hi = LATENCY_BUCKETS_MS[0], LATENCY_BUCKETS_MS[-1]
+                    grid = f" [{lo:g}ms..{hi:g}ms,+Inf]"
+                except Exception:
+                    grid = ""
+                out.append(f"  latency histograms{grid}:")
+                for o in sorted(hist):
+                    h = hist[o] or {}
+                    out.append(
+                        f"    {o:<18} "
+                        f"{_sparkline(list(h.get('buckets') or []))}"
+                        f"  n={h.get('n', 0)}"
+                    )
             fl = sv.get("fleet") or {}
             if fl:
                 # fleet heartbeat panel (round 16): per-replica queue
