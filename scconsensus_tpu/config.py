@@ -325,6 +325,49 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "serve.fleet.reconsensus will run the mini-refine and "
                 "produce an updated model (below it the loop reports "
                 "insufficient evidence and leaves the ledger growing)."),
+        # --- traffic control plane (serve/fleet/loadgen + autoscale) ---
+        EnvFlag("SCC_LOADGEN_RPS", float, 20.0,
+                "Open-loop load generator base arrival rate (requests/s) "
+                "— the rate profile's 1.0x level; the spike/ramp peak is "
+                "a multiple of it."),
+        EnvFlag("SCC_LOADGEN_PROFILE", str, "steady",
+                "Load-generator rate profile: steady|diurnal|spike|ramp "
+                "(serve.fleet.loadgen.PROFILES)."),
+        EnvFlag("SCC_LOADGEN_SEED", int, 7,
+                "Seed for the load generator's arrival schedule and "
+                "traffic-mix draw — the offered load is a pure function "
+                "of (profile, rates, duration, seed)."),
+        EnvFlag("SCC_LOADGEN_DURATION_S", float, 8.0,
+                "Load-generator run length in seconds (the window the "
+                "sustained-RPS-at-SLO headline is measured over)."),
+        EnvFlag("SCC_AUTOSCALE_MIN", int, 1,
+                "Autoscaler replica floor: scale-down never shrinks the "
+                "active group below this many replicas."),
+        EnvFlag("SCC_AUTOSCALE_MAX", int, 4,
+                "Autoscaler replica ceiling: scale-up never grows the "
+                "active group past this many replicas."),
+        EnvFlag("SCC_AUTOSCALE_TICK_S", float, 0.25,
+                "Autoscaler control-loop cadence in seconds (observe -> "
+                "decide -> actuate once per tick)."),
+        EnvFlag("SCC_AUTOSCALE_BURN_UP", float, 2.0,
+                "Scale-up pressure threshold on the worst multi-window "
+                "SLO burn rate (queue pressure is the other trigger; "
+                "see serve.fleet.autoscale.AutoscalePolicy)."),
+        EnvFlag("SCC_AUTOSCALE_BURN_DOWN", float, 0.25,
+                "Scale-down eligibility: the worst burn rate must sit at "
+                "or below this (and the queue at or below queue_low) for "
+                "down_ticks consecutive ticks."),
+        EnvFlag("SCC_AUTOSCALE_UP_TICKS", int, 2,
+                "Consecutive pressured ticks before a scale-up actuates "
+                "(hysteresis against one-tick blips)."),
+        EnvFlag("SCC_AUTOSCALE_DOWN_TICKS", int, 8,
+                "Consecutive idle ticks before a scale-down actuates — "
+                "deliberately slower than scale-up (capacity is cheap, "
+                "a breach is not)."),
+        EnvFlag("SCC_AUTOSCALE_COOLDOWN_TICKS", int, 4,
+                "Post-actuation cooldown in ticks during which no "
+                "further scale action fires (with the streak thresholds, "
+                "the no-flap guarantee)."),
         # --- DE engine ---
         EnvFlag("SCC_WILCOX_PROBE", bool, False,
                 "Synced per-bucket occupancy DIAGNOSIS of the Wilcoxon "

@@ -93,6 +93,15 @@ schema ``scc-run-record`` version 1 — top-level keys:
                     claiming within_budget without peak-RSS evidence
                     (or with the peak over the budget), or whose chunk
                     counts do not sum, is rejected.
+  loadgen           OPTIONAL (still schema version 1 — additive): the
+                    open-loop traffic section (serve.fleet.loadgen,
+                    round 21) — arrival profile + seeded schedule
+                    identity, the traffic mix over registered workload
+                    scenarios, open-loop accounting (offered >= sent >=
+                    completed >= good), the sustained-RPS-at-SLO
+                    headline consistency rule (0.0 on a breached run),
+                    and the autoscaler's typed actuation trail.
+                    Validated by serve.fleet.loadgen.validate_loadgen.
   integrity         OPTIONAL (still schema version 1 — additive): the
                     computation-integrity trail (robust.integrity,
                     round 18) — invariant checks planned/run/passed
@@ -180,6 +189,7 @@ def build_run_record(
     streaming: Optional[Dict[str, Any]] = None,
     integrity: Optional[Dict[str, Any]] = None,
     scenario: Optional[Dict[str, Any]] = None,
+    loadgen: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One schema-v1 run record. Pass ``tracer`` to take spans + compile
     stats from it; or pre-built ``spans`` (e.g. a resumed pipeline's
@@ -194,7 +204,9 @@ def build_run_record(
     attaches the stream.record out-of-core section; ``integrity``
     (optional) attaches the robust.integrity computation-integrity
     section; ``scenario`` (optional) attaches the workload-zoo
-    scenario identity section (scconsensus_tpu.workloads)."""
+    scenario identity section (scconsensus_tpu.workloads); ``loadgen``
+    (optional) attaches the open-loop traffic section
+    (serve.fleet.loadgen)."""
     if spans is None:
         spans = tracer.span_records() if tracer is not None else []
     extra = dict(extra or {})
@@ -240,6 +252,8 @@ def build_run_record(
         rec["integrity"] = integrity
     if scenario is not None:
         rec["scenario"] = scenario
+    if loadgen is not None:
+        rec["loadgen"] = loadgen
     return rec
 
 
@@ -372,6 +386,13 @@ def validate_run_record(rec: Dict[str, Any]) -> None:
         from scconsensus_tpu.workloads import validate_scenario
 
         validate_scenario(sc)
+    lg = rec.get("loadgen")
+    if lg is not None:
+        # jax-free import (serve.fleet.loadgen's module level is
+        # numpy-only by contract; the run path lazy-imports compute)
+        from scconsensus_tpu.serve.fleet.loadgen import validate_loadgen
+
+        validate_loadgen(lg)
 
 
 # --------------------------------------------------------------------------

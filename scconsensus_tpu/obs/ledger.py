@@ -397,6 +397,22 @@ class Ledger:
                 # keyed baselines (p99@rN, throughput@rN) read it —
                 # absent means the bare r15 driver (keys as r1)
                 entry["serving"]["replicas"] = nrep
+        lg = rec.get("loadgen")
+        if isinstance(lg, dict) and lg:
+            # traffic summary on the index (round 21): the perf gate's
+            # per-profile sustained-RPS-at-SLO baselines
+            # (regress.loadgen_baselines) read the manifest, not N
+            # record files — exactly like stage_walls
+            entry["loadgen"] = {
+                "profile": lg.get("profile"),
+                "arrival": lg.get("arrival"),
+                "rps_at_slo": lg.get("rps_at_slo"),
+                "achieved_rps": lg.get("achieved_rps"),
+                "breaches": len(lg.get("breaches") or []),
+                "actuations": len(
+                    (lg.get("autoscale") or {}).get("actuations") or []
+                ),
+            }
         ig = rec.get("integrity")
         if isinstance(ig, dict) and ig:
             # computation-integrity summary on the index (round 18): a

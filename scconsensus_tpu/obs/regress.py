@@ -40,11 +40,14 @@ __all__ = [
     "TransferVerdict",
     "ServingVerdict",
     "StreamingVerdict",
+    "LoadgenVerdict",
     "GateVerdict",
     "stage_baselines",
     "stage_transfer_baselines",
     "serving_baselines",
     "streaming_baselines",
+    "loadgen_baselines",
+    "loadgen_verdicts",
     "diff_span_trees",
     "gate_record",
     "DRIFT_LEDGER_NAME",
@@ -83,6 +86,16 @@ ABS_NOISE_FLOOR_MS = 1.0
 # quantity the whole out-of-core design exists to bound.
 STREAM_REL_NOISE_FLOOR = 0.15
 ABS_NOISE_FLOOR_MB = 64.0
+# Loadgen bands (BASELINE.md traffic policy, round 21): sustained RPS
+# at SLO inherits throughput's noise profile (scheduler jitter, queue-
+# shape luck under open-loop arrivals), so the serving relative floor
+# (25 %) with a 1 rps absolute floor for tiny offered rates. Lower is
+# the regression — a fleet that sustains less traffic at SLO than its
+# baseline has regressed even with every wall clean. Breaches gate
+# history-free: a run with ANY SLO breach fails outright (a breached
+# run's 0.0 headline must never ingest as a quiet new baseline).
+LOADGEN_REL_NOISE_FLOOR = 0.25
+ABS_NOISE_FLOOR_RPS = 1.0
 
 
 # --------------------------------------------------------------------------
@@ -255,6 +268,42 @@ def streaming_baselines(history: Sequence[Dict[str, Any]]
     }
 
 
+def loadgen_baselines(history: Sequence[Dict[str, Any]]
+                      ) -> Dict[str, Dict[str, float]]:
+    """Sustained-RPS-at-SLO baselines from manifest entries' ledger-
+    stamped ``loadgen`` summaries (obs.ledger ingest). Keyed per arrival
+    profile (``rps_at_slo@<profile>`` — spike traffic is not comparable
+    to steady traffic), LOADGEN floors (25 % / 1 rps), partials
+    excluded. Breached runs (``breaches > 0`` — headline pinned 0.0 by
+    the section's own consistency rule) never anchor: a baseline must
+    describe what the fleet sustains WITHIN its SLO."""
+    from scconsensus_tpu.obs.ledger import is_partial_entry
+
+    series: Dict[str, List[float]] = {}
+    for e in history:
+        if is_partial_entry(e):
+            continue
+        lg = e.get("loadgen") or {}
+        v = lg.get("rps_at_slo")
+        profile = lg.get("profile")
+        if (isinstance(v, (int, float)) and v >= 0
+                and isinstance(profile, str)
+                and not lg.get("breaches")):
+            series.setdefault(f"rps_at_slo@{profile}",
+                              []).append(float(v))
+    return {
+        metric: {
+            "baseline_rps": round(b["baseline"], 4),
+            "band_rps": round(b["band"], 4),
+            "spread_rps": round(b["spread"], 4),
+            "n": b["n"],
+        }
+        for metric, b in _banded_baselines(
+            series, ABS_NOISE_FLOOR_RPS, rel_floor=LOADGEN_REL_NOISE_FLOOR
+        ).items()
+    }
+
+
 # --------------------------------------------------------------------------
 # span-tree diff (name the offender)
 # --------------------------------------------------------------------------
@@ -403,6 +452,29 @@ class StreamingVerdict:
 
 
 @dataclasses.dataclass
+class LoadgenVerdict:
+    """Traffic-lane verdict (round 21). Two claims: ``slo_breaches``
+    gates history-free (any breach during the run fails outright — the
+    spike-recovery contract is ZERO breaches), and
+    ``rps_at_slo@<profile>`` gates against the key's ledger-stamped
+    baselines where LOWER is the regression (``excess`` carries the
+    shortfall below the band floor)."""
+
+    metric: str                    # "slo_breaches" | "rps_at_slo@<p>"
+    value: float
+    baseline: float
+    band: float
+    regressed: bool
+    excess: float = 0.0
+    unit: str = "rps"
+    detail: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None}
+
+
+@dataclasses.dataclass
 class GateVerdict:
     ok: bool
     key: Dict[str, str]
@@ -433,6 +505,11 @@ class GateVerdict:
     # section) — judged against the record's OWN declared objectives,
     # so they apply even to a key with zero history
     slo: List[SLOVerdict] = dataclasses.field(default_factory=list)
+    # traffic-lane verdicts (round 21; empty when the candidate carried
+    # no loadgen section) — the breach claim gates history-free
+    loadgen: List[LoadgenVerdict] = dataclasses.field(
+        default_factory=list
+    )
 
     @property
     def regressions(self) -> List[StageVerdict]:
@@ -453,6 +530,10 @@ class GateVerdict:
     @property
     def slo_regressions(self) -> List[SLOVerdict]:
         return [s for s in self.slo if s.regressed]
+
+    @property
+    def loadgen_regressions(self) -> List[LoadgenVerdict]:
+        return [v for v in self.loadgen if v.regressed]
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -479,6 +560,10 @@ class GateVerdict:
             "slo": [s.to_dict() for s in self.slo],
             "slo_regressions": [
                 s.to_dict() for s in self.slo_regressions
+            ],
+            "loadgen": [v.to_dict() for v in self.loadgen],
+            "loadgen_regressions": [
+                v.to_dict() for v in self.loadgen_regressions
             ],
         }
 
@@ -521,6 +606,47 @@ def slo_verdicts(candidate: Dict[str, Any]) -> List[SLOVerdict]:
             limit=float(target),
             regressed=float(p99) > float(target),
         ))
+    return out
+
+
+def loadgen_verdicts(candidate: Dict[str, Any],
+                     history: Sequence[Dict[str, Any]]
+                     ) -> List[LoadgenVerdict]:
+    """Traffic-lane verdicts for one candidate's ``loadgen`` section.
+
+    The breach claim is history-free (the SLOVerdict rule): any breach
+    recorded during the run — including a transient mid-spike breach
+    the final windows recovered from — fails the gate, because the
+    spike-soak contract is recovery WITHOUT a breach. The headline
+    claim gates ``rps_at_slo`` against the key's per-profile baselines;
+    lower is the regression."""
+    lg = candidate.get("loadgen")
+    if not isinstance(lg, dict):
+        return []
+    out: List[LoadgenVerdict] = []
+    breaches = lg.get("breaches")
+    if isinstance(breaches, list):
+        out.append(LoadgenVerdict(
+            metric="slo_breaches", value=float(len(breaches)),
+            baseline=0.0, band=0.0, regressed=len(breaches) > 0,
+            unit="breaches",
+            detail="; ".join(str(b) for b in breaches) or None,
+        ))
+    v = lg.get("rps_at_slo")
+    profile = lg.get("profile")
+    if isinstance(v, (int, float)) and isinstance(profile, str):
+        base = loadgen_baselines(history).get(f"rps_at_slo@{profile}")
+        if base is not None:
+            floor = base["baseline_rps"] - base["band_rps"]
+            lv = LoadgenVerdict(
+                metric=f"rps_at_slo@{profile}",
+                value=round(float(v), 4),
+                baseline=base["baseline_rps"], band=base["band_rps"],
+                regressed=float(v) < floor,
+            )
+            if lv.regressed:
+                lv.excess = round(floor - float(v), 4)
+            out.append(lv)
     return out
 
 
@@ -578,15 +704,20 @@ def gate_record(candidate: Dict[str, Any],
     # a first record that already burned through its error budget must
     # not seed as if it were clean
     slo = slo_verdicts(candidate)
+    # the traffic lane's breach claim is history-free too — a breached
+    # load run must not seed as if it were clean
+    lg_verdicts = loadgen_verdicts(candidate, history)
     if not history:
-        return GateVerdict(ok=not any(s.regressed for s in slo),
+        return GateVerdict(ok=(not any(s.regressed for s in slo)
+                               and not any(v.regressed
+                                           for v in lg_verdicts)),
                            key=key, n_history=0, stages=[],
                            note=note or
                            "no baseline history for this key; "
                            "candidate seeds the baseline",
                            n_partial_excluded=n_partial,
                            candidate_termination=cand_term,
-                           slo=slo)
+                           slo=slo, loadgen=lg_verdicts)
     baselines = stage_baselines(history)
     if cand_term is not None:
         # "completed stages still compare": OPEN span snapshots in a
@@ -718,13 +849,15 @@ def gate_record(candidate: Dict[str, Any],
           and not any(t.regressed for t in transfers)
           and not any(s.regressed for s in serving)
           and not any(s.regressed for s in streaming)
-          and not any(s.regressed for s in slo))
+          and not any(s.regressed for s in slo)
+          and not any(v.regressed for v in lg_verdicts))
     return GateVerdict(ok=ok, key=key, n_history=len(history),
                        stages=stages, note=note,
                        n_partial_excluded=n_partial,
                        candidate_termination=cand_term,
                        transfers=transfers, serving=serving,
-                       streaming=streaming, slo=slo)
+                       streaming=streaming, slo=slo,
+                       loadgen=lg_verdicts)
 
 
 # --------------------------------------------------------------------------

@@ -201,12 +201,18 @@ class CircuitBreaker:
         self.failures = 0
         self.opened_at = 0.0
         self.trips = 0
+        # operator override (the autoscaler's degraded-mode lever): a
+        # forced-open breaker routes every batch to the fallback with NO
+        # half-open probing until force_close() lifts it
+        self.forced = False
         self._lock = threading.Lock()
 
     def route(self, now: Optional[float] = None) -> str:
         """'device' or 'fallback' for the next batch."""
         now = time.monotonic() if now is None else now
         with self._lock:
+            if self.forced:
+                return "fallback"
             if self.state == "closed":
                 return "device"
             if self.state == "open":
@@ -217,8 +223,41 @@ class CircuitBreaker:
                 return "fallback"
             return "device"  # half_open: keep probing
 
+    def force_open(self, now: Optional[float] = None) -> None:
+        """Explicit degraded-mode entry: pin the breaker open (every
+        batch serves flagged-degraded from the fallback, no probing).
+        Counts as a trip — a record claiming degraded service must show
+        a tripped breaker, and a forced entry is exactly that."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self.forced:
+                return
+            self.forced = True
+            if self.state != "open":
+                self.state = "open"
+                self.opened_at = now
+                self.trips += 1
+                self.stats.note_breaker("open", tripped=True)
+
+    def force_close(self) -> None:
+        """Lift the forced-open override (degraded-mode exit): the
+        breaker returns to closed and normal failure counting resumes."""
+        with self._lock:
+            if not self.forced:
+                return
+            self.forced = False
+            if self.state != "closed":
+                self.state = "closed"
+                self.stats.note_breaker("closed")
+            self.failures = 0
+
     def record_success(self) -> None:
         with self._lock:
+            if self.forced:
+                # a fallback success must not close a forced-open
+                # breaker: only force_close() ends degraded mode
+                self.failures = 0
+                return
             if self.state != "closed":
                 self.state = "closed"
                 self.stats.note_breaker("closed")

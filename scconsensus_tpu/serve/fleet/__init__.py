@@ -19,12 +19,24 @@ what stands between it and real traffic (ROADMAP item 3):
   spill non-conforming cells into a landmark mini-refine → merge via the
   paper's contingency heuristic → export → hot-swap back into the fleet.
   Closes the loop the r15 quarantine ledger opened.
+* ``fleet.loadgen`` — the open-loop load generator (round 21): seeded
+  Poisson/burst arrival schedules over diurnal/spike/ramp rate
+  profiles, traffic mixes drawn from registered workload-zoo
+  scenarios, driven through the REAL wire front; emits a wire-side run
+  record whose headline is sustained RPS at SLO.
+* ``fleet.autoscale`` — the burn-rate fleet autoscaler (round 21): a
+  pure table-testable control policy (streak + cooldown hysteresis)
+  over the r20 multi-window burn rates and queue pressure, actuating
+  replica width (``ReplicaPool.scale_to``), admission tightening, and
+  explicit degraded-mode entry/exit — every action a typed
+  ``actuation`` record on the trace/ledger plane.
 
 Import discipline: this module is import-light; the heavy pieces load
 lazily (the chaos harness imports the package root without jax).
 """
 
-__all__ = ["ReplicaPool", "WireFront", "run_reconsensus",
+__all__ = ["ReplicaPool", "WireFront", "Autoscaler", "AutoscalePolicy",
+           "run_load", "run_reconsensus",
            "reconsensus_update", "read_quarantine_batch"]
 
 
@@ -37,6 +49,14 @@ def __getattr__(name):
         from scconsensus_tpu.serve.fleet.wire import WireFront
 
         return WireFront
+    if name in ("Autoscaler", "AutoscalePolicy"):
+        from scconsensus_tpu.serve.fleet import autoscale
+
+        return getattr(autoscale, name)
+    if name == "run_load":
+        from scconsensus_tpu.serve.fleet.loadgen import run_load
+
+        return run_load
     if name in ("run_reconsensus", "reconsensus_update",
                 "read_quarantine_batch"):
         from scconsensus_tpu.serve.fleet import reconsensus

@@ -94,6 +94,7 @@ class ReplicaPool:
         self._dying: List[Replica] = []
         self._swaps: List[Dict[str, Any]] = []
         self._kills: List[Dict[str, Any]] = []
+        self._scales: List[Dict[str, Any]] = []
         self._started_unix = time.time()
         first = self._load(model, readonly)
         self._models: Dict[str, ConsensusModel] = {
@@ -416,6 +417,69 @@ class ReplicaPool:
             self._kills.append(kill)
         return kill
 
+    def scale_to(self, n: int,
+                 drain_timeout_s: Optional[float] = None,
+                 reason: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+        """Resize the ACTIVE model's replica group to ``n`` (the
+        autoscaler's actuator). Scale-up mirrors the kill/respawn path:
+        fresh replicas are built AND started outside the lock, then
+        joined to routing only if the fleet has not moved on. Scale-down
+        drains the removed replicas (shallowest queues first) and banks
+        their stats — a scale action loses zero requests and zero
+        evidence. The action is stamped into ``fleet.scales``; a no-op
+        resize is returned un-stamped. Returns the scale record."""
+        n = int(n)
+        if n < 1:
+            raise ValueError("a fleet needs at least one replica")
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("fleet is not accepting a resize")
+            fp = self._active_fp
+            model = self._models[fp]
+            group = self._groups.get(fp) or []
+            cur = len(group)
+            victims: List[Replica] = []
+            if n < cur:
+                # shed the SHALLOWEST queues: a scale-down exists to
+                # trim idle width, so aim it away from queued work
+                by_depth = sorted(group,
+                                  key=lambda r: r.server.stats.queue_depth)
+                victims = by_depth[:cur - n]
+                for rep in victims:
+                    group.remove(rep)
+                # dying registration under the SAME lock hold that
+                # unroutes them (the stop()/kill discipline)
+                self._dying.extend(victims)
+        rec: Dict[str, Any] = {"from": cur, "to": n,
+                               "ts": round(time.time(), 3)}
+        if reason:
+            rec["reason"] = dict(reason)
+        if n == cur:
+            rec["noop"] = True
+            return rec
+        if victims:
+            drained = self._retire_group(victims, drain=True,
+                                         timeout_s=drain_timeout_s)
+            rec["drained_requests"] = drained
+        elif n > cur:
+            new_group = self._build_group(model, n - cur)
+            for nr in new_group:
+                nr.server.start()
+            with self._lock:
+                if self._closed or self._active_fp != fp:
+                    # the fleet moved on mid-build: the fresh replicas
+                    # never routed, stop them without banking
+                    for nr in new_group:
+                        nr.server.stop(drain=False)
+                    rec["aborted"] = True
+                    return rec
+                self._groups[fp].extend(new_group)
+                rec["added"] = [r.index for r in new_group]
+        with self._lock:
+            self._scales.append(rec)
+        return rec
+
     def _retire_group(self, group: List[Replica], drain: bool,
                       timeout_s: Optional[float] = None) -> int:
         """Stop a group's servers and bank their stats into the pool's
@@ -487,6 +551,7 @@ class ReplicaPool:
         )
         with self._lock:
             kills = [dict(k) for k in self._kills]
+            scales = [dict(s) for s in self._scales]
         sec["fleet"] = {
             # configured fleet width — the replica-keyed baseline key (a
             # workload property, stable across stop/drain)...
@@ -498,6 +563,7 @@ class ReplicaPool:
             "models": models,
             "swaps": swaps,
             "kills": kills,
+            "scales": scales,
             "submitted_by_owner": {
                 "replicas": sum(s["requests"]["submitted"]
                                 for s in live_secs),
@@ -559,6 +625,7 @@ class ReplicaPool:
                 + dying_samples,
                 "pool_expo": self._pool_stats.expo_snapshot(),
                 "kills": [dict(k) for k in self._kills],
+                "scales": [dict(s) for s in self._scales],
             }
 
     def expo_scopes(self, snap: Optional[Dict[str, Any]] = None
@@ -764,4 +831,8 @@ class ReplicaPool:
             out["recent"] = recent[-8:]
         out["fleet"] = {"active_fp": snap["active_fp"][:8],
                         "replicas": reps}
+        if snap.get("scales"):
+            # the heartbeat panel's autoscale tail: tail_run renders it
+            out["fleet"]["scales"] = [dict(s)
+                                      for s in snap["scales"][-3:]]
         return out

@@ -731,3 +731,19 @@ def validate_serving(sv: Dict[str, Any]) -> None:
             _require(sw.get("from_fp") != sw.get("to_fp"),
                      f"fleet.swaps[{i}]: a swap onto the SAME "
                      f"fingerprint is not a swap")
+        scales = fleet.get("scales", [])
+        _require(isinstance(scales, list),
+                 "fleet.scales must be a list")
+        for i, sc in enumerate(scales):
+            _require(isinstance(sc, dict),
+                     f"fleet.scales[{i}] must be an object")
+            frm, to = sc.get("from"), sc.get("to")
+            _require(isinstance(frm, int) and frm >= 0
+                     and isinstance(to, int) and to >= 1,
+                     f"fleet.scales[{i}] must carry int from >= 0 "
+                     f"and to >= 1")
+            _require(frm != to,
+                     f"fleet.scales[{i}]: a resize to the SAME width "
+                     f"is not a scale action (no-ops are un-stamped)")
+            _require(isinstance(sc.get("ts"), (int, float)),
+                     f"fleet.scales[{i}].ts must be a number")

@@ -437,3 +437,47 @@ class TestRunspaceKernel:
         m = np.isfinite(lp_sc)
         np.testing.assert_allclose(lp_rs[m], lp_sc[m], rtol=1e-6, atol=1e-6)
         np.testing.assert_allclose(u_rs, u_sc, atol=1e-3)
+
+
+class TestRedoOverflowDenseNoJdata:
+    def test_jdata_none_rebuilds_and_matches_kernel(self, rng):
+        """Pin: ``_redo_overflow_dense`` must honor ``_gene_chunks``'s
+        contract that dense callers may omit ``jdata`` (it uploads on
+        demand) — the redo twin rebuilds the device matrix itself in the
+        rare overflow case instead of crashing, and the re-run rows must
+        match a direct kernel call."""
+        import jax.numpy as jnp
+
+        from scconsensus_tpu.de.engine import _redo_overflow_dense
+        from scconsensus_tpu.ops.ranksum_allpairs import (
+            allpairs_ranksum_chunk,
+        )
+
+        g, n, k = 8, 90, 3
+        data = np.round(rng.gamma(2.0, size=(g, n)) * 4).astype(
+            np.float32) / 4
+        lab = rng.integers(0, k, n)
+        cell_idx_of = [np.nonzero(lab == c)[0].astype(np.int32)
+                       for c in range(k)]
+        pi, pj = _all_pairs(k)
+        n_of = np.array([ci.size for ci in cell_idx_of], np.int32)
+        cid = _cid_from_groups(cell_idx_of, n)
+        jcid, jn = jnp.asarray(cid), jnp.asarray(n_of)
+        jpi, jpj = jnp.asarray(pi), jnp.asarray(pj)
+        lp, u, ts = allpairs_ranksum_chunk(
+            jnp.asarray(data), jcid, jn, jpi, jpj, k
+        )
+        # every gene "overflowed": the redo must overwrite the zeroed
+        # chunk outputs with a full kernel re-run
+        outs = [(0, g, (jnp.zeros_like(lp), jnp.zeros_like(u),
+                        jnp.zeros_like(ts)))]
+        overflow = [(0, 0, g, jnp.full((g,), 99, jnp.int32))]
+        _redo_overflow_dense(outs, overflow, data, g, None, jcid, jn,
+                             jpi, jpj, k, 0)
+        _, _, (lp1, u1, ts1) = outs[0]
+        np.testing.assert_allclose(np.asarray(u1), np.asarray(u),
+                                   atol=1e-3)
+        np.testing.assert_allclose(np.asarray(ts1), np.asarray(ts),
+                                   rtol=1e-6, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(lp1), np.asarray(lp),
+                                   rtol=2e-4, atol=1e-4)

@@ -786,6 +786,30 @@ class TestFleetSchema:
         with pytest.raises(ValueError, match="per_replica"):
             validate_serving(sec)
 
+    def test_scale_stamps_validate(self):
+        sec = self._fleet_sec()
+        sec["fleet"]["scales"] = [
+            {"from": 1, "to": 2, "ts": 1.0, "reason": "autoscale"},
+            {"from": 2, "to": 1, "ts": 2.0,
+             "drained_requests": 0},
+        ]
+        validate_serving(sec)
+
+    def test_noop_scale_stamp_rejected(self):
+        sec = self._fleet_sec()
+        sec["fleet"]["scales"] = [{"from": 2, "to": 2, "ts": 1.0}]
+        with pytest.raises(ValueError, match="SAME width"):
+            validate_serving(sec)
+
+    def test_scale_stamp_needs_int_widths_and_ts(self):
+        sec = self._fleet_sec()
+        sec["fleet"]["scales"] = [{"from": "1", "to": 2, "ts": 1.0}]
+        with pytest.raises(ValueError, match="int from"):
+            validate_serving(sec)
+        sec["fleet"]["scales"] = [{"from": 1, "to": 2}]
+        with pytest.raises(ValueError, match="ts must be a number"):
+            validate_serving(sec)
+
 
 # --------------------------------------------------------------------------
 # tooling: replica-keyed baselines, fleet heartbeat panel, soak matrix

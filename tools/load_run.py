@@ -1,0 +1,373 @@
+#!/usr/bin/env python
+"""Traffic control plane CLI: open-loop load + burn-rate autoscaling.
+
+Composes the round-21 pair — ``serve.fleet.loadgen`` (seeded open-loop
+arrival schedules driven through the real wire front) and
+``serve.fleet.autoscale`` (the burn-rate control loop actuating replica
+width, admission tightening, and degraded mode) — into one run whose
+record headlines **sustained RPS at SLO** and is gateable by
+``tools/perf_gate.py`` against the evidence ledger's noise bands.
+
+Two modes:
+
+* default — one load run at the chosen profile/rate/mix; writes the
+  full summary (with the validated run record) to ``LOAD_SUMMARY.json``
+  in the workdir, optionally gates it (``--gate``) and ingests it into
+  an evidence ledger (``--evidence``).
+* ``--spike-soak`` — the acceptance proof: a spike profile over a small
+  admission queue and a 1-replica floor. The contract, checked the
+  ``tools/chaos_run.py`` way (one printed checks list): the fleet
+  SHEDS the spike via typed 429s (client-class — shed load never burns
+  the SLO budget), SCALES UP from its floor to absorb it, RECOVERS back
+  to the floor, with ZERO SLO breaches — and every actuation shows up
+  on the postmortem bundle's merged incident timeline.
+
+Exit codes: 0 contract held, 1 broken, 2 usage/environment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+__all__ = ["run_traffic", "run_spike_soak", "main"]
+
+SUMMARY_NAME = "LOAD_SUMMARY.json"
+BUNDLE_NAME = "POSTMORTEM_BUNDLE.json"
+
+
+def _parse_mix(spec: Optional[str]) -> Optional[Dict[str, float]]:
+    """``"multi_sample=2,cite_dual=1"`` → weight dict (None passes
+    through: loadgen defaults to an equal mix over the zoo)."""
+    if not spec:
+        return None
+    mix: Dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise argparse.ArgumentTypeError(
+                f"mix entry {part!r} is not name=weight")
+        name, _, w = part.partition("=")
+        try:
+            mix[name.strip()] = float(w)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"mix weight {w!r} is not a number")
+    return mix or None
+
+
+def _trim(summary: Dict[str, Any]) -> Dict[str, Any]:
+    """The stdout one-liner: the summary minus its bulky members."""
+    out = {k: v for k, v in summary.items()
+           if k not in ("record", "actuations", "scales",
+                        "outcome_counts", "mix_counts")}
+    out["n_actuations"] = len(summary.get("actuations") or [])
+    out["n_scales"] = len(summary.get("scales") or [])
+    return out
+
+
+def _write_summary(workdir: str, summary: Dict[str, Any]) -> str:
+    path = os.path.join(workdir, SUMMARY_NAME)
+    with open(path, "w") as f:
+        json.dump(summary, f, indent=1, default=str)
+    return path
+
+
+def _gate(record: Dict[str, Any], workdir: str,
+          evidence_dir: str) -> Tuple[bool, Dict[str, Any]]:
+    """Run tools/perf_gate.py over the candidate record; (ok, verdict)."""
+    cand = os.path.join(workdir, "LOAD_RECORD.json")
+    with open(cand, "w") as f:
+        json.dump(record, f, default=str)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "perf_gate.py"),
+         cand, "--evidence", evidence_dir, "--json"],
+        capture_output=True, text=True, timeout=300, cwd=_REPO,
+    )
+    verdict: Dict[str, Any] = {}
+    try:
+        verdict = json.loads(proc.stdout or "")  # one indented object
+    except json.JSONDecodeError:
+        pass
+    return proc.returncode == 0 and bool(verdict.get("ok")), verdict
+
+
+def _ingest(record: Dict[str, Any], evidence_dir: str) -> bool:
+    from scconsensus_tpu.obs.ledger import Ledger
+
+    try:
+        entry = Ledger(evidence_dir).ingest(record, source="loadgen")
+        print(f"[load] ingested {entry['file']}", file=sys.stderr)
+        return True
+    except (OSError, ValueError) as e:
+        print(f"[load] ingest failed: {e}", file=sys.stderr)
+        return False
+
+
+def run_traffic(workdir: str, args: argparse.Namespace) -> int:
+    """One load run at the requested shape; 0 = clean (and gated clean
+    when ``--gate``)."""
+    from scconsensus_tpu.serve.fleet.autoscale import AutoscalePolicy
+    from scconsensus_tpu.serve.fleet.loadgen import run_load
+
+    policy = None
+    if not args.no_autoscale:
+        policy = AutoscalePolicy.from_env(
+            min_replicas=args.replicas,
+            max_replicas=max(args.max_replicas, args.replicas),
+        )
+    summary = run_load(
+        workdir,
+        profile=args.profile,
+        base_rps=args.rps,
+        peak_rps=args.peak,
+        duration_s=args.duration,
+        seed=args.seed,
+        mix=_parse_mix(args.mix),
+        arrival=args.arrival,
+        replicas=args.replicas,
+        cells_per=args.cells,
+        n_genes=args.genes,
+        queue_capacity=args.queue_cap,
+        autoscale=not args.no_autoscale,
+        policy=policy,
+        heartbeat_s=args.heartbeat,
+        fresh=args.fresh,
+    )
+    _write_summary(workdir, summary)
+    ok = bool(summary["ok"])
+    print(f"[load] {'ok  ' if ok else 'FAIL'} run clean "
+          f"(offered={summary['offered']} good={summary['good']} "
+          f"rps_at_slo={summary['rps_at_slo']})", file=sys.stderr)
+    rec = summary["record"]
+    if args.gate:
+        if "invalid" in rec:
+            print(f"[load] FAIL record invalid: {rec['invalid']}",
+                  file=sys.stderr)
+            ok = False
+        else:
+            gate_ok, verdict = _gate(rec, workdir, args.evidence)
+            print(f"[load] {'ok  ' if gate_ok else 'FAIL'} perf gate "
+                  f"({len(verdict.get('loadgen') or [])} traffic "
+                  "verdict(s))", file=sys.stderr)
+            ok = ok and gate_ok
+    if ok and args.ingest:
+        ok = _ingest(rec, args.evidence)
+    print(json.dumps(_trim(summary)))
+    return 0 if ok else 1
+
+
+def run_spike_soak(workdir: str, args: argparse.Namespace) -> int:
+    """The spike-soak acceptance proof (shed / scale / recover / zero
+    breaches), chaos_run-style: one checks list, exit 0 iff all hold."""
+    from scconsensus_tpu.serve.fleet.autoscale import AutoscalePolicy
+    from scconsensus_tpu.serve.fleet.loadgen import run_load
+
+    floor = args.replicas
+    policy = AutoscalePolicy.from_env(
+        min_replicas=floor,
+        max_replicas=max(args.max_replicas, floor + 1),
+        # spike-tuned hysteresis: react within ~2 ticks, release the
+        # extra width soon after the spike clears so the recovery leg
+        # fits inside the run's post-spike third. The batcher merges
+        # queued requests aggressively, so sampled depth is spiky —
+        # ANY standing queue at two consecutive ticks is pressure
+        up_ticks=2, down_ticks=4, cooldown_ticks=3,
+        queue_high=0.25, queue_low=0.05,
+    )
+    summary = run_load(
+        workdir,
+        profile="spike",
+        base_rps=args.rps,
+        peak_rps=args.peak,
+        duration_s=args.duration,
+        seed=args.seed,
+        mix=_parse_mix(args.mix),
+        arrival=args.arrival,
+        replicas=floor,
+        cells_per=args.cells,
+        n_genes=args.genes,
+        queue_capacity=args.queue_cap,
+        autoscale=True,
+        policy=policy,
+        heartbeat_s=args.heartbeat,
+        fresh=args.fresh,
+    )
+    # the summary file must exist BEFORE the postmortem runs: the
+    # bundle's replica_scale events come from its record's fleet section
+    _write_summary(workdir, summary)
+
+    acts = summary.get("actuations") or []
+    scales = summary.get("scales") or []
+    counts = summary.get("outcome_counts") or {}
+    ups = [a for a in acts if a.get("kind") == "scale_up"]
+    downs = [a for a in acts if a.get("kind") == "scale_down"]
+
+    checks: List[Tuple[str, bool]] = []
+    checks.append(("run clean (every offered request sent, wire "
+                   "accounting held)", bool(summary["ok"])))
+    checks.append(("fleet scaled UP from its floor to absorb the spike",
+                   any(a.get("from") == floor for a in ups)))
+    checks.append(("fleet recovered back to its floor after the spike",
+                   bool(downs) and bool(scales)
+                   and scales[-1].get("to") == floor))
+    checks.append(("spike shed via typed 429s (rejected_queue >= 1, "
+                   "client-class so the SLO budget never burned)",
+                   counts.get("rejected_queue", 0) >= 1))
+    checks.append(("zero SLO breaches across the whole run",
+                   bool(summary["slo_held"])
+                   and not summary["breaches"]))
+    checks.append(("nonzero sustained RPS at SLO",
+                   float(summary["rps_at_slo"]) > 0.0))
+    checks.append(("run record validated (loadgen + serving + slo "
+                   "sections)", "invalid" not in summary["record"]))
+
+    # the postmortem bundle over the workdir: the actuation ledger rows
+    # and the record's fleet.scales stamps must BOTH land on the merged
+    # incident timeline — the control plane is traceable evidence, not
+    # a side effect
+    bundle_path = os.path.join(workdir, BUNDLE_NAME)
+    pm = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "postmortem.py"),
+         workdir, "--out", bundle_path, "--json"],
+        capture_output=True, text=True, timeout=120, cwd=_REPO,
+    )
+    bundle: Dict[str, Any] = {}
+    try:
+        with open(bundle_path) as f:
+            bundle = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+    timeline = bundle.get("timeline") or []
+    tl_acts = [e for e in timeline if e.get("kind") == "actuation"]
+    checks.append(("postmortem bundle built over the workdir",
+                   pm.returncode == 0 and bool(timeline)))
+    checks.append(("every actuation is on the merged incident timeline",
+                   bool(acts) and len(tl_acts) >= len(acts)))
+    checks.append(("fleet resizes mirrored onto the timeline from the "
+                   "record's fleet section",
+                   any(e.get("kind") == "replica_scale"
+                       for e in timeline)))
+
+    ok = all(c for _, c in checks)
+    for label, c in checks:
+        print(f"[load:spike-soak] {'ok  ' if c else 'FAIL'} {label}",
+              file=sys.stderr)
+    if ok and args.ingest:
+        ok = _ingest(summary["record"], args.evidence)
+    print(json.dumps({
+        "spike_soak": "ok" if ok else "fail",
+        "rps_at_slo": summary["rps_at_slo"],
+        "achieved_rps": summary["achieved_rps"],
+        "sheds": counts.get("rejected_queue", 0),
+        "actuations": len(acts),
+        "scale_ups": len(ups),
+        "scale_downs": len(downs),
+        "breaches": len(summary["breaches"]),
+        "workdir": workdir,
+    }))
+    return 0 if ok else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="open-loop load generator + burn-rate autoscaler "
+                    "over the real wire front")
+    ap.add_argument("--dir", default=None,
+                    help="workdir (model, ledgers, summary, bundle); "
+                         "default: a fresh temp dir")
+    ap.add_argument("--profile", default=None,
+                    choices=["steady", "diurnal", "spike", "ramp"],
+                    help="rate profile (default: SCC_LOADGEN_PROFILE)")
+    ap.add_argument("--rps", type=float, default=None,
+                    help="base offered rate (default: SCC_LOADGEN_RPS)")
+    ap.add_argument("--peak", type=float, default=None,
+                    help="peak rate for spike/ramp/diurnal "
+                         "(default: 4x base)")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="run length in seconds "
+                         "(default: SCC_LOADGEN_DURATION_S)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="arrival-schedule seed "
+                         "(default: SCC_LOADGEN_SEED)")
+    ap.add_argument("--mix", default=None,
+                    help="scenario mix, e.g. multi_sample=2,cite_dual=1 "
+                         "(default: equal over the workload zoo)")
+    ap.add_argument("--arrival", default="poisson",
+                    choices=["poisson", "burst"])
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="fleet floor (the autoscaler's min width)")
+    ap.add_argument("--max-replicas", type=int, default=3,
+                    help="autoscaler ceiling")
+    ap.add_argument("--cells", type=int, default=None,
+                    help="cells per request, scenario-scaled "
+                         "(default: 8; spike-soak: 96)")
+    ap.add_argument("--genes", type=int, default=120)
+    ap.add_argument("--queue-cap", type=int, default=None,
+                    help="admission queue capacity (spike-soak "
+                         "default: 8)")
+    ap.add_argument("--no-autoscale", action="store_true",
+                    help="pure load run: no control loop over the pool")
+    ap.add_argument("--heartbeat", type=float, default=None,
+                    help="live flight-recorder heartbeat seconds")
+    ap.add_argument("--fresh", action="store_true",
+                    help="rebuild the frozen model artifact")
+    ap.add_argument("--evidence", default=None,
+                    help="evidence ledger dir (default: "
+                         "SCC_EVIDENCE_DIR or <repo>/evidence)")
+    ap.add_argument("--ingest", action="store_true",
+                    help="ingest the validated record into the "
+                         "evidence ledger")
+    ap.add_argument("--gate", action="store_true",
+                    help="run tools/perf_gate.py over the record "
+                         "before ingesting")
+    ap.add_argument("--spike-soak", action="store_true",
+                    help="run the shed/scale/recover acceptance proof")
+    args = ap.parse_args(argv)
+
+    args.evidence = args.evidence or os.environ.get(
+        "SCC_EVIDENCE_DIR") or os.path.join(_REPO, "evidence")
+    if args.spike_soak:
+        # soak-shaped defaults: a 1-replica floor behind a tiny
+        # admission queue, heavy payloads (the replica must be
+        # compute-bound for queues to hold sampled depth), a >12x spike
+        # in the middle third, a tail long enough for the recovery leg
+        if args.rps is None:
+            args.rps = 12.0
+        if args.peak is None:
+            args.peak = 12.5 * args.rps
+        if args.duration is None:
+            args.duration = 15.0
+        if args.seed is None:
+            args.seed = 7
+        if args.queue_cap is None:
+            args.queue_cap = 4
+        if args.cells is None:
+            args.cells = 96
+        os.environ.setdefault("SCC_AUTOSCALE_TICK_S", "0.1")
+    if args.cells is None:
+        args.cells = 8
+
+    workdir = args.dir or tempfile.mkdtemp(prefix="scc_load_")
+    os.makedirs(workdir, exist_ok=True)
+    try:
+        if args.spike_soak:
+            return run_spike_soak(workdir, args)
+        return run_traffic(workdir, args)
+    except KeyboardInterrupt:
+        print("[load] interrupted", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -207,6 +207,20 @@ def _report(verdict: regress.GateVerdict, drifts: List[Dict[str, Any]],
             if sv.regressed and sv.detail:
                 line += f"  <- {sv.detail}"
             print(line)
+        for lv in verdict.loadgen:
+            mark = "REGRESSED" if lv.regressed else "ok"
+            if lv.metric == "slo_breaches":
+                line = (f"  load  {lv.metric:<20} {lv.value:>9.0f}   "
+                        f"(zero-breach contract)  {mark}")
+                if lv.regressed and lv.detail:
+                    line += f"  <- {lv.detail}"
+            else:
+                line = (f"  load  {lv.metric:<20} {lv.value:>9.3f}rps "
+                        f"baseline {lv.baseline:.3f}rps "
+                        f"± {lv.band:.3f}rps  {mark}")
+                if lv.regressed:
+                    line += f"  (-{lv.excess:.3f}rps below floor)"
+            print(line)
         for d in drifts:
             state = "acknowledged" if d["acknowledged"] else "UNACKNOWLEDGED"
             src = d.get("pins_source")
@@ -679,6 +693,95 @@ def _smoke(fixtures: str, as_json: bool) -> int:
         "naming the rule",
         slo_rejected,
     ))
+
+    # traffic lane (round 21): a load-run candidate with a clean loadgen
+    # section (mix over registered scenarios, open-loop accounting, zero
+    # breaches) validates, seeds its fresh key, and carries the
+    # zero-breach verdict...
+    import copy as _copy_lg
+    import tempfile as _tempfile_lg
+
+    lg_section = {
+        "profile": "steady", "arrival": "poisson",
+        "base_rps": 20.0, "peak_rps": 80.0, "duration_s": 8.0,
+        "seed": 7,
+        "mix": {"multi_sample": 0.5, "atlas_transfer": 0.5},
+        "offered": 160, "sent": 160, "completed": 160, "good": 158,
+        "late_fraction": 0.0125, "achieved_rps": 19.75,
+        "slo_held": True, "breaches": [], "rps_at_slo": 19.75,
+        "autoscale": {
+            "policy": {"min_replicas": 1, "max_replicas": 4},
+            "ticks": 32, "final_target": 1, "degraded": False,
+            "tightened": False,
+            "actuations": [
+                {"kind": "scale_up", "from": 1, "to": 2,
+                 "reason": {"worst_burn": 0.0, "queue_frac": 0.81},
+                 "ts": 1700000000.0},
+                {"kind": "scale_down", "from": 2, "to": 1,
+                 "reason": {"worst_burn": 0.0, "queue_frac": 0.0},
+                 "ts": 1700000004.0},
+            ],
+        },
+    }
+    lg_rec = _copy_lg.deepcopy(slo_rec)
+    lg_rec["extra"]["config"] = "loadgen-steady"
+    lg_rec["metric"] = "sustained RPS at SLO"
+    lg_rec["unit"] = "rps"
+    lg_rec["value"] = 19.75
+    lg_rec["loadgen"] = _copy_lg.deepcopy(lg_section)
+    with _tempfile_lg.TemporaryDirectory(prefix="scc-gate-smoke-") as tlg:
+        lg_path = os.path.join(tlg, "candidate_loadgen_clean.json")
+        with open(lg_path, "w") as f:
+            json.dump(lg_rec, f)
+        verdict_lg, _ = run_gate(lg_path, evidence)
+        checks.append((
+            "clean load-run candidate validates, seeds its key, and "
+            "carries the zero-breach traffic verdict",
+            verdict_lg.ok
+            and any(v.metric == "slo_breaches" and not v.regressed
+                    for v in verdict_lg.loadgen),
+        ))
+        # ...a run that breached its SLO mid-spike fails on the traffic
+        # verdict alone even with zero history (breaches gate
+        # history-free, like the slo lane) and its headline is pinned
+        # to 0.0 by the section's own consistency rule
+        lg_bad = _copy_lg.deepcopy(lg_rec)
+        lg_bad["loadgen"]["breaches"] = [
+            "burn: worst_burn 20.1 > limit 14.4"]
+        lg_bad["loadgen"]["slo_held"] = False
+        lg_bad["loadgen"]["rps_at_slo"] = 0.0
+        lg_bad["value"] = 0.0
+        bad_path = os.path.join(tlg, "candidate_loadgen_breached.json")
+        with open(bad_path, "w") as f:
+            json.dump(lg_bad, f)
+        verdict_lgb, _ = run_gate(bad_path, evidence)
+        checks.append((
+            "breached load run fails on the traffic verdict alone "
+            "(zero history needed)",
+            (not verdict_lgb.ok)
+            and any(v.metric == "slo_breaches" and v.regressed
+                    for v in verdict_lgb.loadgen)
+            and not any(s.regressed for s in verdict_lgb.stages),
+        ))
+        # ...and a section claiming a nonzero sustained-RPS headline
+        # alongside recorded breaches is a SCHEMA violation — a
+        # breached run sustains nothing, and the record must not
+        # contradict itself
+        lg_lie = _copy_lg.deepcopy(lg_bad)
+        lg_lie["loadgen"]["rps_at_slo"] = 19.75
+        lie_path = os.path.join(tlg, "candidate_loadgen_lie.json")
+        with open(lie_path, "w") as f:
+            json.dump(lg_lie, f)
+        try:
+            run_gate(lie_path, evidence)
+            lg_rejected = False
+        except ValueError as e:
+            lg_rejected = "rps_at_slo must be 0.0" in str(e)
+        checks.append((
+            "nonzero rps_at_slo claim on a breached run rejected "
+            "naming the rule",
+            lg_rejected,
+        ))
 
     # a serving section that lost a request is a SCHEMA violation, not a
     # gateable record (the accounting rule is the serve contract);

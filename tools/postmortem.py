@@ -14,7 +14,8 @@ one bundle:
   * every ``*_partial.json`` flight-record (termination stamps, plus any
     ``serve_request`` spans carrying a ``trace_id`` attr);
   * every ``*LEDGER*.jsonl`` (the quarantine/drift ledger rows, trace-id
-    keyed since round 20);
+    keyed since round 20, and the autoscaler's typed ``actuation`` rows
+    from ``ACTUATION_LEDGER.jsonl`` since round 21);
   * every ``*SUMMARY*.json`` / run-record JSON with per-request
     ``outcomes`` entries or a ``serving`` section (the wire's view:
     status codes, attempts, trace ids).
@@ -219,6 +220,20 @@ def _partial_events(path: str, src: str) -> List[Dict[str, Any]]:
 def _ledger_events(path: str, src: str) -> List[Dict[str, Any]]:
     events = []
     for row in _read_jsonl(path):
+        if row.get("kind") == "actuation":
+            # autoscaler control action (ACTUATION_LEDGER.jsonl): the
+            # fleet changing its own shape is timeline evidence on par
+            # with the requests that provoked it
+            reason = row.get("reason") or {}
+            ev = {"ts": row.get("ts"), "src": src, "kind": "actuation",
+                  "action": row.get("action"),
+                  "from": row.get("from"), "to": row.get("to"),
+                  "trace_id": row.get("trace_id")}
+            for k in ("worst_burn", "queue_frac"):
+                if reason.get(k) is not None:
+                    ev[k] = reason[k]
+            events.append(ev)
+            continue
         ev = {"ts": row.get("ts"), "src": src, "kind": "quarantine",
               "trace_id": row.get("trace_id"),
               "req_id": row.get("req_id"),
@@ -265,6 +280,11 @@ def _summary_events(path: str, src: str) -> Tuple[
                            "replica": kill.get("replica"),
                            "respawned": kill.get("respawned"),
                            "refused": kill.get("refused")})
+        for sc in (serving.get("fleet") or {}).get("scales") or []:
+            events.append({"ts": sc.get("ts"), "src": src,
+                           "kind": "replica_scale",
+                           "from": sc.get("from"), "to": sc.get("to"),
+                           "reason": sc.get("reason")})
         if sec:
             sections["serving"] = sec
     if isinstance(rec, dict) and isinstance(rec.get("slo"), dict):
@@ -308,7 +328,8 @@ def build_bundle(roots: List[str],
                   and (e.get("trace_id") == trace
                        or e["kind"] in ("process_start", "process_end",
                                         "termination", "stall",
-                                        "replica_kill"))]
+                                        "replica_kill", "replica_scale",
+                                        "actuation"))]
     # timestamped events sort by wall clock; timestamp-less span
     # evidence sinks to the end of its trace's story, never the timeline
     timeline = sorted(
@@ -343,7 +364,8 @@ def _fmt_ev(e: Dict[str, Any], t0: float) -> str:
     bits = [reltime, f"[{e['src']}]", e["kind"]]
     for k in ("trace_id", "outcome", "status", "attempt", "latency_ms",
               "cause", "replica", "respawned", "drift_fraction",
-              "last_span", "wall_s"):
+              "last_span", "wall_s", "action", "from", "to", "reason",
+              "worst_burn", "queue_frac"):
         if e.get(k) is not None:
             bits.append(f"{k}={e[k]}")
     if e.get("kind") == "slo_burn":

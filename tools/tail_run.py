@@ -448,6 +448,15 @@ def render(lines: List[Dict[str, Any]],
                            if r.get("trips") else "")
                     )
                     out.append("    " + "   ".join(rbits))
+                for sc in fl.get("scales") or []:
+                    # autoscale tail (round 21): the last few fleet
+                    # resizes, so a width change is visible in the same
+                    # panel as the queues that provoked it
+                    sbits = [f"scale {sc.get('from', '?')}"
+                             f"→{sc.get('to', '?')}"]
+                    if sc.get("reason"):
+                        sbits.append(f"({sc['reason']})")
+                    out.append("    " + " ".join(sbits))
     if st["stall"]:
         sl = st["stall"]
         out.append(f"  STALL #{sl.get('stalls')} at +{_fmt_dur((sl.get('ts') or 0) - float((st['header'] or {}).get('ts') or 0))}"
