@@ -422,6 +422,9 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "(set 0 to disable)."),
         EnvFlag("SCC_JAX_CACHE_DIR", str, None,
                 "Override the persistent XLA compile-cache dir."),
+        EnvFlag("SCC_TUNNEL_LOG", str, None,
+                "Override the TUNNEL_LOG.jsonl path read by "
+                "tunnel_probe --status and the bench tunnel stamp."),
         # --- tools/ ---
         EnvFlag("SCC_1M_CELLS", int, 1_000_000,
                 "run_sparse_1m.py: cell count override (testing)."),

@@ -37,13 +37,39 @@ __all__ = [
 _COST_CACHE: Dict[Any, Optional[Dict[str, float]]] = {}
 
 # cost_analysis key -> run-record field (version-tolerant: the bytes key
-# has been both "bytes accessed" and "bytes_accessed" across jaxlibs)
+# has been both "bytes accessed" and "bytes_accessed" across jaxlibs;
+# the installed jaxlib 0.4.x spells it "bytes accessed" with per-operand
+# variants like "bytes accessed0{}" / "bytes accessedout{}" alongside,
+# which must NOT sum into the total — exact-key matches only here)
 _FIELDS = (
     ("flops", "flops"),
     ("bytes accessed", "bytes_accessed"),
     ("bytes_accessed", "bytes_accessed"),
+    ("bytes-accessed", "bytes_accessed"),
     ("transcendentals", "transcendentals"),
 )
+
+# Normalized-spelling fallback for spellings _FIELDS hasn't seen yet: a
+# jax upgrade that renames "bytes accessed" to, say, "Bytes_Accessed"
+# must degrade to this mapping, not silently zero the cost section.
+# Keys normalize by lowercasing and collapsing non-alphanumerics to a
+# single underscore; per-operand variants ("bytes accessed0{}") carry
+# digits/braces and deliberately do not normalize onto a total field.
+_NORM_FIELDS = {
+    "flops": "flops",
+    "bytes_accessed": "bytes_accessed",
+    "transcendentals": "transcendentals",
+}
+
+
+def _norm_key(k: str) -> str:
+    out: List[str] = []
+    for ch in str(k).strip().lower():
+        if ch.isalnum():
+            out.append(ch)
+        elif not out or out[-1] != "_":
+            out.append("_")
+    return "".join(out).strip("_")
 
 
 def cost_enabled() -> bool:
@@ -86,6 +112,10 @@ def cost_analysis_of(jitted, *args, **kwargs) -> Optional[Dict[str, float]]:
             for src, dst in _FIELDS:
                 v = ca.get(src)
                 if v is not None and dst not in out:
+                    out[dst] = float(v)
+            for src, v in ca.items():
+                dst = _NORM_FIELDS.get(_norm_key(src))
+                if dst is not None and dst not in out and v is not None:
                     out[dst] = float(v)
             out = out or None
     except Exception:

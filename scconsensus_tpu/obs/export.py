@@ -102,6 +102,35 @@ schema ``scc-run-record`` version 1 — top-level keys:
                     headline consistency rule (0.0 on a breached run),
                     and the autoscaler's typed actuation trail.
                     Validated by serve.fleet.loadgen.validate_loadgen.
+  profile           OPTIONAL (still schema version 1 — additive): the
+                    unified per-run profile (obs.profile, round 22) —
+                    one row per stage span joining wall time, device
+                    time, cost-model FLOPs/bytes, achieved rates (vs.
+                    an optional measured ceiling), and audited
+                    transfer bytes, plus per-declared-boundary rows.
+                    Derived at record-build time from the spans /
+                    kernels / cost / residency sections (no new
+                    instrumentation). Validated by
+                    obs.profile.validate_profile.
+  residency_burndown
+                    OPTIONAL (still schema version 1 — additive): the
+                    residency burn-down ledger (obs.profile, round
+                    22) — bytes crossed per declared boundary with
+                    the TODO(item-2) boundaries totalled separately,
+                    the ratcheting progress metric for the device-
+                    residency refactor. Validated by
+                    obs.profile.validate_residency_burndown — totals
+                    disagreeing with the per-boundary rows are
+                    rejected.
+  tunnel            OPTIONAL (still schema version 1 — additive, round
+                    22): accelerator-tunnel health stamped by bench
+                    when the TPU capture tunnel is NOT known-alive —
+                    {state: stale|dead|missing|error, age_s?,
+                    last_outcome?, log?}. Absence means either the
+                    tunnel was alive or the run never needed one (CPU
+                    run without no-cpu-fallback mode); presence makes
+                    "accelerator evidence missing" an explicit,
+                    greppable fact instead of a silent omission.
   integrity         OPTIONAL (still schema version 1 — additive): the
                     computation-integrity trail (robust.integrity,
                     round 18) — invariant checks planned/run/passed
@@ -190,6 +219,9 @@ def build_run_record(
     integrity: Optional[Dict[str, Any]] = None,
     scenario: Optional[Dict[str, Any]] = None,
     loadgen: Optional[Dict[str, Any]] = None,
+    profile: Optional[Dict[str, Any]] = None,
+    residency_burndown: Optional[Dict[str, Any]] = None,
+    tunnel: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One schema-v1 run record. Pass ``tracer`` to take spans + compile
     stats from it; or pre-built ``spans`` (e.g. a resumed pipeline's
@@ -206,7 +238,10 @@ def build_run_record(
     section; ``scenario`` (optional) attaches the workload-zoo
     scenario identity section (scconsensus_tpu.workloads); ``loadgen``
     (optional) attaches the open-loop traffic section
-    (serve.fleet.loadgen)."""
+    (serve.fleet.loadgen); ``profile`` / ``residency_burndown``
+    (optional) attach the obs.profile unified stage profile and
+    residency burn-down ledger; ``tunnel`` (optional) attaches the
+    accelerator-tunnel health stamp (tools.tunnel_probe status)."""
     if spans is None:
         spans = tracer.span_records() if tracer is not None else []
     extra = dict(extra or {})
@@ -254,6 +289,12 @@ def build_run_record(
         rec["scenario"] = scenario
     if loadgen is not None:
         rec["loadgen"] = loadgen
+    if profile is not None:
+        rec["profile"] = profile
+    if residency_burndown is not None:
+        rec["residency_burndown"] = residency_burndown
+    if tunnel is not None:
+        rec["tunnel"] = tunnel
     return rec
 
 
@@ -393,6 +434,31 @@ def validate_run_record(rec: Dict[str, Any]) -> None:
         from scconsensus_tpu.serve.fleet.loadgen import validate_loadgen
 
         validate_loadgen(lg)
+    prof = rec.get("profile")
+    if prof is not None:
+        # jax-free import (obs.profile joins already-collected dicts)
+        from scconsensus_tpu.obs.profile import validate_profile
+
+        validate_profile(prof)
+    bd = rec.get("residency_burndown")
+    if bd is not None:
+        from scconsensus_tpu.obs.profile import validate_residency_burndown
+
+        validate_residency_burndown(bd)
+    tun = rec.get("tunnel")
+    if tun is not None:
+        if not isinstance(tun, dict):
+            raise ValueError("tunnel section must be an object")
+        if tun.get("state") not in ("alive", "stale", "dead", "missing",
+                                    "error"):
+            raise ValueError(
+                "tunnel.state must be alive|stale|dead|missing|error, "
+                f"got {tun.get('state')!r}"
+            )
+        age = tun.get("age_s")
+        if age is not None and (not isinstance(age, (int, float))
+                                or age < 0):
+            raise ValueError("tunnel.age_s must be a number >= 0")
 
 
 # --------------------------------------------------------------------------

@@ -475,6 +475,31 @@ class Ledger:
                 entry["stage_transfer_bytes"] = tb
         except Exception:
             pass
+        try:
+            # per-boundary transfer totals (both directions) ride the
+            # index too — regress.boundary_baselines anchors the
+            # residency burn-down ledger on these stamps. Prefers the
+            # record's own burndown section (validated totals), falls
+            # back to the raw residency aggregate for pre-round-22
+            # records re-ingested by --reindex.
+            bb: Dict[str, int] = {}
+            bd = rec.get("residency_burndown")
+            if isinstance(bd, dict):
+                for b, row in (bd.get("boundaries") or {}).items():
+                    if isinstance(row, dict):
+                        bb[str(b)] = int(row.get("bytes") or 0)
+            else:
+                res = rec.get("residency")
+                if isinstance(res, dict):
+                    for b, row in (res.get("by_boundary") or {}).items():
+                        if isinstance(row, dict):
+                            bb[str(b)] = int(
+                                row.get("to_host_bytes") or 0
+                            ) + int(row.get("to_device_bytes") or 0)
+            if bb:
+                entry["boundary_bytes"] = bb
+        except Exception:
+            pass
         self._manifest["entries"] = [
             e for e in self._manifest["entries"] if e.get("file") != name
         ]

@@ -44,6 +44,8 @@ __all__ = [
     "GateVerdict",
     "stage_baselines",
     "stage_transfer_baselines",
+    "boundary_baselines",
+    "stage_trends",
     "serving_baselines",
     "streaming_baselines",
     "loadgen_baselines",
@@ -188,6 +190,98 @@ def stage_transfer_baselines(history: Sequence[Dict[str, Any]]
             series, ABS_NOISE_FLOOR_BYTES
         ).items()
     }
+
+
+def boundary_baselines(history: Sequence[Dict[str, Any]]
+                       ) -> Dict[str, Dict[str, float]]:
+    """Per-declared-boundary byte baselines from manifest entries'
+    ledger-stamped ``boundary_bytes`` (total of both directions per
+    residency boundary, stamped at ingest). Same median-of-≤3 + noise-
+    band machinery and byte floors as :func:`stage_transfer_baselines`
+    — the residency burn-down ledger's denominator: BASELINE.md pins
+    these numbers and item-2 progress is the TODO boundaries' baselines
+    ratcheting toward zero. Partials excluded; boundaries never crossed
+    simply have no entry."""
+    from scconsensus_tpu.obs.ledger import is_partial_entry
+
+    series: Dict[str, List[float]] = {}
+    for e in history:
+        if is_partial_entry(e):
+            continue
+        for boundary, b in (e.get("boundary_bytes") or {}).items():
+            if isinstance(b, (int, float)) and b >= 0:
+                series.setdefault(boundary, []).append(float(b))
+    return {
+        boundary: {
+            "baseline_bytes": round(b["baseline"]),
+            "band_bytes": round(b["band"]),
+            "spread_bytes": round(b["spread"]),
+            "n": b["n"],
+        }
+        for boundary, b in _banded_baselines(
+            series, ABS_NOISE_FLOOR_BYTES
+        ).items()
+    }
+
+
+def stage_trends(history: Sequence[Dict[str, Any]],
+                 min_points: int = 2) -> Dict[str, Dict[str, Any]]:
+    """Per-stage wall trend lines over the FULL ledger history (oldest
+    first) — where :func:`stage_baselines` answers "is this run slower
+    than the recent anchor", this answers "which way has the stage been
+    drifting across rounds". Returns ``{stage: {n, first_s, last_s,
+    delta_s, pct, slope_s_per_run, direction}}`` with ``direction`` one
+    of ``up`` / ``down`` / ``flat``.
+
+    Degenerate histories are first-class, never errors: a single-entry
+    series reports ``flat`` with a zero slope (one point has no
+    trend), an all-identical series reports ``flat`` (zero variance
+    must not read as drift), and entries missing the stage key — e.g.
+    a backend that never ran it — simply don't contribute points.
+    A series is ``flat`` unless its endpoint delta clears the same
+    noise floors the gate uses (10 % / 50 ms), so timer jitter can
+    never be reported as a trend."""
+    from scconsensus_tpu.obs.ledger import is_partial_entry
+
+    series: Dict[str, List[float]] = {}
+    for e in history:
+        if is_partial_entry(e):
+            continue
+        for stage, w in (e.get("stage_walls") or {}).items():
+            if isinstance(w, (int, float)) and w >= 0:
+                series.setdefault(stage, []).append(float(w))
+    out: Dict[str, Dict[str, Any]] = {}
+    for stage, vs in series.items():
+        n = len(vs)
+        first, last = vs[0], vs[-1]
+        delta = last - first
+        # least-squares slope over run index; a 1-point series has no
+        # trend and a zero-variance index (impossible past the n==1
+        # guard, but cheap to keep explicit) must never divide
+        slope = 0.0
+        if n >= 2:
+            mean_x = (n - 1) / 2.0
+            mean_y = sum(vs) / n
+            sxx = sum((i - mean_x) ** 2 for i in range(n))
+            if sxx > 0:
+                slope = sum(
+                    (i - mean_x) * (v - mean_y) for i, v in enumerate(vs)
+                ) / sxx
+        band = max(ABS_NOISE_FLOOR_S, REL_NOISE_FLOOR * first)
+        if n < max(min_points, 2) or abs(delta) <= band:
+            direction = "flat"
+        else:
+            direction = "up" if delta > 0 else "down"
+        out[stage] = {
+            "n": n,
+            "first_s": round(first, 6),
+            "last_s": round(last, 6),
+            "delta_s": round(delta, 6),
+            "pct": round(100.0 * delta / first, 1) if first > 0 else None,
+            "slope_s_per_run": round(slope, 6),
+            "direction": direction,
+        }
+    return out
 
 
 def serving_baselines(history: Sequence[Dict[str, Any]]

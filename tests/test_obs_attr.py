@@ -1,0 +1,222 @@
+"""Differential run attribution (ISSUE 18 tentpole): diffing two run
+records must yield a deterministic ranked cause list whose drivers
+(transfer-at-boundary / device / work / host) follow the documented
+claim order, tools/perf_diff.py must print the identical report every
+time over the committed evidence pair, and a perf_gate FAIL must name
+the top suspect stage in its output."""
+
+import copy
+import json
+import pathlib
+import subprocess
+import sys
+
+from scconsensus_tpu.obs.attr import (
+    diff_records,
+    format_report,
+    top_suspect,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+EVIDENCE = REPO / "evidence"
+# the README's worked example — both committed, same config fingerprint
+CAND = EVIDENCE / "RUN_quick_cpu_dc28fb1eb588_1785744955.json"
+BASE = EVIDENCE / "RUN_quick_cpu_dc28fb1eb588_1785741543.json"
+
+
+def _rec(stages, residency_by_boundary=None, value=1.0):
+    """Minimal diffable record: stage spans + optional residency."""
+    spans = []
+    for name, props in stages.items():
+        spans.append({"name": name, "kind": "stage",
+                      "wall_synced_s": props["wall"]})
+    rec = {"metric": "m", "value": value, "unit": "seconds",
+           "spans": spans}
+    if residency_by_boundary is not None:
+        rec["residency"] = {"by_boundary": residency_by_boundary}
+    kernels = {}
+    cost = {}
+    for name, props in stages.items():
+        if "device" in props:
+            kernels[name] = {"device_time_s": props["device"]}
+        if "flops" in props:
+            cost[name] = {"flops": props["flops"]}
+    if kernels:
+        rec["kernels"] = {"vs_cost_model": kernels}
+    if cost:
+        rec["extra"] = {"stage_throughput": cost}
+    return rec
+
+
+class TestDrivers:
+    def test_transfer_driver_names_the_boundary(self):
+        base = _rec({"wilcox_ladder": {"wall": 1.0}},
+                    {"wilcox_ladder_plan": {"to_host_bytes": 1000,
+                                            "to_device_bytes": 0,
+                                            "calls": 1}})
+        # stage-level transfers come from residency.by_stage
+        base["residency"]["by_stage"] = {
+            "wilcox_ladder": {"to_host_bytes": 1000,
+                              "to_device_bytes": 0, "calls": 1}}
+        cand = _rec({"wilcox_ladder": {"wall": 1.5}},
+                    {"wilcox_ladder_plan": {"to_host_bytes": 2_100_001_000,
+                                            "to_device_bytes": 0,
+                                            "calls": 2}})
+        cand["residency"]["by_stage"] = {
+            "wilcox_ladder": {"to_host_bytes": 2_100_001_000,
+                              "to_device_bytes": 0, "calls": 2}}
+        diff = diff_records(cand, base)
+        cause = diff["causes"][0]
+        assert cause["driver"] == "transfer"
+        assert cause["boundary"] == "wilcox_ladder_plan"
+        assert "+2.1 GB d2h at boundary `wilcox_ladder_plan`" in \
+            cause["summary"]
+        assert cause["summary"].startswith("stage `wilcox_ladder` +50.0 %")
+
+    def test_device_driver_when_kernels_grew(self):
+        base = _rec({"de": {"wall": 1.0, "device": 0.8}})
+        cand = _rec({"de": {"wall": 2.0, "device": 1.7}})
+        diff = diff_records(cand, base)
+        cause = diff["causes"][0]
+        assert cause["driver"] == "device"
+        assert "device-kernel time" in cause["summary"]
+
+    def test_work_driver_when_flops_grew(self):
+        base = _rec({"de": {"wall": 1.0, "flops": 1e9}})
+        cand = _rec({"de": {"wall": 2.0, "flops": 5e9}})
+        cause = diff_records(cand, base)["causes"][0]
+        assert cause["driver"] == "work"
+        assert "more work dispatched" in cause["summary"]
+
+    def test_host_driver_by_elimination(self):
+        base = _rec({"embed": {"wall": 1.0, "device": 0.1,
+                               "flops": 1e9}})
+        cand = _rec({"embed": {"wall": 3.0, "device": 0.1,
+                               "flops": 1e9}})
+        cause = diff_records(cand, base)["causes"][0]
+        assert cause["driver"] == "host"
+        assert "host-side" in cause["summary"]
+
+    def test_improvement_and_structure(self):
+        base = _rec({"de": {"wall": 2.0}, "gone": {"wall": 0.5}})
+        cand = _rec({"de": {"wall": 1.0}, "new": {"wall": 0.3}})
+        diff = diff_records(cand, base)
+        by_stage = {c["stage"]: c for c in diff["causes"]}
+        assert by_stage["de"]["driver"] == "improvement"
+        assert by_stage["gone"]["driver"] == "structure"
+        assert "only in baseline" in by_stage["gone"]["summary"]
+        assert "only in candidate" in by_stage["new"]["summary"]
+        # the improvement never becomes the suspect; the new stage's
+        # added wall legitimately does (a stage that appeared IS the
+        # structural change a FAIL should name)
+        assert top_suspect(diff)["stage"] == "new"
+
+
+class TestRanking:
+    def test_ranked_by_absolute_delta_name_tiebroken(self):
+        base = _rec({"a": {"wall": 1.0}, "b": {"wall": 1.0},
+                     "c": {"wall": 1.0}})
+        cand = _rec({"a": {"wall": 1.2}, "b": {"wall": 3.0},
+                     "c": {"wall": 1.2}})
+        diff = diff_records(cand, base)
+        assert [c["stage"] for c in diff["causes"]] == ["b", "a", "c"]
+        assert [c["rank"] for c in diff["causes"]] == [1, 2, 3]
+
+    def test_zero_delta_stages_are_not_causes(self):
+        base = _rec({"a": {"wall": 1.0}, "b": {"wall": 2.0}})
+        cand = _rec({"a": {"wall": 1.0}, "b": {"wall": 2.5}})
+        diff = diff_records(cand, base)
+        assert [c["stage"] for c in diff["causes"]] == ["b"]
+        assert "a" in diff["stages"]  # still in the full table
+
+    def test_within_noise_flag_and_top_suspect(self):
+        base = _rec({"a": {"wall": 10.0}, "b": {"wall": 1.0}})
+        cand = _rec({"a": {"wall": 10.3}, "b": {"wall": 2.0}})
+        diff = diff_records(cand, base)
+        by_stage = {c["stage"]: c for c in diff["causes"]}
+        assert by_stage["a"]["within_noise"] is True  # 3 % < 10 % band
+        assert by_stage["b"]["within_noise"] is False
+        # 'b' grew less in absolute terms but is the only out-of-noise
+        # growth — exactly what a FAIL should name
+        assert top_suspect(diff)["stage"] == "b"
+
+    def test_all_within_noise_means_no_suspect(self):
+        base = _rec({"a": {"wall": 10.0}})
+        cand = _rec({"a": {"wall": 10.2}})
+        assert top_suspect(diff_records(cand, base)) is None
+
+
+class TestDeterminism:
+    def test_same_pair_same_diff(self):
+        cand = json.loads(CAND.read_text())
+        base = json.loads(BASE.read_text())
+        d1 = diff_records(copy.deepcopy(cand), copy.deepcopy(base))
+        d2 = diff_records(copy.deepcopy(cand), copy.deepcopy(base))
+        assert json.dumps(d1, sort_keys=True) == json.dumps(
+            d2, sort_keys=True
+        )
+        assert format_report(d1) == format_report(d2)
+
+    def test_headline_and_burndown_on_committed_pair(self):
+        diff = diff_records(json.loads(CAND.read_text()),
+                            json.loads(BASE.read_text()))
+        h = diff["headline"]
+        assert h["unit"] == "seconds" and "delta" in h
+        bd = diff["burndown"]
+        assert bd["candidate_total_bytes"] > 0
+        assert bd["candidate_todo_item2_bytes"] <= \
+            bd["candidate_total_bytes"]
+        report = format_report(diff)
+        assert "perf-diff:" in report and "ranked causes:" in report
+        assert "residency burn-down: total" in report
+        assert "[item-2]" in report
+
+
+class TestPerfDiffCLI:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, str(REPO / "tools" / "perf_diff.py"), *args],
+            capture_output=True, text=True, timeout=120,
+        )
+
+    def test_report_is_deterministic_over_committed_pair(self):
+        a = self._run(str(CAND), str(BASE))
+        b = self._run(str(CAND), str(BASE))
+        assert a.returncode == 0, a.stdout + a.stderr
+        assert a.stdout == b.stdout  # byte-identical, run to run
+        assert f"perf-diff: {CAND.name} vs {BASE.name}" in a.stdout
+        assert "ranked causes:" in a.stdout
+        assert "residency burn-down: total" in a.stdout
+
+    def test_json_mode_round_trips(self):
+        proc = self._run(str(CAND), str(BASE), "--json")
+        assert proc.returncode == 0
+        diff = json.loads(proc.stdout)
+        assert diff["schema"] == "scc-perf-diff"
+        assert diff["candidate"]["label"] == CAND.name
+
+    def test_unreadable_input_exits_2(self, tmp_path):
+        bad = tmp_path / "nope.json"
+        bad.write_text("{}")
+        proc = self._run(str(bad), str(BASE))
+        assert proc.returncode == 2
+        assert "perf_diff" in proc.stderr
+
+
+class TestPerfGateSuspect:
+    def test_smoke_pins_fail_names_top_suspect(self):
+        # the acceptance pin rides perf_gate's own smoke: a synthetic
+        # regressed verdict must print `top suspect: stage ...` and the
+        # annex must be deterministic — both asserted inside --smoke
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "perf_gate.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert ("[smoke] ok   perf-gate FAIL names the top suspect "
+                "stage in its output") in proc.stdout
+        assert ("[smoke] ok   attribution annex is deterministic "
+                "(same pair, same report)") in proc.stdout
+        assert ("[smoke] ok   clean verdict prints no top-suspect "
+                "line") in proc.stdout

@@ -1,0 +1,302 @@
+"""Unified run profile + residency burn-down (ISSUE 18 tentpole): the
+per-stage join of wall/device/FLOPs/transfer signals and the per-boundary
+byte ledger must build from a record's existing sections, validate
+structurally (totals re-checked against rows, boundary names pinned to
+the declared allowlist), ride the run-record schema, render in tail_run,
+and cost nothing but a dict join (overhead pinned inside a noise band)."""
+
+import copy
+import json
+import pathlib
+import time
+
+import pytest
+
+from scconsensus_tpu.obs import export
+from scconsensus_tpu.obs.ledger import Ledger
+from scconsensus_tpu.obs.profile import (
+    ITEM2_BOUNDARIES,
+    build_burndown,
+    build_profile,
+    profile_sections_of,
+    validate_profile,
+    validate_residency_burndown,
+)
+from scconsensus_tpu.obs.residency import BOUNDARIES
+from scconsensus_tpu.obs.trace import Tracer
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+EVIDENCE = REPO / "evidence"
+
+
+def _span(name, wall, kind="stage"):
+    return {"name": name, "kind": kind, "wall_synced_s": wall}
+
+
+def _residency():
+    # real declared boundary names — undeclared ones must not validate
+    return {
+        "by_boundary": {
+            "silhouette_slab_fetch": {"to_host_bytes": 1000,
+                                      "to_device_bytes": 0, "calls": 2},
+            "funnel_counts": {"to_host_bytes": 24,
+                              "to_device_bytes": 8, "calls": 1},
+        },
+        "by_stage": {
+            "silhouette": {"to_host_bytes": 1000, "to_device_bytes": 0,
+                           "calls": 2},
+        },
+    }
+
+
+class TestItem2Allowlist:
+    def test_derived_from_declared_boundaries(self):
+        assert ITEM2_BOUNDARIES <= set(BOUNDARIES)
+        # the device-residency work list: every member's declared
+        # justification carries the marker, every non-member's doesn't
+        for name, why in BOUNDARIES.items():
+            assert (name in ITEM2_BOUNDARIES) == ("TODO(item-2)" in why)
+        assert "silhouette_slab_fetch" in ITEM2_BOUNDARIES
+        assert "funnel_counts" not in ITEM2_BOUNDARIES
+
+
+class TestBuildProfile:
+    def test_joins_all_signals_per_stage(self):
+        spans = [_span("silhouette", 2.0), _span("embed", 1.0),
+                 _span("not_a_stage", 9.0, kind="xfer")]
+        kernels = {"vs_cost_model": {"silhouette": {"device_time_s": 1.5}}}
+        cost = {"silhouette": {"flops": 4e9, "bytes_accessed": 2e8,
+                               "achieved_gflops": 2.0,
+                               "achieved_gbps": 0.1}}
+        sec = build_profile(spans, kernels=kernels, cost=cost,
+                            residency=_residency(),
+                            ceilings={"gflops": 100.0, "gbps": 10.0})
+        validate_profile(sec)
+        row = sec["stages"]["silhouette"]
+        assert row["wall_s"] == 2.0 and row["device_s"] == 1.5
+        assert row["flops"] == 4e9 and row["to_host_bytes"] == 1000
+        assert row["pct_peak_flops"] == 2.0  # 2 / 100 GFLOP/s
+        assert row["pct_peak_bw"] == 1.0
+        # stage with no kernel/cost/transfer signal still gets its wall
+        assert sec["stages"]["embed"] == {"wall_s": 1.0}
+        # non-stage spans never become profile rows
+        assert "not_a_stage" not in sec["stages"]
+        tot = sec["totals"]
+        assert tot["wall_s"] == 3.0 and tot["device_s"] == 1.5
+        assert tot["to_host_bytes"] == 1000
+        bounds = sec["boundaries"]
+        assert bounds["silhouette_slab_fetch"]["todo_item2"] is True
+        assert bounds["funnel_counts"]["todo_item2"] is False
+
+    def test_no_stage_spans_means_no_profile(self):
+        # absence means "no attribution ran" — never a record of zeros
+        assert build_profile([]) is None
+        assert build_profile(None) is None
+        assert build_profile([_span("x", 1.0, kind="xfer")]) is None
+
+    def test_repeated_stage_walls_sum(self):
+        sec = build_profile([_span("de", 1.0), _span("de", 0.5)])
+        assert sec["stages"]["de"]["wall_s"] == 1.5
+
+
+class TestBuildBurndown:
+    def test_rows_and_ratchet_totals(self):
+        bd = build_burndown(_residency())
+        validate_residency_burndown(bd)
+        assert bd["total_bytes"] == 1032
+        assert bd["todo_item2_bytes"] == 1000  # slab fetch only
+        assert bd["n_boundaries"] == 2 and bd["n_todo_item2"] == 1
+        row = bd["boundaries"]["silhouette_slab_fetch"]
+        assert row["bytes"] == 1000 and row["calls"] == 2
+        assert row["todo_item2"] is True
+
+    def test_absent_audit_is_none_not_zero(self):
+        assert build_burndown(None) is None
+        assert build_burndown({}) is None
+        assert build_burndown({"by_boundary": {}}) is None
+
+
+class TestValidators:
+    def _burndown(self):
+        return build_burndown(_residency())
+
+    def test_corrupt_total_rejected(self):
+        bd = self._burndown()
+        bd["total_bytes"] += 1
+        with pytest.raises(ValueError, match="total_bytes disagrees"):
+            validate_residency_burndown(bd)
+
+    def test_corrupt_item2_total_rejected(self):
+        bd = self._burndown()
+        bd["todo_item2_bytes"] = 0
+        with pytest.raises(ValueError, match="todo_item2_bytes disagrees"):
+            validate_residency_burndown(bd)
+
+    def test_undeclared_boundary_rejected(self):
+        bd = self._burndown()
+        bd["boundaries"]["made_up"] = dict(
+            bd["boundaries"]["funnel_counts"]
+        )
+        with pytest.raises(ValueError, match="undeclared boundary"):
+            validate_residency_burndown(bd)
+
+    def test_wrong_item2_flag_rejected(self):
+        bd = self._burndown()
+        bd["boundaries"]["funnel_counts"]["todo_item2"] = True
+        with pytest.raises(ValueError, match="todo_item2 disagrees"):
+            validate_residency_burndown(bd)
+
+    def test_profile_negative_wall_rejected(self):
+        sec = build_profile([_span("de", 1.0)])
+        sec["stages"]["de"]["wall_s"] = -1
+        with pytest.raises(ValueError, match="wall_s"):
+            validate_profile(sec)
+
+    def test_profile_missing_totals_rejected(self):
+        sec = build_profile([_span("de", 1.0)])
+        del sec["totals"]
+        with pytest.raises(ValueError, match="totals"):
+            validate_profile(sec)
+
+
+class TestRunRecordSchema:
+    def _record(self):
+        tr = Tracer(sync="off")
+        with tr.span("silhouette"):
+            pass
+        rec = export.build_run_record("m", 1.0, tracer=tr)
+        rec["residency"] = {
+            "mode": "audit",
+            "to_host": {"calls": 3, "bytes": 1024},
+            "to_device": {"calls": 1, "bytes": 8},
+            "violations": [], **_residency(),
+        }
+        return rec
+
+    def test_sections_attach_and_validate(self):
+        rec = self._record()
+        derived = profile_sections_of(rec)
+        rec2 = export.build_run_record(
+            "m", 1.0,
+            profile=derived["profile"],
+            residency_burndown=derived["residency_burndown"],
+            tunnel={"state": "stale", "age_s": 4000.0,
+                    "last_outcome": "alive"},
+        )
+        export.validate_run_record(rec2)
+        assert rec2["profile"]["stages"]["silhouette"]["wall_s"] >= 0
+        assert rec2["residency_burndown"]["total_bytes"] == 1032
+
+    def test_bad_tunnel_state_rejected(self):
+        rec = export.build_run_record("m", 1.0,
+                                      tunnel={"state": "confused"})
+        with pytest.raises(ValueError, match="tunnel"):
+            export.validate_run_record(rec)
+
+    def test_corrupt_attached_burndown_rejected(self):
+        rec = self._record()
+        bd = profile_sections_of(rec)["residency_burndown"]
+        bd["total_bytes"] += 7
+        rec = export.build_run_record("m", 1.0, residency_burndown=bd)
+        with pytest.raises(ValueError, match="total_bytes disagrees"):
+            export.validate_run_record(rec)
+
+    def test_ledger_ingest_stamps_boundary_bytes(self, tmp_path):
+        rec = self._record()
+        derived = profile_sections_of(rec)
+        rec["residency_burndown"] = derived["residency_burndown"]
+        entry = Ledger(str(tmp_path)).ingest(rec)
+        assert entry["boundary_bytes"] == {
+            "silhouette_slab_fetch": 1000, "funnel_counts": 32,
+        }
+
+    def test_ledger_ingest_falls_back_to_raw_residency(self, tmp_path):
+        # pre-round-22 records (no burndown section) re-ingested by
+        # --reindex still get the stamp from the raw audit aggregate
+        rec = self._record()
+        assert "residency_burndown" not in rec
+        entry = Ledger(str(tmp_path)).ingest(rec)
+        assert entry["boundary_bytes"]["silhouette_slab_fetch"] == 1000
+
+
+class TestCommittedEvidence:
+    """Satellite 5: every section obs/export writes — including the new
+    profile / residency_burndown / tunnel — validates on the evidence
+    records committed to the repo, so a schema change that strands them
+    fails tier-1, not a future re-ingest."""
+
+    RECORDS = sorted(EVIDENCE.glob("RUN_*.json"))
+
+    def test_committed_records_exist(self):
+        assert len(self.RECORDS) >= 2
+
+    @pytest.mark.parametrize(
+        "path", RECORDS, ids=[p.name for p in RECORDS]
+    )
+    def test_every_committed_record_validates(self, path):
+        rec = json.loads(path.read_text())
+        if export.check_schema_version(rec, source=path.name) == "legacy":
+            pytest.skip("legacy record (upgrade path covered elsewhere)")
+        export.validate_run_record(rec)
+
+    def test_derived_sections_validate_on_committed_records(self):
+        derived_any = False
+        for path in self.RECORDS:
+            rec = json.loads(path.read_text())
+            if export.check_schema_version(rec, path.name) == "legacy":
+                continue
+            d = profile_sections_of(rec)
+            if d["profile"] is not None:
+                validate_profile(d["profile"])
+                derived_any = True
+            if d["residency_burndown"] is not None:
+                validate_residency_burndown(d["residency_burndown"])
+        assert derived_any, "no committed record yields a profile"
+
+    def test_derivation_is_deterministic(self):
+        path = self.RECORDS[0]
+        rec = json.loads(path.read_text())
+        a = profile_sections_of(copy.deepcopy(rec))
+        b = profile_sections_of(copy.deepcopy(rec))
+        assert json.dumps(a, sort_keys=True) == json.dumps(
+            b, sort_keys=True
+        )
+
+    def test_attribution_overhead_inside_noise_band(self):
+        # the tentpole's cost contract: the profile join is pure dict
+        # work over already-collected sections. 50 ms (the gate's own
+        # absolute noise floor) is two orders of magnitude of headroom
+        # on a committed record — if this trips, derivation started
+        # doing real work and belongs behind a flag.
+        path = self.RECORDS[0]
+        rec = json.loads(path.read_text())
+        profile_sections_of(rec)  # warm imports
+        t0 = time.perf_counter()
+        for _ in range(10):
+            profile_sections_of(rec)
+        per_call = (time.perf_counter() - t0) / 10
+        assert per_call < 0.05, f"profile join took {per_call:.4f}s"
+
+
+class TestTailRunBurndown:
+    def test_render_shows_burndown_table(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "tail_run", REPO / "tools" / "tail_run.py"
+        )
+        tail_run = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tail_run)
+        partial = {
+            "residency_burndown": build_burndown(_residency()),
+            "spans": [{"name": "silhouette", "kind": "stage",
+                       "wall_synced_s": 1.0, "attrs": {}}],
+        }
+        panel = tail_run.render(
+            [{"kind": "header", "metric": "m", "ts": 0.0}],
+            partial=partial, now=1.0,
+        )
+        assert "residency burn-down: total" in panel
+        assert "silhouette_slab_fetch" in panel
+        assert "[item-2]" in panel
+        assert "funnel_counts" in panel

@@ -169,3 +169,94 @@ class TestSpanDiff:
         off = regress.diff_span_trees(tree(2.0, 2.0), tree(1.0, 1.0), "s")
         assert off["span"] == "bucket"
         assert off["delta_s"] == pytest.approx(2.0)
+
+
+class TestStageTrends:
+    """obs.regress.stage_trends over degenerate ledger histories
+    (ISSUE 18 satellite): single-entry, all-identical, and missing-key
+    histories are first-class — no divide-by-zero anywhere, and a flat
+    series must never be misclassified as drift."""
+
+    def test_single_entry_history_is_flat_zero_slope(self):
+        t = regress.stage_trends([{"stage_walls": {"s": 1.0}}])["s"]
+        assert t["n"] == 1 and t["direction"] == "flat"
+        assert t["slope_s_per_run"] == 0.0 and t["delta_s"] == 0.0
+
+    def test_all_identical_values_are_flat(self):
+        hist = [{"stage_walls": {"s": 2.0}} for _ in range(5)]
+        t = regress.stage_trends(hist)["s"]
+        assert t["direction"] == "flat" and t["slope_s_per_run"] == 0.0
+        assert t["pct"] == 0.0
+
+    def test_jitter_inside_noise_band_is_flat(self):
+        # 4 % endpoint delta < the 10 % relative floor
+        hist = [{"stage_walls": {"s": w}} for w in (1.0, 1.02, 1.04)]
+        assert regress.stage_trends(hist)["s"]["direction"] == "flat"
+
+    def test_real_growth_is_up_with_positive_slope(self):
+        hist = [{"stage_walls": {"s": w}} for w in (1.0, 1.5, 2.0)]
+        t = regress.stage_trends(hist)["s"]
+        assert t["direction"] == "up"
+        assert t["slope_s_per_run"] == pytest.approx(0.5)
+        assert t["pct"] == pytest.approx(100.0)
+
+    def test_shrink_is_down(self):
+        hist = [{"stage_walls": {"s": w}} for w in (2.0, 1.0, 0.5)]
+        assert regress.stage_trends(hist)["s"]["direction"] == "down"
+
+    def test_zero_first_wall_has_no_pct_no_division(self):
+        hist = [{"stage_walls": {"s": w}} for w in (0.0, 1.0)]
+        t = regress.stage_trends(hist)["s"]
+        assert t["pct"] is None and t["direction"] == "up"
+
+    def test_missing_stage_and_backend_keys_skip_not_crash(self):
+        # entries with no stage_walls at all (e.g. a backend that never
+        # stamped them) and entries missing one stage both contribute
+        # nothing — they must not zero-fill the series
+        hist = [
+            {"stage_walls": {"a": 1.0, "b": 1.0}},
+            {"file": "RUN_x.json"},          # no stage_walls key
+            {"stage_walls": None},           # stamped but empty
+            {"stage_walls": {"a": 2.0}},     # 'b' never ran here
+        ]
+        out = regress.stage_trends(hist)
+        assert out["a"]["n"] == 2
+        assert out["b"]["n"] == 1 and out["b"]["direction"] == "flat"
+
+    def test_partials_excluded(self):
+        hist = [
+            {"stage_walls": {"s": 1.0}},
+            {"stage_walls": {"s": 50.0}, "termination": {"cause": "oom"}},
+            {"stage_walls": {"s": 1.0}},
+        ]
+        assert regress.stage_trends(hist)["s"]["n"] == 2
+
+    def test_empty_history(self):
+        assert regress.stage_trends([]) == {}
+
+
+class TestBoundaryBaselines:
+    def test_median_anchor_per_boundary(self):
+        hist = [{"boundary_bytes": {"silhouette_slab_fetch": b}}
+                for b in (100_000.0, 130_000.0, 90_000.0)]
+        b = regress.boundary_baselines(hist)["silhouette_slab_fetch"]
+        assert b["baseline_bytes"] == 100_000 and b["n"] == 3
+        # spread (40 KB) is under the 64 KiB absolute byte floor
+        assert b["band_bytes"] == 64 << 10
+
+    def test_single_entry_and_empty_history(self):
+        out = regress.boundary_baselines(
+            [{"boundary_bytes": {"funnel_counts": 120}}]
+        )
+        assert out["funnel_counts"]["baseline_bytes"] == 120
+        assert out["funnel_counts"]["n"] == 1
+        assert regress.boundary_baselines([]) == {}
+
+    def test_partials_and_unstamped_entries_skip(self):
+        hist = [
+            {"boundary_bytes": {"funnel_counts": 100}},
+            {"boundary_bytes": {"funnel_counts": 9e9},
+             "termination": {"cause": "killed"}},
+            {"file": "RUN_old.json"},  # pre-round-22: no stamp
+        ]
+        assert regress.boundary_baselines(hist)["funnel_counts"]["n"] == 1

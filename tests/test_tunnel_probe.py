@@ -82,3 +82,135 @@ def test_summarize_reads_attempt_records(tmp_path):
     row = next(l for l in proc.stdout.splitlines()
                if l.startswith("TUNNEL_LOG.jsonl"))
     assert "1 alive / 1 down" in row
+
+
+# ---------------------------------------------------------------------------
+# tunnel_status / --status (ISSUE 18 satellite): stale-log detection. The
+# watcher and bench both ask "does TUNNEL_LOG.jsonl carry a FRESH
+# heartbeat" — a log that stopped updating must read `stale`, never
+# `alive`, so a run record missing accelerator evidence names why.
+# ---------------------------------------------------------------------------
+
+import datetime
+import importlib.util
+
+_spec = importlib.util.spec_from_file_location("tunnel_probe_mod", TOOL)
+tunnel_probe = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(tunnel_probe)
+
+
+def _log_line(path, age_s, outcome="alive"):
+    ts = (datetime.datetime.now(datetime.timezone.utc)
+          - datetime.timedelta(seconds=age_s)).isoformat()
+    with open(path, "a") as f:
+        f.write(json.dumps({"ts": ts, "outcome": outcome}) + "\n")
+
+
+class TestTunnelStatus:
+    def test_missing_log(self, tmp_path):
+        st = tunnel_probe.tunnel_status(str(tmp_path / "nope.jsonl"))
+        assert st["state"] == "missing"
+
+    def test_fresh_alive(self, tmp_path):
+        log = tmp_path / "t.jsonl"
+        _log_line(log, age_s=10)
+        st = tunnel_probe.tunnel_status(str(log))
+        assert st["state"] == "alive" and st["last_outcome"] == "alive"
+        assert 0 <= st["age_s"] < 120
+
+    def test_stale_past_threshold(self, tmp_path):
+        log = tmp_path / "t.jsonl"
+        _log_line(log, age_s=7200)  # 2 h old > 1 h default
+        st = tunnel_probe.tunnel_status(str(log))
+        assert st["state"] == "stale" and st["age_s"] > 3600
+
+    def test_threshold_is_tunable(self, tmp_path):
+        log = tmp_path / "t.jsonl"
+        _log_line(log, age_s=120)
+        assert tunnel_probe.tunnel_status(
+            str(log), stale_after_s=60)["state"] == "stale"
+        assert tunnel_probe.tunnel_status(
+            str(log), stale_after_s=600)["state"] == "alive"
+
+    def test_fresh_but_dead_probe(self, tmp_path):
+        log = tmp_path / "t.jsonl"
+        _log_line(log, age_s=5, outcome="timeout")
+        st = tunnel_probe.tunnel_status(str(log))
+        assert st["state"] == "dead" and st["last_outcome"] == "timeout"
+
+    def test_last_valid_line_wins_over_trailing_garbage(self, tmp_path):
+        log = tmp_path / "t.jsonl"
+        _log_line(log, age_s=5)
+        with open(log, "a") as f:
+            f.write("{truncated by a crash\n")
+        assert tunnel_probe.tunnel_status(str(log))["state"] == "alive"
+
+    def test_unparseable_log_is_error_not_alive(self, tmp_path):
+        log = tmp_path / "t.jsonl"
+        log.write_text("not json at all\n")
+        assert tunnel_probe.tunnel_status(str(log))["state"] == "error"
+
+    def test_env_override_path(self, tmp_path, monkeypatch):
+        log = tmp_path / "relocated.jsonl"
+        _log_line(log, age_s=1)
+        monkeypatch.setenv("SCC_TUNNEL_LOG", str(log))
+        st = tunnel_probe.tunnel_status()
+        assert st["state"] == "alive" and st["log"] == str(log)
+
+
+class TestStatusCLI:
+    def test_alive_exits_zero(self, tmp_path):
+        log = tmp_path / "t.jsonl"
+        _log_line(log, age_s=3)
+        env = dict(os.environ, SCC_TUNNEL_LOG=str(log))
+        proc = subprocess.run([sys.executable, TOOL, "--status"],
+                              env=env, capture_output=True, text=True,
+                              timeout=60)
+        assert proc.returncode == 0
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["state"] == "alive"
+
+    def test_stale_exits_nonzero(self, tmp_path):
+        log = tmp_path / "t.jsonl"
+        _log_line(log, age_s=7200)
+        env = dict(os.environ, SCC_TUNNEL_LOG=str(log))
+        proc = subprocess.run(
+            [sys.executable, TOOL, "--status", "--stale-after", "3600"],
+            env=env, capture_output=True, text=True, timeout=60)
+        assert proc.returncode != 0
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["state"] == "stale"
+
+
+class TestBenchStamp:
+    """bench records in no-cpu-fallback mode carry the tunnel verdict —
+    `tunnel: stale` is an explicit recorded fact, not a silent gap."""
+
+    def _bench(self):
+        spec = importlib.util.spec_from_file_location(
+            "bench_mod", str(pathlib.Path(TOOL).parents[1] / "bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_stale_log_stamps_tunnel_stale(self, tmp_path, monkeypatch):
+        log = tmp_path / "t.jsonl"
+        _log_line(log, age_s=7200)
+        monkeypatch.setenv("SCC_TUNNEL_LOG", str(log))
+        monkeypatch.setenv("SCC_BENCH_NO_CPU_FALLBACK", "1")
+        rec = {"extra": {"platform": "cpu"}}
+        self._bench()._stamp_tunnel(rec)
+        assert rec["tunnel"]["state"] == "stale"
+        assert rec["tunnel"]["age_s"] > 3600
+
+    def test_real_accelerator_run_carries_no_stamp(self, monkeypatch):
+        monkeypatch.setenv("SCC_BENCH_NO_CPU_FALLBACK", "1")
+        rec = {"extra": {"platform": "tpu"}}
+        self._bench()._stamp_tunnel(rec)
+        assert "tunnel" not in rec
+
+    def test_intentional_cpu_run_carries_no_stamp(self, monkeypatch):
+        monkeypatch.delenv("SCC_BENCH_NO_CPU_FALLBACK", raising=False)
+        rec = {"extra": {"platform": "cpu"}}
+        self._bench()._stamp_tunnel(rec)
+        assert "tunnel" not in rec

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Structural perf diff of two run records: rank the root causes.
+
+Where ``tools/perf_gate.py`` answers "did THIS run regress against its
+ledger baseline", this answers "what changed between THESE TWO runs" —
+any pair of scc-run-record files (committed evidence, fresh bench
+checkpoints, two backends' captures), no ledger required. The report is
+the obs.attr differential attribution: per-stage wall deltas ranked by
+magnitude, each annotated with its driver (transfer bytes at a declared
+residency boundary, device-kernel time, dispatched FLOPs, or host-side
+by elimination) plus the residency burn-down delta per boundary.
+
+Deterministic by construction: the same two files always print the same
+report (pinned by test), so a report pasted into a PR discussion can be
+reproduced by anyone from the committed records.
+
+Usage: perf_diff.py CANDIDATE.json BASELINE.json [--json] [--max-causes N]
+
+Exit codes: 0 = report printed, 2 = unreadable/legacy input.
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from scconsensus_tpu.obs.attr import (  # noqa: E402
+    diff_records,
+    format_report,
+    top_suspect,
+)
+from scconsensus_tpu.obs.export import check_schema_version  # noqa: E402
+
+
+def _load(path: str) -> dict:
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise SystemExit(f"perf_diff: cannot read {path}: {e}")
+    try:
+        if check_schema_version(rec, source=path) == "legacy":
+            raise ValueError("legacy (pre-schema) record")
+    except ValueError as e:
+        print(f"perf_diff: {path}: {e} — run tools/perf_gate.py "
+              "--upgrade first", file=sys.stderr)
+        raise SystemExit(2)
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="rank the root causes between two run records"
+    )
+    ap.add_argument("candidate", help="the run being explained")
+    ap.add_argument("baseline", help="the run it is compared against")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full diff object instead of text")
+    ap.add_argument("--max-causes", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    cand = _load(args.candidate)
+    base = _load(args.baseline)
+    diff = diff_records(
+        cand, base,
+        candidate_label=os.path.basename(args.candidate),
+        baseline_label=os.path.basename(args.baseline),
+    )
+    if args.json:
+        print(json.dumps(diff, indent=1))
+    else:
+        print(format_report(diff, max_causes=args.max_causes))
+        suspect = top_suspect(diff)
+        if suspect is not None:
+            print(f"top suspect: {suspect['summary']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -11,7 +11,13 @@ Modes:
       banding machinery), or unacknowledged numeric drift; 2 = usage/IO
       error. A wall regression names the offending child span (span-tree
       diff vs the baseline run) and, when XLA cost attribution ran on
-      both sides, the efficiency loss.
+      both sides, the efficiency loss. Every FAIL additionally prints a
+      ``top suspect`` line — the obs.attr differential attribution of
+      the candidate against its key's freshest clean baseline record
+      (stage, wall delta, and the driving signal: transfer bytes at a
+      declared boundary, device time, FLOPs, or host-side). Run
+      ``tools/perf_diff.py`` on the same pair for the full ranked
+      report.
 
   perf_gate.py --smoke
       Self-test against the committed fixture ledger
@@ -137,13 +143,58 @@ def run_gate(candidate_path: str, evidence_dir: str
     return verdict, drifts
 
 
+def attribution_for(candidate_path: str, evidence_dir: str
+                    ) -> Optional[Dict[str, Any]]:
+    """Differential attribution (obs.attr) of a candidate against its
+    key's freshest clean baseline RECORD — the root-cause annex a FAIL
+    prints. Loads the full baseline file (not just the manifest entry)
+    because the diff joins spans + residency + cost; returns None when
+    the key has no usable baseline record. Never raises: attribution is
+    an annex, and an annex failure must not change a verdict."""
+    try:
+        from scconsensus_tpu.obs.attr import diff_records, top_suspect
+        from scconsensus_tpu.obs.ledger import is_partial_entry
+
+        candidate = _load_json(candidate_path)
+        ledger = Ledger(evidence_dir)
+        history = ledger.history(
+            run_key(candidate),
+            exclude_files=[os.path.basename(candidate_path)],
+        )
+        for entry in reversed(history):
+            if is_partial_entry(entry):
+                continue
+            try:
+                rec = ledger.load(entry["file"])
+            except (OSError, ValueError, KeyError):
+                continue
+            if not rec.get("spans"):
+                continue
+            diff = diff_records(
+                candidate, rec,
+                candidate_label=os.path.basename(candidate_path),
+                baseline_label=entry["file"],
+            )
+            return {
+                "baseline_file": entry["file"],
+                "top_suspect": top_suspect(diff),
+                "causes": (diff.get("causes") or [])[:5],
+            }
+    except Exception:
+        pass
+    return None
+
+
 def _report(verdict: regress.GateVerdict, drifts: List[Dict[str, Any]],
-            as_json: bool) -> int:
+            as_json: bool,
+            attribution: Optional[Dict[str, Any]] = None) -> int:
     unacked = [d for d in drifts if not d["acknowledged"]]
     ok = verdict.ok and not unacked
     out = verdict.to_dict()
     out["drift"] = drifts
     out["ok"] = ok
+    if attribution is not None:
+        out["attribution"] = attribution
     if as_json:
         print(json.dumps(out, indent=1))
     else:
@@ -227,6 +278,14 @@ def _report(verdict: regress.GateVerdict, drifts: List[Dict[str, Any]],
             print(f"  drift {d['field']}: pinned={d['pinned']} "
                   f"current={d['current']}  {state}"
                   + (f"  [vs {src}]" if src else ""))
+        if not ok and attribution is not None:
+            suspect = attribution.get("top_suspect")
+            if suspect is not None:
+                print(f"top suspect: {suspect['summary']}  "
+                      f"(vs {attribution['baseline_file']})")
+            else:
+                print("top suspect: none past noise — the FAIL came "
+                      "from a non-wall gate (see verdict lines above)")
         print("PASS" if ok else "FAIL")
     return 0 if ok else 1
 
@@ -808,6 +867,43 @@ def _smoke(fixtures: str, as_json: bool) -> int:
         acct_rejected,
     ))
 
+    # attribution annex (round 22): a FAIL must name its top suspect
+    # stage — the regressed candidate's wilcox_test wall growth,
+    # attributed against the key's freshest clean baseline record — and
+    # the attribution must be deterministic (same pair, same report)
+    import contextlib as _contextlib
+    import io as _io
+
+    attr_fail = attribution_for(
+        os.path.join(fixtures, "candidate_regressed.json"), evidence
+    )
+    buf = _io.StringIO()
+    with _contextlib.redirect_stdout(buf):
+        rc_attr = _report(verdict_r, drifts_r, False, attr_fail)
+    attr_out = buf.getvalue()
+    checks.append((
+        "perf-gate FAIL names the top suspect stage in its output",
+        rc_attr == 1 and attr_fail is not None
+        and (attr_fail.get("top_suspect") or {}).get("stage")
+        == "wilcox_test"
+        and "top suspect: stage `wilcox_test`" in attr_out,
+    ))
+    checks.append((
+        "attribution annex is deterministic (same pair, same report)",
+        attr_fail == attribution_for(
+            os.path.join(fixtures, "candidate_regressed.json"), evidence
+        ),
+    ))
+    # ...and a clean verdict prints no suspect (the annex never runs on
+    # the green path — _report only adds the line on a FAIL)
+    buf2 = _io.StringIO()
+    with _contextlib.redirect_stdout(buf2):
+        rc_clean = _report(verdict, drifts, False, None)
+    checks.append((
+        "clean verdict prints no top-suspect line",
+        rc_clean == 0 and "top suspect" not in buf2.getvalue(),
+    ))
+
     for label, ok in checks:
         print(f"[smoke] {'ok  ' if ok else 'FAIL'} {label}")
     ok_all = all(ok for _, ok in checks)
@@ -854,7 +950,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"perf_gate: {e}", file=sys.stderr)
         return 2
-    return _report(verdict, drifts, args.as_json)
+    # the attribution annex only runs on a failing verdict: a PASS needs
+    # no root cause, and the annex must cost nothing on the green path
+    attribution = None
+    if not (verdict.ok and not [d for d in drifts
+                                if not d["acknowledged"]]):
+        attribution = attribution_for(args.candidate, evidence)
+    return _report(verdict, drifts, args.as_json, attribution)
 
 
 if __name__ == "__main__":

@@ -199,6 +199,25 @@ def _partial_events(path: str, src: str) -> List[Dict[str, Any]]:
             "last_span": term.get("last_span"),
             "stalls": term.get("stall_count"),
         })
+    # round-22 attribution facts: how many bytes the dying run had
+    # already crossed per the burn-down ledger, and whether the
+    # accelerator tunnel was known-dead/stale when it ran — both answer
+    # "why is the accelerator evidence missing from this bundle"
+    bd = rec.get("residency_burndown")
+    if isinstance(bd, dict):
+        events.append({
+            "ts": None, "src": src, "kind": "burndown",
+            "total_bytes": bd.get("total_bytes"),
+            "todo_item2_bytes": bd.get("todo_item2_bytes"),
+            "n_boundaries": bd.get("n_boundaries"),
+        })
+    tun = rec.get("tunnel")
+    if isinstance(tun, dict):
+        events.append({
+            "ts": None, "src": src, "kind": "tunnel",
+            "state": tun.get("state"), "age_s": tun.get("age_s"),
+            "last_outcome": tun.get("last_outcome"),
+        })
     for sp in rec.get("spans") or []:
         if not isinstance(sp, dict):
             continue
@@ -365,7 +384,9 @@ def _fmt_ev(e: Dict[str, Any], t0: float) -> str:
     for k in ("trace_id", "outcome", "status", "attempt", "latency_ms",
               "cause", "replica", "respawned", "drift_fraction",
               "last_span", "wall_s", "action", "from", "to", "reason",
-              "worst_burn", "queue_frac"):
+              "worst_burn", "queue_frac", "total_bytes",
+              "todo_item2_bytes", "n_boundaries", "state", "age_s",
+              "last_outcome"):
         if e.get(k) is not None:
             bits.append(f"{k}={e[k]}")
     if e.get("kind") == "slo_burn":

@@ -475,6 +475,39 @@ def render(lines: List[Dict[str, Any]],
         if walls:
             out.append("  completed stages: " + " | ".join(
                 f"{n} {_fmt_dur(w)}" for n, w in walls[-12:]))
+        # residency burn-down table (round 22): bytes crossed per
+        # declared boundary, TODO(item-2) rows flagged — the ratchet the
+        # device-residency refactor is measured by, rendered live from
+        # the partial record's own section (or derived on the fly from
+        # its residency audit for pre-round-22 checkpoints)
+        bd = partial.get("residency_burndown")
+        if not isinstance(bd, dict):
+            try:
+                from scconsensus_tpu.obs.profile import build_burndown
+
+                bd = build_burndown(partial.get("residency"))
+            except Exception:
+                bd = None
+        if isinstance(bd, dict) and bd.get("boundaries"):
+            out.append(
+                "  residency burn-down: total "
+                f"{_fmt_bytes(bd.get('total_bytes'))} across "
+                f"{bd.get('n_boundaries', 0)} boundaries; TODO(item-2) "
+                f"{_fmt_bytes(bd.get('todo_item2_bytes') or 0) if bd.get('todo_item2_bytes') else '0B'} "
+                f"across {bd.get('n_todo_item2', 0)}"
+            )
+            rows = sorted(
+                bd["boundaries"].items(),
+                key=lambda kv: (-int(kv[1].get("bytes") or 0), kv[0]),
+            )
+            for bname, row in rows[:8]:
+                tag = "  [item-2]" if row.get("todo_item2") else ""
+                out.append(
+                    f"    {bname:<24} {_fmt_bytes(row.get('bytes'))}"
+                    f"  ({row.get('calls', 0)} call(s)){tag}"
+                )
+            if len(rows) > 8:
+                out.append(f"    ... {len(rows) - 8} more boundaries")
         term = partial.get("termination")
         if isinstance(term, dict):
             out.append(f"  partial record: cause={term.get('cause')}"

@@ -44,6 +44,16 @@ all_done() {
 DEADLINE=${SCC_WATCHER_DEADLINE:-0}   # epoch seconds; 0 = no deadline
 while true; do
   rotate "$LOG"
+  # stale-log sentinel (round 22): if the freshest TUNNEL_LOG heartbeat
+  # is older than an hour, say so explicitly — a silent watcher is
+  # indistinguishable from a dead tunnel in the evidence, and bench
+  # stamps `tunnel: stale` on records from the same verdict
+  # (tools/tunnel_probe.py --status).
+  status=$(python tools/tunnel_probe.py --status 2>/dev/null | tail -1)
+  case "$status" in
+    *'"state": "alive"'*) : ;;
+    *) echo "$(date +%H:%M:%S) tunnel status: $status" >> $LOG ;;
+  esac
   for cfg in $CFGS; do rotate "/tmp/tpu_capture_$cfg.out"; done
   if [ "$DEADLINE" -gt 0 ] && [ "$(date +%s)" -ge "$DEADLINE" ]; then
     echo "$(date +%H:%M:%S) DEADLINE reached, exiting" >> $LOG; exit 0

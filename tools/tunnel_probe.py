@@ -198,6 +198,79 @@ def _heartbeat_summary(hb_base: str) -> "dict | None":
     }
 
 
+# A TUNNEL_LOG heartbeat older than this is STALE: the watcher loop
+# probes far more often than hourly, so an hour of silence means the
+# probe lane itself is down (box offline, cron dead), which is a
+# different fact from a probed-and-dead tunnel — and bench records must
+# say which one it was.
+STALE_AFTER_S = 3600.0
+
+
+def tunnel_status(log_path: "str | None" = None,
+                  now: "float | None" = None,
+                  stale_after_s: float = STALE_AFTER_S) -> dict:
+    """Freshness verdict over TUNNEL_LOG.jsonl — the stamp bench puts on
+    run records whenever accelerator evidence is expected but absent
+    (satellite: ``tunnel: stale`` instead of silent omission).
+
+    Returns ``{"state": alive|stale|dead|missing|error, "age_s"?,
+    "last_outcome"?, "log"}``:
+
+    * ``missing`` — no log at all (this host never ran the probe lane);
+    * ``error``   — log exists but no line parses (corrupt tail);
+    * ``stale``   — freshest entry is older than ``stale_after_s``:
+      nothing has even *tried* the tunnel recently, so "no accelerator
+      evidence" is a monitoring gap, not a measured-dead tunnel;
+    * ``dead``    — fresh entry, probe answered dead/timeout/error;
+    * ``alive``   — fresh entry and the probe got a live backend.
+
+    ``SCC_TUNNEL_LOG`` overrides the default log path (tests, hosts
+    with a relocated probe lane).
+    """
+    path = log_path or os.environ.get("SCC_TUNNEL_LOG") \
+        or os.path.join(_REPO, "TUNNEL_LOG.jsonl")
+    out: dict = {"log": path}
+    if not os.path.exists(path):
+        out["state"] = "missing"
+        return out
+    last = None
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and rec.get("ts"):
+                    last = rec
+    except OSError:
+        out["state"] = "error"
+        return out
+    if last is None:
+        out["state"] = "error"
+        return out
+    try:
+        ts = datetime.datetime.fromisoformat(str(last["ts"]))
+        if ts.tzinfo is None:
+            ts = ts.replace(tzinfo=datetime.timezone.utc)
+        age = (now if now is not None else time.time()) - ts.timestamp()
+    except (ValueError, TypeError, OverflowError):
+        out["state"] = "error"
+        return out
+    out["age_s"] = round(max(age, 0.0), 1)
+    out["last_outcome"] = last.get("outcome")
+    if age > stale_after_s:
+        out["state"] = "stale"
+    elif last.get("outcome") == "alive":
+        out["state"] = "alive"
+    else:
+        out["state"] = "dead"
+    return out
+
+
 def _append_log(path: str, record: dict) -> None:
     """One JSON line per attempt; logging failure never kills the probe.
     Rotation: past LOG_CAP_BYTES the log rolls to ``<path>.1`` (one
@@ -262,16 +335,34 @@ def main() -> int:
     ap.add_argument("--timeout", type=float, default=90.0,
                     help="hard per-probe timeout (seconds)")
     ap.add_argument("--attempts", type=int, default=2)
-    ap.add_argument("--log", default=os.path.join(_REPO, "TUNNEL_LOG.jsonl"),
-                    help="attempt-log path ('' disables)")
+    ap.add_argument("--log", default=None,
+                    help="attempt-log path ('' disables; default "
+                         "<repo>/TUNNEL_LOG.jsonl)")
     ap.add_argument("--once", action="store_true",
                     help="run the measurement in-process (child mode)")
+    ap.add_argument("--status", action="store_true",
+                    help="no probe: print the TUNNEL_LOG freshness "
+                         "verdict as JSON (exit 0 only when alive)")
+    ap.add_argument("--stale-after", type=float, default=STALE_AFTER_S,
+                    help="seconds before the last log entry counts as "
+                         "stale (--status mode)")
     ap.add_argument("--hb-base", default="",
                     help="flight-recorder path base for the child probe "
                          "(parent-managed; '' skips the recorder)")
     ap.add_argument("--test-hang-s", type=float, default=0.0,
                     help=argparse.SUPPRESS)  # simulates a wedged backend
     args = ap.parse_args()
+    if args.log is None:
+        # --status leaves None so tunnel_status can honor SCC_TUNNEL_LOG;
+        # probe mode writes the canonical repo log
+        if not args.status:
+            args.log = os.path.join(_REPO, "TUNNEL_LOG.jsonl")
+
+    if args.status:
+        st = tunnel_status(args.log or None,
+                           stale_after_s=args.stale_after)
+        print(json.dumps(st), flush=True)
+        return 0 if st["state"] == "alive" else 1
 
     if args.once:
         print(json.dumps(probe_once(
