@@ -101,6 +101,32 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "the offending span + array name + count into span "
                 "metrics and the run record's quality section. bench.py "
                 "workers and tools/run_sparse_1m.py default it on."),
+        EnvFlag("SCC_HOSTPROF", bool, False,
+                "Host execution profiler (obs.hostprof): a sampling "
+                "stack profiler on the run thread (folded stacks "
+                "bucketed per stage span, classified into python / "
+                "blocking_wait / compile / serialization causes) plus "
+                "gc.callbacks pause accounting and an RSS/HBM memory "
+                "timeline — landed as the run record's host_profile and "
+                "memory_timeline sections. bench.py workers default it "
+                "on."),
+        EnvFlag("SCC_HOSTPROF_HZ", float, 50.0,
+                "Sampling rate (Hz) of the SCC_HOSTPROF stack/memory "
+                "sampler. 50 Hz = one _current_frames walk + one statm "
+                "pread every 20 ms; overhead is pinned under the perf "
+                "gate's 50 ms noise floor by test."),
+        EnvFlag("SCC_COMPILELOG", bool, False,
+                "Per-stage JAX compile/retrace telemetry "
+                "(obs.compilelog): jax.monitoring compile events stamped "
+                "with the ambient stage span and its entry ordinal, "
+                "aggregated (compiles, retraces, cache hits, compile "
+                "wall) into the run record's compile section. bench.py "
+                "workers default it on."),
+        EnvFlag("SCC_COMPILELOG_MAX_EVENTS", int, 65536,
+                "Cap on buffered compile/cache events per process "
+                "(obs.device): past the cap new events are dropped "
+                "rather than grow the buffer unboundedly in a "
+                "pathological retrace storm."),
         # --- tree stage (landmark recluster, ROADMAP item 1) ---
         EnvFlag("SCC_TREE_LANDMARK_THRESHOLD", int, 200_000,
                 "Cell count above which the pooled tree stage switches "

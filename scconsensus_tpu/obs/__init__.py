@@ -42,7 +42,16 @@ Four layers, consumed together through one versioned run-record schema:
     capture window (SCC_OBS_KERNELS) parsed into per-kernel device
     times, joined to tracer spans and the obs.cost FLOPs/bytes model
     as the run record's ``kernels`` section (the roofline-style
-    evidence ROADMAP item 3 gates on).
+    evidence ROADMAP item 3 gates on);
+  * ``obs.hostprof`` — the host execution observatory: a sampling
+    stack profiler bucketed per stage span (python-compute with top
+    frame, blocking-wait, compile, serialization), gc.callbacks pause
+    accounting, and the RSS/HBM memory timeline — the run record's
+    ``host_profile`` and ``memory_timeline`` sections (SCC_HOSTPROF);
+  * ``obs.compilelog`` — per-stage JAX compile/retrace telemetry:
+    jax.monitoring events stamped with the ambient stage and its entry
+    ordinal, aggregated into the run record's ``compile`` section
+    (compiles, retraces, cache hits, compile wall; SCC_COMPILELOG).
 
 ``utils.logging.StageTimer`` remains as a thin back-compat shim over
 ``Tracer``; ``bench.py`` and the ``tools/`` emitters all build their
@@ -62,6 +71,7 @@ from scconsensus_tpu.obs.metrics import MetricSet
 from scconsensus_tpu.obs import quality  # noqa: F401 (after trace: it
 #                                          reads the partially-built pkg)
 from scconsensus_tpu.obs import kernels, residency  # noqa: F401
+from scconsensus_tpu.obs import compilelog, hostprof  # noqa: F401
 from scconsensus_tpu.obs.export import (
     SCHEMA_NAME,
     SCHEMA_VERSION,
@@ -76,6 +86,8 @@ __all__ = [
     "quality",
     "residency",
     "kernels",
+    "hostprof",
+    "compilelog",
     "Span",
     "Tracer",
     "current_tracer",

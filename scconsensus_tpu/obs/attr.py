@@ -23,7 +23,17 @@ is part of the report's determinism contract):
 * ``work`` — cost-model FLOPs grew past noise (more work dispatched:
   shape growth, an extra ladder rung, a redo).
 * ``host`` — wall grew with transfers, device time, and FLOPs flat:
-  host-side time (Python, planning, I/O) by elimination.
+  host-side time (Python, planning, I/O) by elimination. When both
+  records carry the round-19 host-observatory sections
+  (``host_profile`` / ``compile``), the bucket splits into NAMED
+  drivers — claim order ``gc`` (measured collector pauses),
+  ``compile/retrace`` (compile wall + the retrace-count delta),
+  ``blocking-wait`` (``block_until_ready``/transfer waits),
+  ``serialization`` (json/pickle codecs), ``python-compute`` (sampled
+  Python time, with the dominant frame named) — and the cause keeps
+  "host-side" in its summary so downstream grep contracts hold.
+  Pre-19 records without the sections keep the plain ``host`` driver
+  (attribution stays version-tolerant).
 
 Consumers: ``tools/perf_diff.py`` (CLI over any two records),
 ``tools/perf_gate.py`` (every FAIL names its top suspect), and
@@ -50,6 +60,19 @@ __all__ = [
 
 DIFF_SCHEMA = "scc-perf-diff"
 DIFF_VERSION = 1
+
+# Internal host-cause keys (host_profile.stages[*].causes spelling) in
+# claim order, and their report driver names. Order is part of the
+# determinism contract: on an exact tie the earlier cause wins.
+_HOST_CAUSE_KEYS = ("gc", "compile", "blocking_wait", "serialization",
+                    "python")
+_HOST_DRIVER_NAMES = {
+    "gc": "gc",
+    "compile": "compile/retrace",
+    "blocking_wait": "blocking-wait",
+    "serialization": "serialization",
+    "python": "python-compute",
+}
 
 
 def _fmt_bytes(n: float) -> str:
@@ -115,6 +138,127 @@ def _boundary_deltas(cand_bd: Optional[Dict[str, Any]],
     return out
 
 
+def _host_cause_rows(rec: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Per-stage host-cause seconds from a record's round-19 sections:
+    ``{stage: {gc, compile, blocking_wait, serialization, python,
+    _retraces, _top_frame?}}``. Empty for pre-19 records (no sections)
+    — the caller falls back to the undifferentiated host driver.
+    Every field read is guarded: a malformed or future-shaped section
+    degrades to zeros, never raises out of a diff."""
+    out: Dict[str, Dict[str, Any]] = {}
+
+    def _row(stage: str) -> Dict[str, Any]:
+        return out.setdefault(
+            stage, {k: 0.0 for k in _HOST_CAUSE_KEYS} | {"_retraces": 0}
+        )
+
+    hp = rec.get("host_profile")
+    if isinstance(hp, dict):
+        for stage, srow in (hp.get("stages") or {}).items():
+            if not isinstance(srow, dict):
+                continue
+            row = _row(stage)
+            causes = srow.get("causes") or {}
+            for k in _HOST_CAUSE_KEYS:
+                v = causes.get(k) if isinstance(causes, dict) else None
+                if isinstance(v, (int, float)) and v > 0:
+                    row[k] += float(v)
+            tf = srow.get("top_frame")
+            if isinstance(tf, str) and tf:
+                row["_top_frame"] = tf
+    comp = rec.get("compile")
+    if isinstance(comp, dict):
+        for stage, crow in (comp.get("by_stage") or {}).items():
+            if not isinstance(crow, dict):
+                continue
+            row = _row(stage)
+            t = crow.get("total_s")
+            if isinstance(t, (int, float)) and t > 0:
+                # measured compile wall wins over the sampler's estimate
+                # of the same seconds (max, not sum: one wall, two
+                # instruments)
+                row["compile"] = max(row["compile"], float(t))
+            r = crow.get("retraces")
+            if isinstance(r, int) and r > 0:
+                row["_retraces"] += r
+    return out
+
+
+def _split_host_cause(head: str, cause: Dict[str, Any],
+                      host_cand: Optional[Dict[str, Any]],
+                      host_base: Optional[Dict[str, Any]]
+                      ) -> Optional[Dict[str, Any]]:
+    """Name the dominant host cause of a stage's wall growth from both
+    records' per-stage cause seconds. None when neither record carries
+    host-observatory data for the stage or no cause's delta clears the
+    absolute noise floor — the caller keeps the legacy host driver."""
+    if not host_cand and not host_base:
+        return None
+    hc = host_cand or {}
+    hb = host_base or {}
+    best_key: Optional[str] = None
+    best_delta = ABS_NOISE_FLOOR_S
+    for k in _HOST_CAUSE_KEYS:
+        d = float(hc.get(k) or 0.0) - float(hb.get(k) or 0.0)
+        if d > best_delta:
+            best_key, best_delta = k, d
+    if best_key is None:
+        return None
+    cause["driver"] = _HOST_DRIVER_NAMES[best_key]
+    cause["delta_host_cause_s"] = round(best_delta, 6)
+    if best_key == "gc":
+        detail = f"{best_delta:+.3f} s GC pauses"
+    elif best_key == "compile":
+        dr = int(hc.get("_retraces") or 0) - int(hb.get("_retraces") or 0)
+        cause["delta_retraces"] = dr
+        detail = f"{best_delta:+.3f} s compile/retrace"
+        if dr > 0:
+            detail += f" (+{dr} retrace{'s' if dr != 1 else ''})"
+    elif best_key == "blocking_wait":
+        detail = (f"{best_delta:+.3f} s blocking waits "
+                  "(block_until_ready/transfers)")
+    elif best_key == "serialization":
+        detail = f"{best_delta:+.3f} s serialization"
+    else:
+        detail = f"{best_delta:+.3f} s python compute"
+        frame = hc.get("_top_frame")
+        if isinstance(frame, str) and frame:
+            cause["frame"] = frame
+            detail += f" at `{frame}`"
+    cause["summary"] = f"{head}, host-side driven by {detail}"
+    return cause
+
+
+def _compile_delta(candidate: Dict[str, Any], baseline: Dict[str, Any]
+                   ) -> Optional[Dict[str, Any]]:
+    """Record-level compile-telemetry delta (None when neither record
+    carries a ``compile`` section)."""
+    c, b = candidate.get("compile"), baseline.get("compile")
+    if not isinstance(c, dict) and not isinstance(b, dict):
+        return None
+    c = c if isinstance(c, dict) else {}
+    b = b if isinstance(b, dict) else {}
+
+    def _i(d: Dict[str, Any], k: str) -> int:
+        v = d.get(k)
+        return int(v) if isinstance(v, int) else 0
+
+    def _f(d: Dict[str, Any], k: str) -> float:
+        v = d.get(k)
+        return float(v) if isinstance(v, (int, float)) else 0.0
+
+    return {
+        "candidate_retraces": _i(c, "retraces"),
+        "baseline_retraces": _i(b, "retraces"),
+        "delta_compiles": _i(c, "compiles") - _i(b, "compiles"),
+        "delta_retraces": _i(c, "retraces") - _i(b, "retraces"),
+        "delta_cache_hits": _i(c, "cache_hits") - _i(b, "cache_hits"),
+        "delta_wall_s": round(
+            _f(c, "compile_wall_s") - _f(b, "compile_wall_s"), 6
+        ),
+    }
+
+
 def _transfer_driver(boundaries: Dict[str, Dict[str, Any]],
                      direction_key: str
                      ) -> Optional[Tuple[str, int]]:
@@ -141,6 +285,8 @@ def diff_records(candidate: Dict[str, Any], baseline: Dict[str, Any],
     cs, bs = cand_p.get("stages") or {}, base_p.get("stages") or {}
     boundaries = _boundary_deltas(_burndown_of(candidate),
                                   _burndown_of(baseline))
+    host_cand = _host_cause_rows(candidate)
+    host_base = _host_cause_rows(baseline)
 
     stages: Dict[str, Dict[str, Any]] = {}
     for name in sorted(set(cs) | set(bs)):
@@ -185,7 +331,8 @@ def diff_records(candidate: Dict[str, Any], baseline: Dict[str, Any],
     for name, row in ranked:
         if row["delta_wall_s"] == 0 and row["only_in"] is None:
             continue
-        cause = _classify(name, row, boundaries)
+        cause = _classify(name, row, boundaries,
+                          host_cand.get(name), host_base.get(name))
         cause["rank"] = len(causes) + 1
         causes.append(cause)
 
@@ -229,11 +376,15 @@ def diff_records(candidate: Dict[str, Any], baseline: Dict[str, Any],
         "stages": stages,
         "boundaries": boundaries,
         "burndown": burndown,
+        "compile": _compile_delta(candidate, baseline),
     }
 
 
 def _classify(name: str, row: Dict[str, Any],
-              boundaries: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+              boundaries: Dict[str, Dict[str, Any]],
+              host_cand: Optional[Dict[str, Any]] = None,
+              host_base: Optional[Dict[str, Any]] = None
+              ) -> Dict[str, Any]:
     """One cause entry for a stage delta: driver + human summary. Only
     wall *growth* gets a root-cause claim; shrinkage and stages unique
     to one record are reported as what they are."""
@@ -302,6 +453,9 @@ def _classify(name: str, row: Dict[str, Any],
             )
             return cause
 
+    split = _split_host_cause(head, cause, host_cand, host_base)
+    if split is not None:
+        return split
     cause["driver"] = "host"
     cause["summary"] = (
         f"{head}, host-side (transfers, device time, and FLOPs flat)"
@@ -350,6 +504,16 @@ def format_report(diff: Dict[str, Any], max_causes: int = 10) -> str:
                          "threshold")
     else:
         lines.append("ranked causes: none (no stage walls differ)")
+    comp = diff.get("compile")
+    if comp:
+        rt = f" ({comp['candidate_retraces']} vs " \
+             f"{comp['baseline_retraces']} retraces)"
+        lines.append(
+            f"compile: {comp['delta_compiles']:+d} compiles, "
+            f"{comp['delta_retraces']:+d} retraces{rt}, "
+            f"{comp['delta_cache_hits']:+d} cache hits, "
+            f"{comp['delta_wall_s']:+.3f} s compile wall"
+        )
     bd = diff.get("burndown")
     if bd:
         lines.append(

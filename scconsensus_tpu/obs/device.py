@@ -30,6 +30,9 @@ __all__ = [
     "install_compile_listener",
     "compile_mark",
     "compile_stats",
+    "compile_events",
+    "cache_mark",
+    "cache_events",
     "TransferWatch",
 ]
 
@@ -40,11 +43,18 @@ __all__ = [
 
 def _backend_initialized() -> bool:
     """Whether some jax backend has ALREADY initialized (without
-    triggering one). Best-effort over a private registry; unknown jax
-    internals degrade to True (the pre-guard behavior)."""
+    triggering one). Reads sys.modules only — never an import: the
+    hostprof sampler thread calls this every tick, and an off-thread
+    ``from jax._src import xla_bridge`` racing the main thread's own
+    in-progress jax import corrupts the partially-initialized module
+    graph. Best-effort; unknown jax internals degrade to True (the
+    pre-guard behavior)."""
     try:
-        from jax._src import xla_bridge
+        import sys
 
+        xla_bridge = sys.modules.get("jax._src.xla_bridge")
+        if xla_bridge is None:
+            return False  # bridge never imported: no backend is up
         return bool(getattr(xla_bridge, "_backends", None))
     except Exception:
         return True
@@ -145,21 +155,91 @@ def host_peak_rss_bytes() -> Optional[int]:
 # --------------------------------------------------------------------------
 
 _COMPILE_LOCK = threading.Lock()
-_COMPILE_EVENTS: List[Tuple[str, float]] = []
+# Rich events are (name, secs, stage|None, stage_entry_ordinal); legacy
+# writers (and older tests) still append bare (name, secs) 2-tuples, so
+# every consumer unpacks with tolerance. Stage/ordinal come from
+# trace.ambient_stage() at capture time — jax.monitoring hands us no
+# function identity, so WHERE (which open stage, which entry of it) is
+# the join key the compile section is built on.
+_COMPILE_EVENTS: List[Tuple] = []
+_CACHE_EVENTS: List[Tuple] = []  # compilation-cache-hit plain events
 _LISTENER_STATE = {"installed": None}  # None = not attempted yet
+
+_EVENT_CAP = {"v": None}  # lazily resolved SCC_COMPILELOG_MAX_EVENTS
+
+
+def _norm_key(k: str) -> str:
+    # obs.cost's spelling-drift armor: lowercase, collapse non-alnum
+    # runs to one underscore
+    out: List[str] = []
+    for ch in str(k).strip().lower():
+        if ch.isalnum():
+            out.append(ch)
+        elif not out or out[-1] != "_":
+            out.append("_")
+    return "".join(out).strip("_")
+
+
+def _event_cap() -> int:
+    if _EVENT_CAP["v"] is None:
+        try:
+            from scconsensus_tpu.config import env_flag
+
+            _EVENT_CAP["v"] = int(
+                env_flag("SCC_COMPILELOG_MAX_EVENTS") or 65536
+            )
+        except Exception:
+            _EVENT_CAP["v"] = 65536
+    return _EVENT_CAP["v"]
+
+
+def _ambient_stage() -> Tuple[Optional[str], int]:
+    try:
+        from scconsensus_tpu.obs.trace import ambient_stage
+
+        return ambient_stage()
+    except Exception:
+        return (None, 0)
 
 
 def _on_duration(event: str, duration: float, **kw) -> None:
     # jax emits many duration events; keep only compilation-shaped ones
-    # ('/jax/core/compile/...', backend_compile, pjit compilation, ...)
-    if "compil" in event:
+    # ('/jax/core/compile/...', backend_compile, pjit compilation, ...).
+    # Version-tolerant: the raw substring check is backed by the
+    # normalized spelling, so a jax upgrade respelling the event family
+    # ('backendCompile', 'Compilation') cannot silently zero the section.
+    name = str(event)
+    norm = _norm_key(name)
+    if "compil" not in name and "compil" not in norm:
+        return
+    # derived savings metrics are not wall time spent — jax's
+    # compile_time_saved_sec can even go NEGATIVE (cache retrieval
+    # slower than the compile it replaced) and would corrupt the
+    # section's wall sum; real durations are never negative either
+    if "saved" in norm or float(duration) < 0:
+        return
+    stage, occ = _ambient_stage()
+    with _COMPILE_LOCK:
+        if len(_COMPILE_EVENTS) < _event_cap():
+            _COMPILE_EVENTS.append((name, float(duration), stage, occ))
+
+
+def _on_event(event: str, **kw) -> None:
+    # plain (durationless) events: keep compilation-cache hits
+    # ('/jax/compilation_cache/compile_requests_use_cache' on jax 0.4;
+    # normalized match for future respellings)
+    norm = _norm_key(event)
+    if "cache" in norm and ("compil" in norm or "use_cache" in norm):
+        stage, occ = _ambient_stage()
         with _COMPILE_LOCK:
-            _COMPILE_EVENTS.append((event, float(duration)))
+            if len(_CACHE_EVENTS) < _event_cap():
+                _CACHE_EVENTS.append((str(event), stage, occ))
 
 
 def install_compile_listener() -> bool:
-    """Register the compile-duration listener once per process. Returns
-    whether a listener is active (False on jax builds without
+    """Register the compile-duration listener (plus the cache-hit plain
+    event listener, best-effort) once per process. Returns whether the
+    duration listener is active (False on jax builds without
     ``jax.monitoring`` duration listeners). Never the first jax touch: if
     jax has not been imported yet the attempt is deferred (not cached), so
     a later tracer created after jax is up still installs it."""
@@ -177,6 +257,13 @@ def install_compile_listener() -> bool:
             _LISTENER_STATE["installed"] = True
         except Exception:
             _LISTENER_STATE["installed"] = False
+        if _LISTENER_STATE["installed"]:
+            try:
+                from jax import monitoring
+
+                monitoring.register_event_listener(_on_event)
+            except Exception:
+                pass  # cache hits degrade to 0; compiles still counted
         return _LISTENER_STATE["installed"]
 
 
@@ -192,17 +279,39 @@ def compile_stats(since: int = 0) -> Dict[str, Any]:
     with _COMPILE_LOCK:
         events = _COMPILE_EVENTS[since:]
     by_event: Dict[str, Dict[str, float]] = {}
-    for name, secs in events:
-        rec = by_event.setdefault(name, {"n": 0, "total_s": 0.0})
+    for ev in events:
+        rec = by_event.setdefault(ev[0], {"n": 0, "total_s": 0.0})
         rec["n"] += 1
-        rec["total_s"] += secs
+        rec["total_s"] += ev[1]
     for rec in by_event.values():
         rec["total_s"] = round(rec["total_s"], 4)
     return {
         "events": len(events),
-        "total_s": round(sum(s for _, s in events), 4),
+        "total_s": round(sum(ev[1] for ev in events), 4),
         "by_event": by_event,
     }
+
+
+def compile_events(since: int = 0) -> List[Tuple]:
+    """Raw compile-event tuples after ``since``: ``(name, secs, stage,
+    entry_ordinal)`` (legacy appenders may have left bare 2-tuples —
+    consumers unpack with tolerance). obs.compilelog builds the run
+    record's ``compile`` section from these."""
+    with _COMPILE_LOCK:
+        return list(_COMPILE_EVENTS[since:])
+
+
+def cache_mark() -> int:
+    """Opaque position in the compilation-cache-hit event stream."""
+    with _COMPILE_LOCK:
+        return len(_CACHE_EVENTS)
+
+
+def cache_events(since: int = 0) -> List[Tuple]:
+    """Raw cache-hit tuples ``(name, stage, entry_ordinal)`` after
+    ``since``."""
+    with _COMPILE_LOCK:
+        return list(_CACHE_EVENTS[since:])
 
 
 # --------------------------------------------------------------------------

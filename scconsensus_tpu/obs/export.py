@@ -131,6 +131,34 @@ schema ``scc-run-record`` version 1 — top-level keys:
                     run without no-cpu-fallback mode); presence makes
                     "accelerator evidence missing" an explicit,
                     greppable fact instead of a silent omission.
+  host_profile      OPTIONAL (still schema version 1 — additive, round
+                    19): the host execution profile (obs.hostprof) —
+                    sampled stacks bucketed per stage span and
+                    classified into named host causes (python-compute
+                    with top frame, blocking_wait, compile,
+                    serialization) plus gc.callbacks pause accounting
+                    (with the explicit "(outside spans)" bucket) and
+                    the sampler's own self-time. Presence means the
+                    profiler RAN (zero samples included); absence
+                    means it never ran — a present-but-null value is
+                    rejected. Validated by
+                    obs.hostprof.validate_host_profile.
+  compile           OPTIONAL (still schema version 1 — additive, round
+                    19): per-stage JAX compile/retrace telemetry
+                    (obs.compilelog) — compiles, traces, retraces
+                    (trace-shaped events on a stage's second-or-later
+                    entry), compilation-cache hits, compile wall, and
+                    per-event / per-stage breakdowns. Distinct from
+                    the legacy flat device.compile aggregate, which is
+                    unchanged. Validated by
+                    obs.compilelog.validate_compile.
+  memory_timeline   OPTIONAL (still schema version 1 — additive, round
+                    19): the unified memory timeline (obs.hostprof) —
+                    downsampled host-RSS (and, when a backend is up,
+                    HBM bytes_in_use) samples laid over the stage
+                    timeline, with peak bytes and per-stage RSS
+                    first/peak/last/delta. Validated by
+                    obs.hostprof.validate_memory_timeline.
   integrity         OPTIONAL (still schema version 1 — additive): the
                     computation-integrity trail (robust.integrity,
                     round 18) — invariant checks planned/run/passed
@@ -222,6 +250,9 @@ def build_run_record(
     profile: Optional[Dict[str, Any]] = None,
     residency_burndown: Optional[Dict[str, Any]] = None,
     tunnel: Optional[Dict[str, Any]] = None,
+    host_profile: Optional[Dict[str, Any]] = None,
+    compile: Optional[Dict[str, Any]] = None,
+    memory_timeline: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One schema-v1 run record. Pass ``tracer`` to take spans + compile
     stats from it; or pre-built ``spans`` (e.g. a resumed pipeline's
@@ -241,7 +272,11 @@ def build_run_record(
     (serve.fleet.loadgen); ``profile`` / ``residency_burndown``
     (optional) attach the obs.profile unified stage profile and
     residency burn-down ledger; ``tunnel`` (optional) attaches the
-    accelerator-tunnel health stamp (tools.tunnel_probe status)."""
+    accelerator-tunnel health stamp (tools.tunnel_probe status);
+    ``host_profile`` / ``compile`` / ``memory_timeline`` (optional)
+    attach the round-19 host execution observatory sections
+    (obs.hostprof sampled stacks + GC pauses, obs.compilelog
+    compile/retrace counters, and the RSS/HBM timeline)."""
     if spans is None:
         spans = tracer.span_records() if tracer is not None else []
     extra = dict(extra or {})
@@ -295,6 +330,12 @@ def build_run_record(
         rec["residency_burndown"] = residency_burndown
     if tunnel is not None:
         rec["tunnel"] = tunnel
+    if host_profile is not None:
+        rec["host_profile"] = host_profile
+    if compile is not None:
+        rec["compile"] = compile
+    if memory_timeline is not None:
+        rec["memory_timeline"] = memory_timeline
     return rec
 
 
@@ -459,6 +500,31 @@ def validate_run_record(rec: Dict[str, Any]) -> None:
         if age is not None and (not isinstance(age, (int, float))
                                 or age < 0):
             raise ValueError("tunnel.age_s must be a number >= 0")
+    # round-19 host-observatory sections: absence is the marker for "the
+    # instrument never ran" — a present-but-null key would make absence
+    # ambiguous, so it is rejected outright
+    for key in ("host_profile", "compile", "memory_timeline"):
+        if key in rec and rec[key] is None:
+            raise ValueError(
+                f"{key} must be omitted when absent, not null"
+            )
+    hp = rec.get("host_profile")
+    if hp is not None:
+        # jax-free import (obs.hostprof's module level is stdlib-only)
+        from scconsensus_tpu.obs.hostprof import validate_host_profile
+
+        validate_host_profile(hp)
+    comp = rec.get("compile")
+    if comp is not None:
+        # jax-free import (obs.compilelog aggregates captured tuples)
+        from scconsensus_tpu.obs.compilelog import validate_compile
+
+        validate_compile(comp)
+    mt = rec.get("memory_timeline")
+    if mt is not None:
+        from scconsensus_tpu.obs.hostprof import validate_memory_timeline
+
+        validate_memory_timeline(mt)
 
 
 # --------------------------------------------------------------------------

@@ -32,7 +32,7 @@ import threading
 import time
 import weakref
 from contextlib import contextmanager
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from scconsensus_tpu.config import env_flag
 
@@ -42,6 +42,7 @@ __all__ = [
     "span",
     "current_tracer",
     "current_span",
+    "ambient_stage",
     "last_tracer",
     "device_drain",
     "summarize_record",
@@ -291,6 +292,11 @@ class Tracer:
         self.last_transition_unix = time.time()
         self._stack: List[Span] = []
         self._ids = itertools.count()
+        # per-stage-name entry counts: the Nth time a stage span named X
+        # opens, _stage_entries[X] == N. The compile log keys retraces on
+        # this ordinal — a trace-shaped event inside entry >= 2 of a stage
+        # means the jit cache missed on a shape it had already seen.
+        self._stage_entries: Dict[str, int] = {}
         self._lock = threading.Lock()
         global _LAST_TRACER
         _LAST_TRACER = weakref.ref(self)
@@ -327,6 +333,9 @@ class Tracer:
                 len(self._stack), kind, dict(attrs),
             )
             self._stack.append(sp)
+            if kind == "stage":
+                self._stage_entries[name] = \
+                    self._stage_entries.get(name, 0) + 1
             self.last_transition_unix = time.time()
         do_sync = self._should_sync(kind, sync)
         ann = None
@@ -498,6 +507,28 @@ def current_span() -> Optional[Span]:
         return None
     with tr._lock:
         return tr._stack[-1] if tr._stack else None
+
+
+def ambient_stage() -> Tuple[Optional[str], int]:
+    """``(stage_name, entry_ordinal)`` of the innermost open stage-kind
+    span, or ``(None, 0)`` with no stage open. Contextvar-first with the
+    :func:`last_tracer` fallback, so off-thread observers (the hostprof
+    sampler, jax.monitoring listeners firing on whichever thread jax
+    compiles from, gc callbacks) resolve the same stage the run thread
+    is in. Thread-safe; never raises."""
+    tr = _ACTIVE.get()
+    if tr is None:
+        tr = last_tracer()
+    if tr is None:
+        return (None, 0)
+    try:
+        with tr._lock:
+            for s in reversed(tr._stack):
+                if s.kind == "stage":
+                    return (s.name, tr._stage_entries.get(s.name, 1))
+    except Exception:
+        pass
+    return (None, 0)
 
 
 @contextmanager

@@ -199,3 +199,28 @@ class TestCommittedEvidence:
             validate_run_record(rec)
             if e["source"] == "legacy-upgrade":
                 assert isinstance(downgrade_legacy(rec), dict)
+
+    def test_host_observatory_sections_lint(self):
+        """Round-19 schema lint (ISSUE 19 satellite): every committed
+        record either omits the host-observatory sections entirely
+        (pre-19 history — explicit absence) or carries truthy dicts
+        that survive section validation; the demo trio carries all
+        three."""
+        led = Ledger(str(REPO / "evidence"))
+        full = 0
+        for e in led.entries():
+            rec = led.load(e["file"])
+            present = 0
+            for key in ("host_profile", "compile", "memory_timeline"):
+                if key in rec:
+                    assert isinstance(rec[key], dict) and rec[key], (
+                        f"{e['file']}: {key} present but not a truthy "
+                        "dict — null/empty sections are forbidden"
+                    )
+                    present += 1
+            if present == 3:
+                full += 1
+        assert full >= 3, (
+            "the committed hostprofdemo trio (all three sections) "
+            "went missing"
+        )

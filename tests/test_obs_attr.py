@@ -11,6 +11,8 @@ import pathlib
 import subprocess
 import sys
 
+import pytest
+
 from scconsensus_tpu.obs.attr import (
     diff_records,
     format_report,
@@ -22,6 +24,10 @@ EVIDENCE = REPO / "evidence"
 # the README's worked example — both committed, same config fingerprint
 CAND = EVIDENCE / "RUN_quick_cpu_dc28fb1eb588_1785744955.json"
 BASE = EVIDENCE / "RUN_quick_cpu_dc28fb1eb588_1785741543.json"
+# the round-19 host-observatory demo trio (tools/make_hostprof_demo.py)
+DEMO_BASE = EVIDENCE / "RUN_hostprofdemo_cpu_9629c861f138_1786000001.json"
+DEMO_GC = EVIDENCE / "RUN_hostprofdemo_cpu_9629c861f138_1786000002.json"
+DEMO_RETRACE = EVIDENCE / "RUN_hostprofdemo_cpu_9629c861f138_1786000003.json"
 
 
 def _rec(stages, residency_by_boundary=None, value=1.0):
@@ -220,3 +226,176 @@ class TestPerfGateSuspect:
                 "(same pair, same report)") in proc.stdout
         assert ("[smoke] ok   clean verdict prints no top-suspect "
                 "line") in proc.stdout
+
+
+# --------------------------------------------------------------------------
+# round 19: the host-side bucket split into named causes
+# --------------------------------------------------------------------------
+
+def _host_sections(rec, stage, causes=None, top_frame=None,
+                   compile_by_stage=None, compile_totals=None):
+    """Attach minimal round-19 sections to a `_rec` record."""
+    if causes is not None:
+        srow = {"samples": 1, "causes": causes, "est_s": 0.0}
+        if top_frame:
+            srow["top_frame"] = top_frame
+        rec["host_profile"] = {"version": 1, "stages": {stage: srow}}
+    comp = {}
+    if compile_by_stage is not None:
+        comp["by_stage"] = {stage: compile_by_stage}
+    if compile_totals is not None:
+        comp.update(compile_totals)
+    if comp:
+        rec["compile"] = comp
+    return rec
+
+
+class TestHostCauseSplit:
+    """The legacy `host` driver splits into named causes when both (or
+    either) record carries host-observatory sections."""
+
+    def _pair(self, base_wall=1.0, cand_wall=3.0):
+        base = _rec({"embed": {"wall": base_wall, "device": 0.1,
+                               "flops": 1e9}})
+        cand = _rec({"embed": {"wall": cand_wall, "device": 0.1,
+                               "flops": 1e9}})
+        return cand, base
+
+    def test_gc_driver_names_the_pause_delta(self):
+        cand, base = self._pair()
+        _host_sections(base, "embed", causes={"gc": 0.1})
+        _host_sections(cand, "embed", causes={"gc": 1.5})
+        cause = diff_records(cand, base)["causes"][0]
+        assert cause["driver"] == "gc"
+        assert cause["delta_host_cause_s"] == pytest.approx(1.4)
+        assert "host-side driven by +1.400 s GC pauses" in cause["summary"]
+
+    def test_compile_driver_counts_retraces(self):
+        cand, base = self._pair()
+        _host_sections(base, "embed", compile_by_stage={
+            "events": 1, "compiles": 0, "retraces": 0, "total_s": 0.1})
+        _host_sections(cand, "embed", compile_by_stage={
+            "events": 6, "compiles": 3, "retraces": 5, "total_s": 1.3})
+        cause = diff_records(cand, base)["causes"][0]
+        assert cause["driver"] == "compile/retrace"
+        assert cause["delta_retraces"] == 5
+        assert "+1.200 s compile/retrace (+5 retraces)" in cause["summary"]
+
+    def test_python_driver_names_the_frame(self):
+        cand, base = self._pair()
+        _host_sections(base, "embed", causes={"python": 0.5})
+        _host_sections(cand, "embed", causes={"python": 2.4},
+                       top_frame="engine.py:rank_chunk:142")
+        cause = diff_records(cand, base)["causes"][0]
+        assert cause["driver"] == "python-compute"
+        assert cause["frame"] == "engine.py:rank_chunk:142"
+        assert "at `engine.py:rank_chunk:142`" in cause["summary"]
+
+    def test_blocking_wait_driver(self):
+        cand, base = self._pair()
+        _host_sections(base, "embed", causes={"blocking_wait": 0.1})
+        _host_sections(cand, "embed", causes={"blocking_wait": 1.9})
+        cause = diff_records(cand, base)["causes"][0]
+        assert cause["driver"] == "blocking-wait"
+        assert "blocking waits" in cause["summary"]
+
+    def test_tie_keeps_the_earlier_claim_order_key(self):
+        # gc and python grew by the same 1.0 s: gc claims first
+        cand, base = self._pair()
+        _host_sections(base, "embed", causes={"gc": 0.0, "python": 0.0})
+        _host_sections(cand, "embed", causes={"gc": 1.0, "python": 1.0})
+        assert diff_records(cand, base)["causes"][0]["driver"] == "gc"
+
+    def test_below_floor_falls_back_to_legacy_host(self):
+        # causes present but no delta clears the 50 ms floor
+        cand, base = self._pair()
+        _host_sections(base, "embed", causes={"gc": 0.10})
+        _host_sections(cand, "embed", causes={"gc": 0.12})
+        cause = diff_records(cand, base)["causes"][0]
+        assert cause["driver"] == "host"
+        assert "host-side" in cause["summary"]
+
+    def test_one_sided_sections_still_split(self):
+        # baseline is a pre-19 record: the candidate's own measured
+        # causes still name the driver (base reads as zeros)
+        cand, base = self._pair()
+        _host_sections(cand, "embed", causes={"gc": 1.5})
+        assert diff_records(cand, base)["causes"][0]["driver"] == "gc"
+
+    def test_record_level_compile_delta_block(self):
+        cand, base = self._pair()
+        _host_sections(base, "embed", compile_totals={
+            "compiles": 1, "retraces": 0, "cache_hits": 4,
+            "compile_wall_s": 0.2})
+        _host_sections(cand, "embed", compile_totals={
+            "compiles": 7, "retraces": 6, "cache_hits": 1,
+            "compile_wall_s": 1.4})
+        diff = diff_records(cand, base)
+        comp = diff["compile"]
+        assert comp["delta_compiles"] == 6
+        assert comp["delta_retraces"] == 6
+        assert comp["delta_cache_hits"] == -3
+        assert comp["delta_wall_s"] == pytest.approx(1.2)
+        report = format_report(diff)
+        assert "compile: +6 compiles, +6 retraces (6 vs 0 retraces)" \
+            in report
+
+    def test_pre19_pair_has_no_compile_block(self):
+        cand, base = self._pair()
+        diff = diff_records(cand, base)
+        assert diff.get("compile") is None
+        assert "compile:" not in format_report(diff)
+
+
+class TestCommittedDemoPins:
+    """The ISSUE 19 acceptance pin: over the committed demo trio the
+    diff names `gc` and `compile/retrace` as the top causes —
+    deterministically, through the real CLI."""
+
+    def _diff(self, cand_path, base_path):
+        return diff_records(json.loads(cand_path.read_text()),
+                            json.loads(base_path.read_text()))
+
+    def test_gc_heavy_pair_names_gc(self):
+        diff = self._diff(DEMO_GC, DEMO_BASE)
+        cause = diff["causes"][0]
+        assert cause["stage"] == "wilcox_test"
+        assert cause["driver"] == "gc"
+        assert cause["delta_host_cause_s"] == pytest.approx(1.2)
+        assert "host-side driven by +1.200 s GC pauses" in cause["summary"]
+
+    def test_retrace_heavy_pair_names_compile_retrace(self):
+        diff = self._diff(DEMO_RETRACE, DEMO_BASE)
+        cause = diff["causes"][0]
+        assert cause["stage"] == "wilcox_test"
+        assert cause["driver"] == "compile/retrace"
+        assert cause["delta_retraces"] == 6
+        assert ("host-side driven by +1.200 s compile/retrace "
+                "(+6 retraces)") in cause["summary"]
+        comp = diff["compile"]
+        assert comp["delta_retraces"] == 6
+        assert comp["delta_compiles"] == 6
+        assert comp["delta_cache_hits"] == -2
+
+    def test_demo_pair_diffs_are_deterministic(self):
+        for cand in (DEMO_GC, DEMO_RETRACE):
+            d1 = self._diff(cand, DEMO_BASE)
+            d2 = self._diff(cand, DEMO_BASE)
+            assert json.dumps(d1, sort_keys=True) == \
+                json.dumps(d2, sort_keys=True)
+            assert format_report(d1) == format_report(d2)
+
+    def test_cli_prints_the_named_causes(self):
+        run = lambda c, b: subprocess.run(  # noqa: E731
+            [sys.executable, str(REPO / "tools" / "perf_diff.py"),
+             str(c), str(b)],
+            capture_output=True, text=True, timeout=120,
+        )
+        gc_out = run(DEMO_GC, DEMO_BASE)
+        assert gc_out.returncode == 0, gc_out.stdout + gc_out.stderr
+        assert "host-side driven by +1.200 s GC pauses" in gc_out.stdout
+        rt_out = run(DEMO_RETRACE, DEMO_BASE)
+        assert rt_out.returncode == 0, rt_out.stdout + rt_out.stderr
+        assert ("host-side driven by +1.200 s compile/retrace "
+                "(+6 retraces)") in rt_out.stdout
+        assert "compile: +6 compiles" in rt_out.stdout

@@ -1,0 +1,236 @@
+"""Per-stage compile/retrace telemetry (ISSUE 19): the pure ``compile``
+section builder over captured duration events, the version-tolerant
+event-name filter pinned against the *installed* jax (satellite 2), and
+the retrace budget of the anchor smoke pipeline — a second identical
+in-process run must add zero compilation-shaped events (satellite 1)."""
+
+import numpy as np
+import pytest
+
+import scconsensus_tpu as scc
+from scconsensus_tpu.obs import device as obs_device
+from scconsensus_tpu.obs import compilelog
+from scconsensus_tpu.obs.compilelog import (
+    build_compile_section,
+    event_kind,
+    validate_compile,
+)
+from scconsensus_tpu.obs.hostprof import OUTSIDE_SPANS
+from scconsensus_tpu.obs.trace import Tracer
+from scconsensus_tpu.utils import synthetic_scrna
+
+
+# --------------------------------------------------------------------------
+# pure builder
+# --------------------------------------------------------------------------
+
+class TestBuildCompileSection:
+    def test_zero_events_is_an_honest_section_of_zeros(self):
+        sec = build_compile_section([])
+        assert sec["events"] == 0
+        assert sec["compiles"] == 0
+        assert sec["retraces"] == 0
+        assert sec["by_stage"] == {}
+        validate_compile(sec)
+
+    def test_legacy_two_tuples_default_to_no_stage_first_entry(self):
+        # the capture list predates stage stamping; old injectors (and
+        # tests) still append bare (name, secs) pairs
+        sec = build_compile_section([("pjit_compile", 0.01)])
+        assert sec["events"] == 1
+        assert sec["retraces"] == 0  # occ defaults to 1 — not a retrace
+        assert OUTSIDE_SPANS in sec["by_stage"]
+        validate_compile(sec)
+
+    def test_counts_kinds_stages_and_retraces(self):
+        evs = [
+            ("/jax/core/compile/jaxpr_trace_duration", 0.05, "de", 1),
+            ("/jax/core/compile/backend_compile_duration", 0.10, "de", 1),
+            # second entry into `de`: the cache missed — a retrace
+            ("/jax/core/compile/jaxpr_trace_duration", 0.08, "de", 2),
+            ("/jax/core/compile/backend_compile_duration", 0.12, "de", 2),
+            ("/jax/core/compile/jaxpr_trace_duration", 0.02, None, 1),
+        ]
+        sec = build_compile_section(evs, cache_hits=3)
+        assert sec["events"] == 5
+        assert sec["compiles"] == 2
+        assert sec["traces"] == 3
+        assert sec["retraces"] == 1
+        assert sec["cache_hits"] == 3
+        assert sec["compile_wall_s"] == pytest.approx(0.37)
+        de = sec["by_stage"]["de"]
+        assert (de["events"], de["compiles"], de["retraces"]) == (4, 2, 1)
+        assert de["total_s"] == pytest.approx(0.35)
+        assert sec["by_stage"][OUTSIDE_SPANS]["events"] == 1
+        validate_compile(sec)
+
+    def test_event_kind_is_spelling_tolerant(self):
+        # satellite 2: classification by normalized spelling, so a jax
+        # upgrade respelling the event keeps classifying identically
+        for name in ("/jax/core/compile/backend_compile_duration",
+                     "Backend-Compile Duration", "backendCompile_duration"):
+            assert event_kind(name) == "backend", name
+        for name in ("/jax/core/compile/jaxpr_trace_duration",
+                     "Jaxpr TRACE duration"):
+            assert event_kind(name) == "trace", name
+        assert event_kind("/jax/core/compile/something_else") == "other"
+
+
+class TestValidateCompile:
+    def _sec(self):
+        return build_compile_section(
+            [("/jax/core/compile/jaxpr_trace_duration", 0.05, "de", 2)])
+
+    def test_retraces_cannot_exceed_traces(self):
+        sec = self._sec()
+        sec["retraces"] = 9
+        with pytest.raises(ValueError, match="retraces"):
+            validate_compile(sec)
+
+    def test_by_event_must_sum_to_events(self):
+        sec = self._sec()
+        sec["events"] = 7
+        with pytest.raises(ValueError, match="by_event|by_stage|exceed"):
+            validate_compile(sec)
+
+    def test_by_stage_must_sum_to_events(self):
+        sec = self._sec()
+        sec["by_stage"]["ghost"] = {"events": 1, "compiles": 0,
+                                    "retraces": 0, "total_s": 0.0}
+        with pytest.raises(ValueError, match="by_stage"):
+            validate_compile(sec)
+
+
+# --------------------------------------------------------------------------
+# runtime arm/snapshot gating
+# --------------------------------------------------------------------------
+
+class TestArmAndSnapshot:
+    def test_snapshot_none_when_never_armed(self, monkeypatch):
+        monkeypatch.setitem(compilelog._STATE, "armed", False)
+        assert compilelog.snapshot() is None
+
+    def test_env_gate_respected(self, monkeypatch):
+        monkeypatch.setitem(compilelog._STATE, "armed", False)
+        monkeypatch.delenv("SCC_COMPILELOG", raising=False)
+        assert compilelog.install_and_mark() is False
+        assert compilelog.armed() is False
+
+    def test_force_arms_and_snapshots_against_installed_jax(
+            self, monkeypatch):
+        pytest.importorskip("jax")
+        monkeypatch.setitem(compilelog._STATE, "armed", False)
+        monkeypatch.setitem(compilelog._STATE, "dur_mark", 0)
+        monkeypatch.setitem(compilelog._STATE, "cache_mark", 0)
+        assert compilelog.install_and_mark(force=True) is True
+        assert compilelog.armed() is True
+        sec = compilelog.snapshot()
+        assert sec is not None
+        validate_compile(sec)
+
+    def test_explicit_marks_scope_the_window(self, monkeypatch):
+        monkeypatch.setitem(compilelog._STATE, "armed", False)
+        with obs_device._COMPILE_LOCK:
+            n0 = len(obs_device._COMPILE_EVENTS)
+            obs_device._COMPILE_EVENTS.append(("pjit_compile", 0.5))
+        try:
+            sec = compilelog.snapshot(dur_mark=n0, cache_mark=0)
+            assert sec["events"] == 1
+            assert sec["compile_wall_s"] == pytest.approx(0.5)
+        finally:
+            with obs_device._COMPILE_LOCK:
+                del obs_device._COMPILE_EVENTS[n0:n0 + 1]
+
+
+# --------------------------------------------------------------------------
+# satellite 2: the name filter pinned against the INSTALLED jax
+# --------------------------------------------------------------------------
+
+class TestListenerAgainstInstalledJax:
+    def test_jit_emits_compilation_shaped_events(self):
+        """A fresh jit through the installed jax must land duration
+        events in the capture — if a jax upgrade respells its event
+        names past the normalized filter, this fails loudly instead of
+        the compile section silently reading all-zeros."""
+        jax = pytest.importorskip("jax")
+        assert obs_device.install_compile_listener(), \
+            "installed jax exposes no monitoring listener hook"
+        mark = obs_device.compile_mark()
+
+        @jax.jit
+        def _uniq_round19(x):
+            return x * 3.0 + 0.125
+
+        _uniq_round19(np.arange(11, dtype=np.float32)).block_until_ready()
+        evs = obs_device.compile_events(since=mark)
+        assert evs, ("no compilation-shaped duration events captured — "
+                     "the event-name filter zeroed out against jax "
+                     f"{jax.__version__}")
+        kinds = {event_kind(ev[0]) for ev in evs}
+        assert "trace" in kinds, f"no trace-shaped event in {sorted(kinds)}"
+        sec = build_compile_section(evs)
+        assert sec["traces"] >= 1
+        validate_compile(sec)
+
+    def test_events_stamped_with_stage_and_entry_ordinal(self):
+        jax = pytest.importorskip("jax")
+        assert obs_device.install_compile_listener()
+        tr = Tracer(sync="off")
+
+        @jax.jit
+        def _staged_round19(x):
+            return (x - 0.5) ** 2
+
+        mark = obs_device.compile_mark()
+        with tr.span("warm_stage"):
+            _staged_round19(np.arange(5, dtype=np.float32))
+        warm = obs_device.compile_events(since=mark)
+        assert warm and all(
+            len(ev) > 3 and ev[2] == "warm_stage" and ev[3] == 1
+            for ev in warm)
+
+        # re-entering the stage with a NEW shape is a retrace: events
+        # stamped with entry ordinal 2, counted by the section builder
+        mark2 = obs_device.compile_mark()
+        with tr.span("warm_stage"):
+            _staged_round19(np.arange(6, dtype=np.float32))
+        retr = obs_device.compile_events(since=mark2)
+        assert retr and all(ev[3] == 2 for ev in retr)
+        sec = build_compile_section(retr)
+        assert sec["retraces"] >= 1
+        assert sec["by_stage"]["warm_stage"]["retraces"] >= 1
+
+
+# --------------------------------------------------------------------------
+# satellite 1: the anchor smoke pipeline's retrace budget
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def planted():
+    data, truth, _ = synthetic_scrna(
+        n_genes=500, n_cells=600, n_clusters=4, n_markers_per_cluster=40,
+        marker_log_fc=2.5, seed=11,
+    )
+    return data, np.array([f"c{t}" for t in truth])
+
+
+class TestRetraceBudget:
+    def test_identical_rerun_compiles_nothing(self, planted):
+        """The anchor smoke's compile budget on a warm cache is ZERO:
+        jit caching makes an identical in-process re-run event-free, so
+        any event here means shape churn / weak-type flips crept into
+        the pipeline — the regression ROADMAP item 1's fusion work must
+        not reintroduce."""
+        pytest.importorskip("jax")
+        assert obs_device.install_compile_listener()
+        data, labels = planted
+        kw = dict(q_val_thrs=0.05, min_cluster_size=10,
+                  deep_split_values=(1, 2, 3))
+        scc.recluster_de_consensus_fast(data, labels, **kw)  # warm-up
+        mark = obs_device.compile_mark()
+        scc.recluster_de_consensus_fast(data, labels, **kw)
+        new = obs_device.compile_events(since=mark)
+        assert not new, (
+            f"identical anchor re-run emitted {len(new)} compile "
+            f"event(s); first few: {[ev[0] for ev in new[:5]]}"
+        )

@@ -1,0 +1,340 @@
+"""Host execution observatory (ISSUE 19 tentpole): the sampling stack
+profiler, GC pause accounting, and memory timeline must build schema-
+valid ``host_profile`` / ``memory_timeline`` sections, stay honest on
+degenerate inputs (zero-sample stages, stages shorter than one sampling
+period, GC outside any span, pre-19 records with no sections at all),
+and keep the sampler's own cost under the perf gate's 50 ms noise
+floor."""
+
+import gc
+import sys
+import time
+
+import pytest
+
+from scconsensus_tpu.obs.export import build_run_record, validate_run_record
+from scconsensus_tpu.obs.hostprof import (
+    CATEGORIES,
+    OUTSIDE_SPANS,
+    HostProfiler,
+    build_host_profile,
+    build_memory_timeline,
+    classify_stack,
+    validate_host_profile,
+    validate_memory_timeline,
+)
+from scconsensus_tpu.obs.regress import ABS_NOISE_FLOOR_S
+from scconsensus_tpu.obs.trace import Tracer
+
+
+# --------------------------------------------------------------------------
+# stack classifier
+# --------------------------------------------------------------------------
+
+class TestClassifyStack:
+    def test_none_frame_is_python_without_frame(self):
+        assert classify_stack(None) == ("python", None)
+
+    def test_plain_python_frame_named(self):
+        cat, top = classify_stack(sys._getframe())
+        assert cat == "python"
+        assert "test_obs_hostprof.py:test_plain_python_frame_named:" in top
+
+    def test_blocking_wait_recognized_anywhere_in_the_walk(self):
+        def block_until_ready():  # the waiter the run thread sits in
+            def leaf():
+                return classify_stack(sys._getframe())
+            return leaf()
+
+        cat, top = block_until_ready()
+        assert cat == "blocking_wait"
+        # the *leaf* frame is still the one named — where the wait parks
+        assert ":leaf:" in top
+
+
+# --------------------------------------------------------------------------
+# pure builders — degenerate inputs (satellite 4)
+# --------------------------------------------------------------------------
+
+class TestBuildHostProfile:
+    def test_buckets_by_stage_and_cause(self):
+        samples = [
+            (0.02, "consensus", "python", "a.py:f:1"),
+            (0.04, "consensus", "python", "a.py:f:1"),
+            (0.06, "consensus", "blocking_wait", None),
+            (0.08, None, "python", "b.py:g:2"),
+        ]
+        sec = build_host_profile(samples, period_s=0.02)
+        assert sec["n_samples"] == 4
+        row = sec["stages"]["consensus"]
+        assert row["samples"] == 3
+        assert row["causes"]["python"] == pytest.approx(0.04)
+        assert row["causes"]["blocking_wait"] == pytest.approx(0.02)
+        assert row["top_frame"] == "a.py:f:1"
+        assert sec["stages"][OUTSIDE_SPANS]["samples"] == 1
+        validate_host_profile(sec)
+
+    def test_zero_samples_is_an_honest_empty_section(self):
+        """A run with no samples at all (profiler started, run finished
+        inside one period) still gets a section — the profiler RAN."""
+        sec = build_host_profile([], period_s=0.02)
+        assert sec["n_samples"] == 0
+        assert sec["stages"] == {}
+        assert sec["gc"]["collections"] == 0
+        validate_host_profile(sec)
+
+    def test_stage_shorter_than_period_has_no_row(self):
+        """A 3 ms stage at a 20 ms grid catches zero samples: no row at
+        all, never a zero-second row pretending coverage."""
+        sec = build_host_profile(
+            [(0.02, "long_stage", "python", None)], period_s=0.02)
+        assert "blink_stage" not in sec["stages"]
+        assert sec["stages"]["long_stage"]["est_s"] == pytest.approx(0.02)
+        validate_host_profile(sec)
+
+    def test_gc_outside_spans_lands_in_the_named_bucket(self):
+        """A collection between stages is still a pause the run paid."""
+        sec = build_host_profile(
+            [], gc={"collections": 3,
+                    "by_stage": {None: {"pauses": 3, "pause_s": 0.5}}},
+            period_s=0.02)
+        assert sec["gc"]["pause_s"] == pytest.approx(0.5)
+        assert sec["gc"]["outside_spans_pause_s"] == pytest.approx(0.5)
+        row = sec["stages"][OUTSIDE_SPANS]
+        assert row["causes"]["gc"] == pytest.approx(0.5)
+        assert row["gc_pauses"] == 3
+        assert row["samples"] == 0  # GC billed it, samples did not
+        validate_host_profile(sec)
+
+    def test_gc_on_a_sampled_stage_merges_into_its_row(self):
+        sec = build_host_profile(
+            [(0.02, "de", "python", None)],
+            gc={"collections": 1,
+                "by_stage": {"de": {"pauses": 1, "pause_s": 0.1}}},
+            period_s=0.02)
+        row = sec["stages"]["de"]
+        assert row["causes"]["gc"] == pytest.approx(0.1)
+        assert row["causes"]["python"] == pytest.approx(0.02)
+        assert sec["gc"]["outside_spans_pause_s"] == 0.0
+        validate_host_profile(sec)
+
+    def test_unknown_category_folds_into_python(self):
+        sec = build_host_profile([(0.02, "s", "martian", None)])
+        assert sec["stages"]["s"]["causes"]["python"] > 0
+        validate_host_profile(sec)
+
+
+class TestBuildMemoryTimeline:
+    def test_empty_input_is_none_not_an_empty_timeline(self):
+        assert build_memory_timeline([]) is None
+        # rows with no RSS reading are dropped, not zero-filled
+        assert build_memory_timeline([(0.1, None, None, None)]) is None
+
+    def test_peaks_and_by_stage_deltas(self):
+        ticks = [(0.0, 100, None, None), (0.1, 300, 7, "de"),
+                 (0.2, 200, None, "de"), (0.3, 150, None, None)]
+        sec = build_memory_timeline(ticks, period_s=0.1)
+        assert sec["n_samples"] == 4
+        assert sec["rss_peak_bytes"] == 300
+        assert sec["hbm_peak_bytes"] == 7
+        de = sec["by_stage"]["de"]
+        assert de["rss_peak_bytes"] == 300
+        assert de["rss_delta_bytes"] == 200 - 300
+        assert sec["by_stage"][OUTSIDE_SPANS]["rss_first_bytes"] == 100
+        validate_memory_timeline(sec)
+
+    def test_downsampling_keeps_the_final_sample(self):
+        ticks = [(i * 0.01, 100 + i, None, None) for i in range(1000)]
+        sec = build_memory_timeline(ticks, period_s=0.01, max_points=50)
+        assert sec["n_samples"] == 1000
+        assert len(sec["samples"]) == 50
+        assert sec["samples"][-1]["rss_bytes"] == 100 + 999
+        assert sec["rss_peak_bytes"] == 100 + 999
+        validate_memory_timeline(sec)
+
+    def test_unordered_input_is_sorted(self):
+        sec = build_memory_timeline(
+            [(0.2, 5, None, None), (0.1, 9, None, None)])
+        assert [s["t_s"] for s in sec["samples"]] == [0.1, 0.2]
+        validate_memory_timeline(sec)
+
+
+# --------------------------------------------------------------------------
+# validators reject tampering
+# --------------------------------------------------------------------------
+
+class TestValidators:
+    def _profile(self):
+        return build_host_profile(
+            [(0.02, "de", "python", "a.py:f:1")], period_s=0.02)
+
+    def test_host_profile_sample_sum_must_match(self):
+        sec = self._profile()
+        sec["n_samples"] = 99
+        with pytest.raises(ValueError, match="sum to n_samples"):
+            validate_host_profile(sec)
+
+    def test_host_profile_negative_cause_rejected(self):
+        sec = self._profile()
+        sec["stages"]["de"]["causes"]["gc"] = -1.0
+        with pytest.raises(ValueError, match="causes.gc"):
+            validate_host_profile(sec)
+
+    def test_host_profile_wrong_version_rejected(self):
+        sec = self._profile()
+        sec["version"] = 2
+        with pytest.raises(ValueError, match="version"):
+            validate_host_profile(sec)
+
+    def test_memory_timeline_peak_below_sample_rejected(self):
+        sec = build_memory_timeline([(0.0, 100, None, None)])
+        sec["rss_peak_bytes"] = 1
+        with pytest.raises(ValueError, match="below a carried sample"):
+            validate_memory_timeline(sec)
+
+    def test_memory_timeline_must_be_time_ordered(self):
+        sec = build_memory_timeline(
+            [(0.0, 100, None, None), (0.1, 100, None, None)])
+        sec["samples"][0]["t_s"] = 9.9
+        with pytest.raises(ValueError, match="time-ordered"):
+            validate_memory_timeline(sec)
+
+
+# --------------------------------------------------------------------------
+# run-record integration: additive sections + explicit-absence rule
+# --------------------------------------------------------------------------
+
+class TestRunRecordSections:
+    def test_record_with_all_sections_validates(self):
+        rec = build_run_record(
+            metric="m", value=1.0, unit="seconds",
+            host_profile=build_host_profile(
+                [(0.02, "de", "python", None)], period_s=0.02),
+            compile={"version": 1, "events": 0, "compiles": 0,
+                     "traces": 0, "retraces": 0, "cache_hits": 0,
+                     "compile_wall_s": 0.0, "by_event": {},
+                     "by_stage": {}},
+            memory_timeline=build_memory_timeline(
+                [(0.0, 100, None, None)]),
+        )
+        validate_run_record(rec)
+        assert rec["host_profile"]["n_samples"] == 1
+
+    def test_pre19_record_without_sections_still_validates(self):
+        rec = build_run_record(metric="m", value=1.0, unit="seconds")
+        assert "host_profile" not in rec
+        assert "compile" not in rec
+        assert "memory_timeline" not in rec
+        validate_run_record(rec)
+
+    def test_present_but_null_sections_rejected(self):
+        for key in ("host_profile", "compile", "memory_timeline"):
+            rec = build_run_record(metric="m", value=1.0, unit="seconds")
+            rec[key] = None
+            with pytest.raises(ValueError, match="omitted when absent"):
+                validate_run_record(rec)
+
+    def test_corrupt_section_caught_through_record_validation(self):
+        rec = build_run_record(
+            metric="m", value=1.0, unit="seconds",
+            host_profile=build_host_profile([], period_s=0.02))
+        rec["host_profile"]["period_s"] = 0
+        with pytest.raises(ValueError, match="period_s"):
+            validate_run_record(rec)
+
+
+# --------------------------------------------------------------------------
+# the live sampler
+# --------------------------------------------------------------------------
+
+class TestHostProfilerLive:
+    def test_samples_stage_gc_and_memory(self):
+        prof = HostProfiler(period_s=0.005)
+        tr = Tracer(sync="off")
+        prof.start()
+        try:
+            with tr.span("busy_stage"):
+                t0 = time.perf_counter()
+                x = 0.0
+                while time.perf_counter() - t0 < 0.25:
+                    x += sum(i * i for i in range(500))
+                gc.collect()
+        finally:
+            prof.stop()
+        secs = prof.sections()
+        hp = secs["host_profile"]
+        validate_host_profile(hp)
+        assert hp["n_samples"] >= 5
+        assert "busy_stage" in hp["stages"]
+        row = hp["stages"]["busy_stage"]
+        assert row["causes"]["python"] > 0
+        assert row.get("top_frame")  # the busy loop frame was named
+        assert hp["gc"]["collections"] >= 1
+        mt = secs["memory_timeline"]
+        if mt is not None:  # /proc may be unreadable in exotic sandboxes
+            validate_memory_timeline(mt)
+            assert mt["rss_peak_bytes"] > 0
+
+    def test_sections_safe_while_running(self):
+        prof = HostProfiler(period_s=0.005).start()
+        try:
+            time.sleep(0.05)
+            secs = prof.sections()  # bench._finalize reads a live one
+            validate_host_profile(secs["host_profile"])
+        finally:
+            prof.stop()
+
+    def test_stop_removes_gc_callback(self):
+        prof = HostProfiler(period_s=0.01).start()
+        assert prof._on_gc in gc.callbacks
+        prof.stop()
+        assert prof._on_gc not in gc.callbacks
+
+    def test_overhead_under_the_noise_floor(self):
+        """The acceptance pin: over an anchor-smoke-scale stage the
+        sampler's self-measured cost (stack walk + RSS read per tick at
+        the production 50 Hz grid) stays under the perf gate's 50 ms
+        absolute noise floor, so profiled runs remain comparable with
+        unprofiled history."""
+        prof = HostProfiler(period_s=0.02)  # 50 Hz, the default
+        tr = Tracer(sync="off")
+        prof.start()
+        try:
+            with tr.span("anchor_smoke_shape"):
+                t0 = time.perf_counter()
+                x = 0.0
+                while time.perf_counter() - t0 < 1.5:
+                    x += sum(i * i for i in range(1000))
+        finally:
+            prof.stop()
+        hp = prof.sections()["host_profile"]
+        assert hp["n_samples"] >= 20  # it actually sampled the stage
+        assert hp["sampler_self_s"] < ABS_NOISE_FLOOR_S, (
+            f"sampler burned {hp['sampler_self_s']:.4f}s over a 1.5s "
+            f"stage — above the {ABS_NOISE_FLOOR_S}s noise floor"
+        )
+
+
+class TestEnvGate:
+    def test_disabled_by_default(self, monkeypatch):
+        from scconsensus_tpu.obs import hostprof
+
+        monkeypatch.delenv("SCC_HOSTPROF", raising=False)
+        monkeypatch.setitem(hostprof._ACTIVE, "prof", None)
+        assert hostprof.start_if_enabled() is None
+        assert hostprof.active_profiler() is None
+
+    def test_enabled_starts_and_stop_active_clears(self, monkeypatch):
+        from scconsensus_tpu.obs import hostprof
+
+        monkeypatch.setenv("SCC_HOSTPROF", "1")
+        monkeypatch.setenv("SCC_HOSTPROF_HZ", "100")
+        monkeypatch.setitem(hostprof._ACTIVE, "prof", None)
+        prof = hostprof.start_if_enabled()
+        try:
+            assert prof is not None
+            assert prof.period_s == pytest.approx(0.01)
+            assert hostprof.start_if_enabled() is prof  # idempotent
+        finally:
+            hostprof.stop_active()
+        assert hostprof.active_profiler() is None

@@ -441,6 +441,75 @@ class TestTailRunRender:
         assert "transfers: h2d 1.5GiB" in panel
         assert "rate " in panel
 
+    def test_render_tunnel_verdict_in_header(self):
+        """ISSUE 19 satellite: the tunnel_probe --status verdict rides
+        the flight-record header — dead/stale shout in uppercase, a
+        healthy tunnel stays lowercase."""
+        sys.path.insert(0, str(REPO / "tools"))
+        import tail_run
+
+        hdr = [{"t": "header", "ts": 1.0, "metric": "x"}]
+        panel = tail_run.render(
+            hdr, tunnel={"state": "dead", "age_s": 120.0})
+        assert "[tunnel DEAD, 2m0" in panel.splitlines()[0] or \
+            "[tunnel DEAD" in panel.splitlines()[0]
+        alive = tail_run.render(
+            hdr, tunnel={"state": "alive", "age_s": 5.0})
+        assert "[tunnel alive" in alive.splitlines()[0]
+        # no verdict (probe unavailable) leaves the header untouched
+        assert "tunnel" not in tail_run.render(hdr).splitlines()[0]
+
+    def test_render_host_observatory_panels_from_partial(self):
+        """The round-19 sections on a partial record render as the
+        host-profile, compile, and memory panels."""
+        sys.path.insert(0, str(REPO / "tools"))
+        import tail_run
+
+        from scconsensus_tpu.obs.compilelog import build_compile_section
+        from scconsensus_tpu.obs.hostprof import (
+            build_host_profile,
+            build_memory_timeline,
+        )
+
+        partial = {
+            "host_profile": build_host_profile(
+                [(i * 0.02, "wilcox_test", "python",
+                  "engine.py:rank_chunk:142") for i in range(50)],
+                gc={"collections": 4,
+                    "by_stage": {"wilcox_test": {"pauses": 4,
+                                                 "pause_s": 0.4}}},
+                period_s=0.02, sampler_self_s=0.003),
+            "compile": build_compile_section(
+                [("/jax/core/compile/jaxpr_trace_duration", 0.08,
+                  "wilcox_test", 2)], cache_hits=3),
+            "memory_timeline": build_memory_timeline(
+                [(i * 0.1, (300 + i) << 20, None, None)
+                 for i in range(10)], period_s=0.1),
+        }
+        panel = tail_run.render(
+            [{"t": "header", "ts": 1.0, "metric": "x"}], partial=partial)
+        assert "host profile: 50 samples @ 50Hz" in panel
+        assert "gc x4" in panel
+        assert "wilcox_test" in panel and "mostly python" in panel
+        assert "top engine.py:rank_chunk:142" in panel
+        assert "RETRACES 1" in panel and "3 cache hits" in panel
+        assert "memory: rss " in panel and "peak 309.0MiB" in panel
+
+    def test_render_pre19_partial_degrades(self):
+        """A partial record without the round-19 sections renders no
+        host-observatory panels (and does not crash)."""
+        sys.path.insert(0, str(REPO / "tools"))
+        import tail_run
+
+        panel = tail_run.render(
+            [{"t": "header", "ts": 1.0, "metric": "x"}],
+            partial={"termination": {"cause": "stall",
+                                     "flushed_unix": 1.0}})
+        assert "host profile:" not in panel
+        assert "compile:" not in panel
+        assert "memory: rss" not in panel
+        assert "cause=stall" in panel
+
 
 # --------------------------------------------------------------------------
 # profiler capture window (SIGUSR1's main-thread toggle)

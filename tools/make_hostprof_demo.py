@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Generate the committed host-observatory demo evidence trio.
+
+Three schema-valid run records over the same synthetic stage skeleton
+(``consensus`` → ``wilcox_test`` → ``tree``), constructed to differ in
+exactly one named host cause so the attribution plane's split is
+demonstrable (and pinned by test) on committed evidence:
+
+* ``baseline``      — wilcox_test 2.0 s, light GC, one cold compile;
+* ``gc-heavy``      — wilcox_test 3.2 s, the growth driven by +1.2 s of
+                      measured GC pauses (``host_profile.stages``);
+* ``retrace-heavy`` — wilcox_test 3.2 s, the growth driven by +1.2 s of
+                      compile wall with 6 retraces (``compile.by_stage``).
+
+``tools/perf_diff.py gc-heavy baseline`` must name ``gc`` as the top
+cause and ``retrace-heavy baseline`` must name ``compile/retrace`` —
+that is the round-19 acceptance demo, asserted by tests/test_obs_attr.py
+against the ledger-ingested copies of these records.
+
+Every section goes through the real builders (obs.hostprof /
+obs.compilelog pure functions) and the real ``build_run_record`` +
+``Ledger.ingest`` path, so the committed records exercise the same
+validators as live bench output. Deterministic: fixed created_unix
+stamps, fixed sample streams, no randomness.
+
+Usage:  python tools/make_hostprof_demo.py [--evidence DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from scconsensus_tpu.obs.compilelog import build_compile_section  # noqa: E402
+from scconsensus_tpu.obs.export import build_run_record  # noqa: E402
+from scconsensus_tpu.obs.hostprof import (  # noqa: E402
+    build_host_profile,
+    build_memory_timeline,
+)
+
+PERIOD_S = 0.02  # 50 Hz, the default sampler grid
+
+# fixed identity: distinct created stamps make distinct ledger filenames
+# under one shared run key (dataset=hostprofdemo backend=cpu)
+CREATED = {"baseline": 1786000001, "gc-heavy": 1786000002,
+           "retrace-heavy": 1786000003}
+
+
+def _spans(walls: List[Tuple[str, float]]) -> List[Dict[str, Any]]:
+    out, t0 = [], 0.0
+    for i, (name, wall) in enumerate(walls):
+        out.append({
+            "name": name, "span_id": i, "parent_id": None, "depth": 0,
+            "kind": "stage", "t0_s": round(t0, 6),
+            "wall_submitted_s": round(wall, 6),
+            "wall_synced_s": round(wall, 6), "synced": True,
+        })
+        t0 += wall
+    return out
+
+
+def _stack_samples(stage_cause_s: Dict[str, Dict[str, float]],
+                   frames: Dict[str, str]
+                   ) -> List[Tuple[float, Optional[str], str,
+                                   Optional[str]]]:
+    """Deterministic sample stream: each (stage, cause) contributes
+    seconds/PERIOD samples on a synthetic time grid."""
+    samples = []
+    t = 0.0
+    for stage, causes in stage_cause_s.items():
+        for cause, secs in causes.items():
+            for _ in range(int(round(secs / PERIOD_S))):
+                t += PERIOD_S
+                samples.append((round(t, 4), stage, cause,
+                                frames.get(stage)))
+    return samples
+
+
+def _mem_samples(total_s: float, base_rss: int, peak_rss: int
+                 ) -> List[Tuple[float, int, Optional[int],
+                                 Optional[str]]]:
+    """RSS ramp base → peak → settle over the run, sampled at 10 Hz."""
+    n = max(int(total_s * 10), 2)
+    out = []
+    for i in range(n):
+        frac = i / (n - 1)
+        ramp = 1.0 - abs(2.0 * frac - 1.0)  # 0 → 1 → 0 triangle
+        rss = int(base_rss + (peak_rss - base_rss) * ramp)
+        out.append((round(i * 0.1, 4), rss, None,
+                    "wilcox_test" if 0.25 <= frac <= 0.75 else None))
+    return out
+
+
+def _compile_events(retraces: int) -> List[Tuple[str, float, str, int]]:
+    """One cold compile on wilcox_test entry 1, plus ``retraces``
+    re-trace+recompile pairs on entry 2 (0.2 s wall each)."""
+    ev = [
+        ("/jax/core/compile/jaxpr_trace_duration", 0.05, "wilcox_test", 1),
+        ("/jax/core/compile/backend_compile_duration", 0.10,
+         "wilcox_test", 1),
+    ]
+    for _ in range(retraces):
+        ev.append(("/jax/core/compile/jaxpr_trace_duration", 0.08,
+                   "wilcox_test", 2))
+        ev.append(("/jax/core/compile/backend_compile_duration", 0.12,
+                   "wilcox_test", 2))
+    return ev
+
+
+def _record(kind: str) -> Dict[str, Any]:
+    wilcox_wall = 2.0 if kind == "baseline" else 3.2
+    gc_pause = 1.3 if kind == "gc-heavy" else 0.1
+    retraces = 6 if kind == "retrace-heavy" else 0
+    # python fills whatever wall the named cause doesn't explain — the
+    # deltas between records must isolate ONE cause past the noise floor
+    python_s = {"baseline": 1.5, "gc-heavy": 1.5,
+                "retrace-heavy": 1.5}[kind]
+
+    walls = [("consensus", 1.0), ("wilcox_test", wilcox_wall),
+             ("tree", 1.5)]
+    cause_s = {
+        "consensus": {"python": 0.8},
+        "wilcox_test": {"python": python_s, "blocking_wait": 0.2},
+        "tree": {"python": 1.2, "serialization": 0.1},
+    }
+    frames = {
+        "consensus": "consensus.py:vote_matrix:88",
+        "wilcox_test": "engine.py:rank_chunk:142",
+        "tree": "recluster.py:ward_merge:57",
+    }
+    host_profile = build_host_profile(
+        _stack_samples(cause_s, frames),
+        gc={"collections": int(round(gc_pause / 0.01)),
+            "by_stage": {"wilcox_test": {
+                "pauses": int(round(gc_pause / 0.01)),
+                "pause_s": gc_pause}}},
+        period_s=PERIOD_S,
+        sampler_self_s=0.012,
+    )
+    compile_sec = build_compile_section(
+        _compile_events(retraces),
+        cache_hits=2 if kind == "baseline" else 0,
+    )
+    memory_timeline = build_memory_timeline(
+        _mem_samples(sum(w for _, w in walls), 310 << 20,
+                     (360 if kind == "baseline" else 395) << 20),
+        period_s=0.1,
+    )
+    total = sum(w for _, w in walls)
+    rec = build_run_record(
+        metric="hostprof demo pipeline wall (synthetic, round 19)",
+        value=round(total, 3),
+        unit="seconds",
+        extra={"config": "hostprofdemo", "platform": "cpu",
+               "demo_kind": kind, "synthetic": True},
+        spans=_spans(walls),
+        host_profile=host_profile,
+        compile=compile_sec,
+        memory_timeline=memory_timeline,
+    )
+    rec["run"]["created_unix"] = CREATED[kind]  # deterministic identity
+    return rec
+
+
+def build_demo_records() -> Dict[str, Dict[str, Any]]:
+    """kind → record, the importable surface tests pin against."""
+    return {kind: _record(kind) for kind in CREATED}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="generate + ingest the host-observatory demo trio")
+    ap.add_argument("--evidence", default=None,
+                    help="ledger dir (default: SCC_EVIDENCE_DIR or "
+                         "<repo>/evidence)")
+    args = ap.parse_args(argv)
+
+    from scconsensus_tpu.obs.ledger import Ledger, default_evidence_dir
+
+    led = Ledger(args.evidence or default_evidence_dir(_REPO))
+    for kind, rec in build_demo_records().items():
+        entry = led.ingest(rec, source="hostprof-demo")
+        print(f"{kind:>14}: {entry['file']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -218,6 +218,28 @@ def _partial_events(path: str, src: str) -> List[Dict[str, Any]]:
             "state": tun.get("state"), "age_s": tun.get("age_s"),
             "last_outcome": tun.get("last_outcome"),
         })
+    # round-19 host-observatory facts: what the dying run's host was
+    # DOING (sampled causes + GC pauses) and whether it was fighting
+    # recompilation — the two classic "slow but no kernel evidence"
+    # stories a postmortem has to tell
+    hp = rec.get("host_profile")
+    if isinstance(hp, dict):
+        g = hp.get("gc") or {}
+        events.append({
+            "ts": None, "src": src, "kind": "host_profile",
+            "n_samples": hp.get("n_samples"),
+            "gc_pause_s": g.get("pause_s"),
+            "gc_collections": g.get("collections"),
+        })
+    comp = rec.get("compile")
+    if isinstance(comp, dict):
+        events.append({
+            "ts": None, "src": src, "kind": "compile",
+            "compiles": comp.get("compiles"),
+            "retraces": comp.get("retraces"),
+            "cache_hits": comp.get("cache_hits"),
+            "compile_wall_s": comp.get("compile_wall_s"),
+        })
     for sp in rec.get("spans") or []:
         if not isinstance(sp, dict):
             continue
@@ -386,7 +408,9 @@ def _fmt_ev(e: Dict[str, Any], t0: float) -> str:
               "last_span", "wall_s", "action", "from", "to", "reason",
               "worst_burn", "queue_frac", "total_bytes",
               "todo_item2_bytes", "n_boundaries", "state", "age_s",
-              "last_outcome"):
+              "last_outcome", "n_samples", "gc_pause_s",
+              "gc_collections", "compiles", "retraces", "cache_hits",
+              "compile_wall_s"):
         if e.get(k) is not None:
             bits.append(f"{k}={e[k]}")
     if e.get("kind") == "slo_burn":

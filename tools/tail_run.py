@@ -73,6 +73,23 @@ def _sparkline(buckets: List[int]) -> str:
     return "".join(out)
 
 
+def _value_sparkline(vals: List[Optional[float]]) -> str:
+    """Linear sparkline over a sampled VALUE series (the RSS timeline) —
+    min..max scaled, unlike :func:`_sparkline`'s log-count scale for
+    histogram buckets."""
+    xs = [float(v) for v in vals if v is not None]
+    if not xs:
+        return ""
+    lo, hi = min(xs), max(xs)
+    if hi <= lo:
+        return _SPARK[0] * len(xs)
+    return "".join(
+        _SPARK[min(int((v - lo) / (hi - lo) * (len(_SPARK) - 1) + 0.5),
+                   len(_SPARK) - 1)]
+        for v in xs
+    )
+
+
 def _fmt_dur(s: Optional[float]) -> str:
     if s is None:
         return "?"
@@ -191,16 +208,28 @@ def _span_line(sp: Dict[str, Any],
 def render(lines: List[Dict[str, Any]],
            baselines: Optional[Dict[str, Dict[str, float]]] = None,
            partial: Optional[Dict[str, Any]] = None,
-           now: Optional[float] = None) -> str:
+           now: Optional[float] = None,
+           tunnel: Optional[Dict[str, Any]] = None) -> str:
     """One status panel as text (pure function of its inputs — the render
-    smoke test drives it over a committed fixture stream)."""
+    smoke test drives it over a committed fixture stream). ``tunnel``
+    (optional) is a tools/tunnel_probe ``tunnel_status()`` verdict,
+    surfaced in the header so a dead TPU evidence channel is visible on
+    every live run."""
     baselines = baselines or {}
     now = time.time() if now is None else now
     st = _stream_state(lines)
     out: List[str] = []
     hdr = st["header"] or {}
-    out.append(f"flight record: {hdr.get('metric', '?')}"
-               + (f"   [pid {hdr['pid']}]" if hdr.get("pid") else ""))
+    head = (f"flight record: {hdr.get('metric', '?')}"
+            + (f"   [pid {hdr['pid']}]" if hdr.get("pid") else ""))
+    if isinstance(tunnel, dict) and tunnel.get("state"):
+        state = str(tunnel["state"])
+        tag = state if state == "alive" else state.upper()
+        age = tunnel.get("age_s")
+        head += (f"   [tunnel {tag}"
+                 + (f", {_fmt_dur(age)} old" if age is not None else "")
+                 + "]")
+    out.append(head)
     if st["extra"]:
         ident = ", ".join(f"{k}={v}" for k, v in sorted(st["extra"].items())
                           if isinstance(v, (str, int, float, bool)))
@@ -508,6 +537,56 @@ def render(lines: List[Dict[str, Any]],
                 )
             if len(rows) > 8:
                 out.append(f"    ... {len(rows) - 8} more boundaries")
+        # host-observatory panels (round 19): sampled host causes,
+        # compile/retrace counters, and the RSS timeline — rendered only
+        # when the record carries the sections (pre-19 partials degrade
+        # to the panels above)
+        hp = partial.get("host_profile")
+        if isinstance(hp, dict):
+            period = float(hp.get("period_s") or 0.0)
+            hz = f"{1.0 / period:.0f}Hz" if period > 0 else "?"
+            g = hp.get("gc") or {}
+            out.append(
+                f"  host profile: {hp.get('n_samples', 0)} samples @ {hz}"
+                f"   gc x{g.get('collections', 0)}"
+                f" ({_fmt_dur(g.get('pause_s', 0.0))} paused)"
+                f"   sampler self "
+                f"{_fmt_dur(hp.get('sampler_self_s', 0.0))}"
+            )
+            hrows = sorted(
+                (hp.get("stages") or {}).items(),
+                key=lambda kv: (-(kv[1].get("samples") or 0), kv[0]),
+            )
+            for sname, srow in hrows[:6]:
+                causes = srow.get("causes") or {}
+                line = f"    {sname:<24} {_fmt_dur(srow.get('est_s'))}"
+                dom = max(causes, key=lambda k: causes.get(k) or 0.0) \
+                    if causes else None
+                if dom is not None and (causes.get(dom) or 0.0) > 0:
+                    line += f"  mostly {dom} ({_fmt_dur(causes[dom])})"
+                if srow.get("top_frame"):
+                    line += f"  top {srow['top_frame']}"
+                out.append(line)
+        comp_sec = partial.get("compile")
+        if isinstance(comp_sec, dict):
+            rt = int(comp_sec.get("retraces") or 0)
+            out.append(
+                f"  compile: {comp_sec.get('compiles', 0)} compiles   "
+                + (f"RETRACES {rt}" if rt else "0 retraces") + "   "
+                f"{comp_sec.get('cache_hits', 0)} cache hits   "
+                f"wall {_fmt_dur(comp_sec.get('compile_wall_s', 0.0))}"
+            )
+        mt = partial.get("memory_timeline")
+        if isinstance(mt, dict):
+            vals = [s.get("rss_bytes")
+                    for s in (mt.get("samples") or [])
+                    if isinstance(s, dict)]
+            out.append(
+                "  memory: rss " + _value_sparkline(vals[-48:])
+                + f"  peak {_fmt_bytes(mt.get('rss_peak_bytes'))}"
+                + (f"  hbm peak {_fmt_bytes(mt['hbm_peak_bytes'])}"
+                   if mt.get("hbm_peak_bytes") else "")
+            )
         term = partial.get("termination")
         if isinstance(term, dict):
             out.append(f"  partial record: cause={term.get('cause')}"
@@ -540,6 +619,18 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     evidence = args.evidence or default_evidence_dir(_REPO)
     baselines: Dict[str, Dict[str, float]] = {}
+    tunnel: Optional[Dict[str, Any]] = None
+    try:
+        # best-effort tunnel verdict for the header (satellite: the
+        # still-dead TPU evidence channel must be visible on every run)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        try:
+            from tunnel_probe import tunnel_status
+        finally:
+            sys.path.pop(0)
+        tunnel = tunnel_status()
+    except Exception:
+        tunnel = None
     while True:
         lines = read_stream(args.stream)
         if not args.no_eta and not baselines:
@@ -547,7 +638,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 _stream_state(lines)["key"], evidence
             )
         panel = render(lines, baselines,
-                       partial=_partial_sidecar(args.stream))
+                       partial=_partial_sidecar(args.stream),
+                       tunnel=tunnel)
         if args.follow:
             sys.stdout.write("\x1b[2J\x1b[H" + panel + "\n")
             sys.stdout.flush()
