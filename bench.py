@@ -179,6 +179,19 @@ def _trim_line(parsed: dict) -> str:
             ex["retraces"] = comp["retraces"]
         ex["truncated"] = True
         line = json.dumps(parsed)
+    # graph passports (round 24): the full per-program censuses live in
+    # the checkpoint + ledger record; the tail keeps the ratchet facts a
+    # driver must see (program count + static host-crossing totals)
+    if len(line) > 1500 and parsed.get("graphs"):
+        gr = parsed.pop("graphs")
+        ex = parsed.setdefault("extra", {})
+        tot = gr.get("totals") or {}
+        ex["graph_programs"] = tot.get("programs", 0)
+        if tot.get("transfer_ops") or tot.get("host_callbacks"):
+            ex["graph_crossings"] = (tot.get("transfer_ops", 0)
+                                     + tot.get("host_callbacks", 0))
+        ex["truncated"] = True
+        line = json.dumps(parsed)
     if len(line) > 1500 and parsed.get("memory_timeline"):
         mt = parsed.pop("memory_timeline")
         ex = parsed.setdefault("extra", {})
@@ -390,8 +403,42 @@ def _finalize(record: dict) -> dict:
             record["compile"] = comp
     except Exception as e:
         log(f"[bench] compile-log stamp failed: {e!r}")
+    try:
+        from scconsensus_tpu.obs import graphs
+
+        sec = graphs.snapshot()
+        if sec is not None and sec.get("programs"):
+            record["graphs"] = sec
+            _stamp_graph_ratchet_ack(record)
+    except Exception as e:
+        log(f"[bench] graph-passport stamp failed: {e!r}")
     _stamp_tunnel(record)
     return record
+
+
+def _stamp_graph_ratchet_ack(record: dict) -> None:
+    """Stamp ``extra.graph_ratchet_ack`` — a digest of the
+    NUMERIC_PINS.json ``graph_ratchet`` entry this record's dataset is
+    gated against — so committed bench evidence names exactly which
+    transfer-op debt snapshot it acknowledged (the committed-evidence
+    lint requires it on new bench records carrying a graphs section)."""
+    try:
+        from scconsensus_tpu.obs.graphs import ratchet_ack
+        from scconsensus_tpu.obs.ledger import run_key
+        from scconsensus_tpu.obs.regress import PINS_NAME
+
+        pins_path = os.path.join(_evidence_dir(), PINS_NAME)
+        with open(pins_path) as f:
+            doc = json.load(f)
+        ratchet = doc.get("graph_ratchet")
+        if not isinstance(ratchet, dict):
+            return
+        entry = ratchet.get(run_key(record)["dataset"])
+        if isinstance(entry, dict):
+            record.setdefault("extra", {})["graph_ratchet_ack"] = \
+                ratchet_ack(entry)
+    except Exception as e:
+        log(f"[bench] graph-ratchet ack stamp failed: {e!r}")
 
 
 def _stamp_tunnel(record: dict) -> None:
@@ -1124,6 +1171,10 @@ def _worker_body() -> None:
     # pinned under the perf gate's noise floor by test
     os.environ.setdefault("SCC_HOSTPROF", "1")
     os.environ.setdefault("SCC_COMPILELOG", "1")
+    # compiled-program observatory on by default (round 24): every bench
+    # record carries per-program graph passports (obs.graphs) so the
+    # transfer-op ratchet has a candidate side. serve never sets this.
+    os.environ.setdefault("SCC_GRAPHS", "1")
 
     import jax
 
@@ -1143,6 +1194,13 @@ def _worker_body() -> None:
         compilelog.install_and_mark()
     except Exception as e:
         log(f"[bench] compile-log arm failed: {e!r}")
+    try:
+        # same deferral: passport capture lowers+compiles through jax
+        from scconsensus_tpu.obs import graphs
+
+        graphs.install_and_mark()
+    except Exception as e:
+        log(f"[bench] graph-passport arm failed: {e!r}")
     try:
         # start AFTER jax finishes importing: the sampler thread probes
         # sys.modules for the xla bridge every tick, and launching it

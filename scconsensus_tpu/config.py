@@ -127,6 +127,21 @@ ENV_FLAGS: Dict[str, EnvFlag] = {
                 "(obs.device): past the cap new events are dropped "
                 "rather than grow the buffer unboundedly in a "
                 "pathological retrace storm."),
+        EnvFlag("SCC_GRAPHS", bool, False,
+                "Compiled-program observatory (obs.graphs): capture a "
+                "graph passport (op census, transfer ops, host "
+                "callbacks, donation hits/misses, fusion count, "
+                "XLA-estimated buffer bytes) for every instrumented "
+                "jitted stage program on its first call per abstract "
+                "signature, landed as the run record's graphs section. "
+                "bench.py workers default it on; serve never arms it "
+                "(capture lowers+compiles an AOT copy of each "
+                "program)."),
+        EnvFlag("SCC_GRAPHS_MAX_PROGRAMS", int, 256,
+                "Cap on captured graph passports per process "
+                "(obs.graphs): past the cap further programs are "
+                "dropped with a section error note rather than grow "
+                "capture cost unboundedly under a retrace storm."),
         # --- tree stage (landmark recluster, ROADMAP item 1) ---
         EnvFlag("SCC_TREE_LANDMARK_THRESHOLD", int, 200_000,
                 "Cell count above which the pooled tree stage switches "

@@ -75,6 +75,7 @@ import numpy as np
 
 from scconsensus_tpu.obs import quality as obs_quality
 from scconsensus_tpu.obs.cost import attach_cost
+from scconsensus_tpu.obs.graphs import instrument as _passport
 from scconsensus_tpu.ops.negbin import (
     common_dispersion_grid,
     delta_grid,
@@ -286,6 +287,15 @@ def _tagwise_pairs(table_i, table_j, w_tag, zs_i, zs_j, ns_i, ns_j,
         lgamma_shift(zs_j[..., None], ns_j[None, :, None] * r)
     ll = jnp.moveaxis(m - zterm, 0, 1)                        # (Pc, G, T)
     return tagwise_dispersion(ll, common, prior_n, keep.T)
+
+
+# graph passports (obs.graphs, SCC_GRAPHS): the NB engine's CSR-window and
+# node-table stage programs (the zero-compacted window op is the q2q
+# hotpath; the table chunk is its legacy full-width form)
+_sub_table_sorted_chunk = _passport(
+    "edger.sub_table_sorted_chunk", _sub_table_sorted_chunk
+)
+_table_chunk = _passport("edger.table_chunk", _table_chunk)
 
 
 # --------------------------------------------------------------------------

@@ -51,7 +51,14 @@ Four layers, consumed together through one versioned run-record schema:
   * ``obs.compilelog`` — per-stage JAX compile/retrace telemetry:
     jax.monitoring events stamped with the ambient stage and its entry
     ordinal, aggregated into the run record's ``compile`` section
-    (compiles, retraces, cache hits, compile wall; SCC_COMPILELOG).
+    (compiles, retraces, cache hits, compile wall; SCC_COMPILELOG);
+  * ``obs.graphs`` — the compiled-program observatory: per-program
+    graph passports (op census, d2h/h2d transfer ops, host callbacks,
+    donation hits/misses, fusion counts, XLA buffer estimates) from
+    the AOT-lowered HLO of every instrumented jitted stage program,
+    keyed by an environment fingerprint — the run record's ``graphs``
+    section and the perf gate's transfer-op ratchet (SCC_GRAPHS;
+    ``tools/graph_diff.py`` diffs two records' passports).
 
 ``utils.logging.StageTimer`` remains as a thin back-compat shim over
 ``Tracer``; ``bench.py`` and the ``tools/`` emitters all build their
@@ -71,7 +78,7 @@ from scconsensus_tpu.obs.metrics import MetricSet
 from scconsensus_tpu.obs import quality  # noqa: F401 (after trace: it
 #                                          reads the partially-built pkg)
 from scconsensus_tpu.obs import kernels, residency  # noqa: F401
-from scconsensus_tpu.obs import compilelog, hostprof  # noqa: F401
+from scconsensus_tpu.obs import compilelog, graphs, hostprof  # noqa: F401
 from scconsensus_tpu.obs.export import (
     SCHEMA_NAME,
     SCHEMA_VERSION,
@@ -88,6 +95,7 @@ __all__ = [
     "kernels",
     "hostprof",
     "compilelog",
+    "graphs",
     "Span",
     "Tracer",
     "current_tracer",

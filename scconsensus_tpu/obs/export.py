@@ -253,6 +253,7 @@ def build_run_record(
     host_profile: Optional[Dict[str, Any]] = None,
     compile: Optional[Dict[str, Any]] = None,
     memory_timeline: Optional[Dict[str, Any]] = None,
+    graphs: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """One schema-v1 run record. Pass ``tracer`` to take spans + compile
     stats from it; or pre-built ``spans`` (e.g. a resumed pipeline's
@@ -276,7 +277,11 @@ def build_run_record(
     ``host_profile`` / ``compile`` / ``memory_timeline`` (optional)
     attach the round-19 host execution observatory sections
     (obs.hostprof sampled stacks + GC pauses, obs.compilelog
-    compile/retrace counters, and the RSS/HBM timeline)."""
+    compile/retrace counters, and the RSS/HBM timeline); ``graphs``
+    (optional) attaches the obs.graphs compiled-program observatory —
+    per-program graph passports (op census, transfer ops, host
+    callbacks, donation hits/misses, buffer bytes), keyed by the
+    run's environment fingerprint."""
     if spans is None:
         spans = tracer.span_records() if tracer is not None else []
     extra = dict(extra or {})
@@ -289,6 +294,14 @@ def build_run_record(
     if "jax" in sys.modules:  # never import jax here: orchestrator-side
         try:                  # records must not trigger plugin registration
             run["jax_version"] = sys.modules["jax"].__version__
+        except Exception:
+            pass
+        try:  # toolchain identity keys graph passports + their ratchet
+            from scconsensus_tpu.obs.graphs import environment_fingerprint
+
+            fp = environment_fingerprint()
+            if fp is not None:
+                run["env_fingerprint"] = fp
         except Exception:
             pass
     rec = {
@@ -336,6 +349,8 @@ def build_run_record(
         rec["compile"] = compile
     if memory_timeline is not None:
         rec["memory_timeline"] = memory_timeline
+    if graphs is not None:
+        rec["graphs"] = graphs
     return rec
 
 
@@ -503,7 +518,7 @@ def validate_run_record(rec: Dict[str, Any]) -> None:
     # round-19 host-observatory sections: absence is the marker for "the
     # instrument never ran" — a present-but-null key would make absence
     # ambiguous, so it is rejected outright
-    for key in ("host_profile", "compile", "memory_timeline"):
+    for key in ("host_profile", "compile", "memory_timeline", "graphs"):
         if key in rec and rec[key] is None:
             raise ValueError(
                 f"{key} must be omitted when absent, not null"
@@ -525,6 +540,12 @@ def validate_run_record(rec: Dict[str, Any]) -> None:
         from scconsensus_tpu.obs.hostprof import validate_memory_timeline
 
         validate_memory_timeline(mt)
+    gr = rec.get("graphs")
+    if gr is not None:
+        # jax-free import (obs.graphs validation parses captured dicts)
+        from scconsensus_tpu.obs.graphs import validate_graphs
+
+        validate_graphs(gr)
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,683 @@
+"""Compiled-program observatory: graph passports for every jitted stage
+program → the ``graphs`` run-record section.
+
+Every instrument before this round (``obs.residency`` crossings, the r22
+``residency_burndown``, the r23 host profiler) measures the *runtime*
+symptoms of host round-trips. This module introspects the compiled
+program that causes them: for each jitted stage program (wilcox ladder,
+gate funnel, rSVD embed, landmark assign, distance stream, …) it
+captures a schema-validated **graph passport** from the AOT artifacts —
+``jitted.lower(*args).compile()`` → ``Compiled.as_text()`` (optimized
+HLO), ``Compiled.memory_analysis()``, ``Compiled.cost_analysis()``:
+
+* **op census** — op-kind histogram and fusion count over the optimized
+  HLO, so "one device-resident execution graph" (ROADMAP item 1) has a
+  static op-level denominator;
+* **transfer ops & host callbacks** — infeed/outfeed/send/recv-shaped
+  ops, host-memory-space copies, and ``pure_callback``/``io_callback``
+  custom-calls, each with the *source location* XLA recorded for it, so
+  a reintroduced host crossing names its line of Python;
+* **donation hits vs misses** — declared donated buffers checked against
+  the module's ``input_output_alias`` header (a declared donation XLA
+  could not alias is a silent extra copy);
+* **XLA-estimated buffer bytes** — argument/output/temp/alias sizes and
+  the derived peak estimate.
+
+The runtime half mirrors :mod:`obs.compilelog`: :func:`install_and_mark`
+arms the registry (gated on ``SCC_GRAPHS``; bench workers default it
+on, serve never arms it), :func:`instrument` wraps a jitted callable so
+its first call per abstract signature captures a passport (memoized —
+steady-state calls cost one dict lookup), and ``bench._finalize`` stamps
+:func:`snapshot` as the record's ``graphs`` section. Passports join the
+stage timeline through the same ambient-stage + entry-ordinal scheme the
+compile log uses. Capture is best-effort: any failure lands in the
+section's ``errors`` list, never in the measurement.
+
+Passports are **backend-fingerprint-keyed**: the section carries
+:func:`environment_fingerprint` (jax/jaxlib versions, backend, device
+kind, XLA_FLAGS/LIBTPU_INIT_ARGS) and ``tools/graph_diff.py`` refuses
+to diff across fingerprints — an op census from another toolchain is a
+different program, not a regression.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from scconsensus_tpu.config import env_flag
+
+__all__ = [
+    "GRAPHS_VERSION",
+    "TRANSFER_OP_KINDS",
+    "passport_from_hlo",
+    "build_graphs_section",
+    "validate_graphs",
+    "environment_fingerprint",
+    "fingerprint_digest",
+    "instrument",
+    "observe",
+    "install_and_mark",
+    "armed",
+    "snapshot",
+    "reset",
+    "stage_graph_counts",
+    "ratchet_ack",
+]
+
+GRAPHS_VERSION = 1
+
+# HLO op kinds that ARE host<->device (or cross-device) data movement when
+# they appear inside a compiled program. Host-memory-space copies are
+# caught separately (_HOST_SPACE in the op line).
+TRANSFER_OP_KINDS = frozenset((
+    "infeed", "outfeed",
+    "send", "send-done", "recv", "recv-done",
+))
+
+# XLA annotates host-memory-space buffers as S(5) in layouts; a copy (or
+# async copy-start/done pair) touching one is a device<->host transfer.
+_HOST_SPACE = "S(5)"
+_COPY_KINDS = frozenset(("copy", "copy-start", "copy-done"))
+
+# one HLO instruction: `  [ROOT] %name = <type> op-kind(...)`
+_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?:\([^=]*\)|\S+)\s+"
+    r"([a-zA-Z][\w\-]*)\("
+)
+_META_RE = re.compile(r'source_file="([^"]*)"\s+source_line=(\d+)')
+_TARGET_RE = re.compile(r'custom_call_target="([^"]*)"')
+# module-header donation evidence: input_output_alias={ {}: (0, {}, ...) }
+_ALIAS_BLOCK_RE = re.compile(r"input_output_alias=\{(.*?)\}\s*(?:,|$)")
+_ALIAS_PARAM_RE = re.compile(r"\(\s*(\d+)\s*,")
+
+
+def _where(line: str) -> Optional[str]:
+    """``file:line`` from an op's metadata, repo-relative when possible."""
+    m = _META_RE.search(line)
+    if not m:
+        return None
+    path, lineno = m.group(1), m.group(2)
+    for marker in ("/scconsensus_tpu/", "/tools/", "/tests/"):
+        i = path.find(marker)
+        if i >= 0:
+            path = path[i + 1:]
+            break
+    return f"{path}:{lineno}"
+
+
+def _is_callback(kind: str, line: str) -> Optional[str]:
+    """The custom-call target when this op is a host callback
+    (``pure_callback``/``io_callback`` lower to ``xla_python_*callback``
+    custom-calls), else None."""
+    if kind != "custom-call":
+        return None
+    m = _TARGET_RE.search(line)
+    if m and "callback" in m.group(1):
+        return m.group(1)
+    return None
+
+
+def _is_transfer(kind: str, line: str) -> bool:
+    if kind in TRANSFER_OP_KINDS:
+        return True
+    return kind in _COPY_KINDS and _HOST_SPACE in line
+
+
+def passport_from_hlo(
+    program: str,
+    hlo_text: str,
+    donated: int = 0,
+    memory: Optional[Dict[str, Any]] = None,
+    cost: Optional[Dict[str, Any]] = None,
+    stage: Optional[str] = None,
+    entry_ordinal: int = 1,
+    capture_s: float = 0.0,
+) -> Dict[str, Any]:
+    """One graph passport from optimized-HLO text (pure — tests feed
+    synthetic modules). ``donated`` is the number of *declared* donated
+    buffers (flattened leaves of the donated arguments); hits are the
+    module header's ``input_output_alias`` entries, misses the declared
+    remainder XLA could not alias. ``memory`` carries the
+    ``CompiledMemoryStats`` fields already plucked into a plain dict;
+    ``cost`` the normalized cost-analysis dict (obs.cost fields)."""
+    histogram: Dict[str, int] = {}
+    fusions = 0
+    transfers: List[Dict[str, Any]] = []
+    callbacks: List[Dict[str, Any]] = []
+    alias_hits = 0
+    for line in hlo_text.splitlines():
+        if "input_output_alias={" in line:
+            blk = _ALIAS_BLOCK_RE.search(line)
+            if blk:
+                alias_hits = len(_ALIAS_PARAM_RE.findall(blk.group(1)))
+        m = _OP_RE.match(line)
+        if not m:
+            continue
+        kind = m.group(1)
+        histogram[kind] = histogram.get(kind, 0) + 1
+        if kind == "fusion":
+            fusions += 1
+        target = _is_callback(kind, line)
+        if target is not None:
+            callbacks.append({"target": target, "where": _where(line)})
+        elif _is_transfer(kind, line):
+            transfers.append({"op": kind, "where": _where(line)})
+    hits = min(alias_hits, donated) if donated else alias_hits
+    misses = max(0, donated - alias_hits)
+    buffers: Dict[str, int] = {}
+    if memory:
+        for key in ("argument_bytes", "output_bytes", "temp_bytes",
+                    "alias_bytes", "generated_code_bytes"):
+            v = memory.get(key)
+            if isinstance(v, (int, float)):
+                buffers[key] = int(v)
+        # XLA's static live-set estimate: everything resident at once,
+        # minus what donation lets the program reuse in place
+        buffers["peak_bytes"] = max(0, (
+            buffers.get("argument_bytes", 0)
+            + buffers.get("output_bytes", 0)
+            + buffers.get("temp_bytes", 0)
+            - buffers.get("alias_bytes", 0)
+        ))
+    passport: Dict[str, Any] = {
+        "program": program,
+        "stage": stage,
+        "entry_ordinal": int(entry_ordinal),
+        "ops": sum(histogram.values()),
+        "op_histogram": {k: histogram[k] for k in sorted(histogram)},
+        "fusions": fusions,
+        "transfer_ops": {"count": len(transfers), "sites": transfers},
+        "host_callbacks": {"count": len(callbacks), "sites": callbacks},
+        "donation": {"declared": int(donated), "hits": int(hits),
+                     "misses": int(misses)},
+        "buffers": buffers,
+        "capture_s": round(float(capture_s), 6),
+    }
+    if cost:
+        passport["cost"] = {k: float(v) for k, v in cost.items()}
+    return passport
+
+
+def build_graphs_section(
+    passports: Sequence[Dict[str, Any]],
+    fingerprint: Optional[Dict[str, Any]] = None,
+    errors: Iterable[str] = (),
+) -> Dict[str, Any]:
+    """The ``graphs`` section from captured passports (pure). Programs
+    are keyed by their unique capture name; ``by_stage`` joins them to
+    the stage timeline by the ambient stage recorded at first call —
+    the same join ``obs.compilelog`` uses, so the compile panel and the
+    passport panel name the same rows."""
+    programs: Dict[str, Dict[str, Any]] = {}
+    by_stage: Dict[str, Dict[str, Any]] = {}
+    totals = {"programs": 0, "transfer_ops": 0, "host_callbacks": 0,
+              "donation_misses": 0, "fusions": 0}
+    for p in passports:
+        name = str(p.get("program"))
+        while name in programs:  # same program, new abstract signature
+            name += "'"
+        programs[name] = p
+        totals["programs"] += 1
+        t = (p.get("transfer_ops") or {}).get("count", 0)
+        c = (p.get("host_callbacks") or {}).get("count", 0)
+        misses = (p.get("donation") or {}).get("misses", 0)
+        totals["transfer_ops"] += t
+        totals["host_callbacks"] += c
+        totals["donation_misses"] += misses
+        totals["fusions"] += p.get("fusions", 0)
+        stage = p.get("stage") or _outside()
+        row = by_stage.setdefault(stage, {
+            "programs": [], "transfer_ops": 0, "host_callbacks": 0,
+            "donation_misses": 0,
+        })
+        row["programs"].append(name)
+        row["transfer_ops"] += t
+        row["host_callbacks"] += c
+        row["donation_misses"] += misses
+    sec: Dict[str, Any] = {
+        "version": GRAPHS_VERSION,
+        "programs": {k: programs[k] for k in sorted(programs)},
+        "by_stage": {k: by_stage[k] for k in sorted(by_stage)},
+        "totals": totals,
+    }
+    if fingerprint:
+        sec["fingerprint"] = fingerprint
+    errs = [str(e) for e in errors]
+    if errs:
+        sec["errors"] = errs
+    return sec
+
+
+def _outside() -> str:
+    from scconsensus_tpu.obs.hostprof import OUTSIDE_SPANS
+
+    return OUTSIDE_SPANS
+
+
+# --------------------------------------------------------------------------
+# environment fingerprint (satellite: passports are toolchain-keyed)
+# --------------------------------------------------------------------------
+
+_FP_FIELDS = ("jax", "jaxlib", "backend", "device_kind", "xla_flags",
+              "libtpu_init_args")
+
+
+def fingerprint_digest(fp: Dict[str, Any]) -> str:
+    """12-hex digest over the identity fields (ignores the digest field
+    itself and any future additive keys), the single equality the diff
+    tool and the ratchet key on."""
+    core = {k: fp.get(k) for k in _FP_FIELDS}
+    return hashlib.sha256(
+        json.dumps(core, sort_keys=True).encode()
+    ).hexdigest()[:12]
+
+
+def environment_fingerprint() -> Optional[Dict[str, Any]]:
+    """Toolchain identity of this process: jax/jaxlib versions, backend,
+    device kind, and the XLA/libtpu environment knobs that change
+    compiled programs. None when jax was never imported — a jax-free
+    record has no compiled programs to key. Never imports jax itself
+    (orchestrator-side records must not trigger plugin registration) and
+    never initializes a backend that is not already up."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return None
+    jax = sys.modules["jax"]
+    fp: Dict[str, Any] = {
+        "jax": getattr(jax, "__version__", None),
+        "xla_flags": os.environ.get("XLA_FLAGS") or "",
+        "libtpu_init_args": os.environ.get("LIBTPU_INIT_ARGS") or "",
+    }
+    try:
+        import jaxlib  # pairs with jax; no backend init
+
+        fp["jaxlib"] = getattr(jaxlib, "__version__", None)
+    except Exception:
+        fp["jaxlib"] = None
+    try:
+        fp["backend"] = jax.default_backend()
+        dev = jax.devices()[0]
+        fp["device_kind"] = getattr(dev, "device_kind", None)
+        fp["device_count"] = int(jax.device_count())
+    except Exception:
+        fp.setdefault("backend", None)
+        fp.setdefault("device_kind", None)
+    fp["digest"] = fingerprint_digest(fp)
+    return fp
+
+
+# --------------------------------------------------------------------------
+# runtime: armed registry, memoized first-call capture, snapshot
+# --------------------------------------------------------------------------
+
+_STATE: Dict[str, Any] = {
+    "armed": False,
+    "passports": [],      # captured passport dicts, call order
+    "seen": set(),        # (program, signature) keys already captured
+    "errors": [],
+    "lock": threading.Lock(),
+}
+
+
+def install_and_mark(force: bool = False) -> bool:
+    """Arm the passport registry (gated on ``SCC_GRAPHS`` unless
+    ``force``); also clears any capture from a previous arm so a worker
+    section holds only its own run's programs."""
+    if not force and not env_flag("SCC_GRAPHS"):
+        return False
+    reset()
+    _STATE["armed"] = True
+    return True
+
+
+def armed() -> bool:
+    return bool(_STATE["armed"])
+
+
+def reset() -> None:
+    """Disarm and drop all captured state (tests; install re-arms)."""
+    with _STATE["lock"]:
+        _STATE["armed"] = False
+        _STATE["passports"] = []
+        _STATE["seen"] = set()
+        _STATE["errors"] = []
+
+
+def _signature(args: Tuple, kwargs: Dict[str, Any]) -> Any:
+    """Hashable abstract signature: pytree structure + per-leaf
+    (shape, dtype) for arrays, value for hashable statics. NEVER reprs
+    an array — that would fetch device data mid-stage."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for x in leaves:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append(("arr", tuple(int(s) for s in shape), str(dtype)))
+        elif isinstance(x, (int, float, bool, str, type(None))):
+            sig.append(("val", x))
+        else:
+            sig.append(("type", type(x).__name__))
+    return (str(treedef), tuple(sig))
+
+
+def _count_donated(donate_argnums: Sequence[int], args: Tuple) -> int:
+    """Declared donated buffers = flattened leaves of the donated
+    positional args (what XLA sees as donatable parameters)."""
+    if not donate_argnums:
+        return 0
+    import jax
+
+    n = 0
+    for i in donate_argnums:
+        if 0 <= int(i) < len(args):
+            n += len(jax.tree_util.tree_leaves(args[int(i)]))
+    return n
+
+
+def _memory_dict(compiled) -> Optional[Dict[str, int]]:
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+    out: Dict[str, int] = {}
+    for attr, key in (
+        ("argument_size_in_bytes", "argument_bytes"),
+        ("output_size_in_bytes", "output_bytes"),
+        ("temp_size_in_bytes", "temp_bytes"),
+        ("alias_size_in_bytes", "alias_bytes"),
+        ("generated_code_size_in_bytes", "generated_code_bytes"),
+    ):
+        v = getattr(mem, attr, None)
+        if isinstance(v, (int, float)):
+            out[key] = int(v)
+    return out or None
+
+
+def _cost_dict(compiled) -> Optional[Dict[str, float]]:
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not ca:
+        return None
+    out: Dict[str, float] = {}
+    for src, dst in (("flops", "flops"), ("bytes accessed", "bytes_accessed"),
+                     ("transcendentals", "transcendentals")):
+        v = ca.get(src)
+        if v is not None:
+            out[dst] = float(v)
+    return out or None
+
+
+def observe(program: str, jitted, args: Tuple = (),
+            kwargs: Optional[Dict[str, Any]] = None,
+            donate_argnums: Sequence[int] = ()) -> None:
+    """Capture ``program``'s passport on the first call at this abstract
+    signature (no-op when disarmed or already seen — one set lookup).
+    Best-effort: a failing lower/compile records an error string, never
+    raises into the measurement."""
+    if not _STATE["armed"]:
+        return
+    kwargs = kwargs or {}
+    try:
+        key = (program, _signature(args, kwargs))
+    except Exception:
+        key = (program, None)
+    if key in _STATE["seen"]:
+        return
+    with _STATE["lock"]:
+        if key in _STATE["seen"]:
+            return
+        _STATE["seen"].add(key)
+        cap = int(env_flag("SCC_GRAPHS_MAX_PROGRAMS"))
+        if len(_STATE["passports"]) >= cap:
+            msg = f"passport cap reached ({cap}); further programs dropped"
+            if msg not in _STATE["errors"]:
+                _STATE["errors"].append(msg)
+            return
+    t0 = time.perf_counter()
+    try:
+        stage, ordinal = _ambient()
+        compiled = jitted.lower(*args, **kwargs).compile()
+        passport = passport_from_hlo(
+            program,
+            compiled.as_text(),
+            donated=_count_donated(donate_argnums, args),
+            memory=_memory_dict(compiled),
+            cost=_cost_dict(compiled),
+            stage=stage,
+            entry_ordinal=ordinal,
+            capture_s=time.perf_counter() - t0,
+        )
+        with _STATE["lock"]:
+            _STATE["passports"].append(passport)
+    except Exception as e:
+        with _STATE["lock"]:
+            _STATE["errors"].append(f"{program}: {e!r}")
+
+
+def _ambient() -> Tuple[Optional[str], int]:
+    try:
+        from scconsensus_tpu.obs.trace import ambient_stage
+
+        name, ordinal = ambient_stage()
+        if name is not None:
+            return str(name), max(1, int(ordinal))
+    except Exception:
+        pass
+    return None, 1
+
+
+class _Observed:
+    """A jitted callable plus first-call-per-signature passport capture.
+    Transparent otherwise: attribute access (``.lower``, AOT users)
+    forwards to the wrapped function, and a disarmed registry costs one
+    dict lookup per call."""
+
+    __slots__ = ("_program", "_fn", "_donate")
+
+    def __init__(self, program: str, fn, donate_argnums: Sequence[int]):
+        self._program = program
+        self._fn = fn
+        self._donate = tuple(donate_argnums)
+
+    def __call__(self, *args, **kwargs):
+        if _STATE["armed"]:
+            observe(self._program, self._fn, args, kwargs,
+                    donate_argnums=self._donate)
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+    @property
+    def __wrapped__(self):
+        return self._fn
+
+    def __repr__(self):  # pragma: no cover - debugging nicety
+        return f"<observed {self._program}: {self._fn!r}>"
+
+
+def instrument(program: str, jitted, donate_argnums: Sequence[int] = ()):
+    """Wrap an already-jitted callable as an observed stage program."""
+    return _Observed(program, jitted, donate_argnums)
+
+
+def snapshot() -> Optional[Dict[str, Any]]:
+    """The ``graphs`` section for everything captured since arming; None
+    when never armed — the record omits the section rather than claim a
+    run that was not looking compiled nothing."""
+    if not _STATE["armed"]:
+        return None
+    with _STATE["lock"]:
+        passports = list(_STATE["passports"])
+        errors = list(_STATE["errors"])
+    return build_graphs_section(
+        passports,
+        fingerprint=environment_fingerprint(),
+        errors=errors,
+    )
+
+
+# --------------------------------------------------------------------------
+# consumers: per-stage counts (the perf-gate ratchet) + pins ack
+# --------------------------------------------------------------------------
+
+def stage_graph_counts(rec: Dict[str, Any]) -> Dict[str, Dict[str, int]]:
+    """``{stage: {transfer_ops, host_callbacks}}`` from a run record's
+    graphs section ({} when absent) — the candidate side of the
+    perf-gate transfer-op ratchet."""
+    sec = rec.get("graphs")
+    if not isinstance(sec, dict):
+        return {}
+    out: Dict[str, Dict[str, int]] = {}
+    for stage, row in (sec.get("by_stage") or {}).items():
+        if isinstance(row, dict):
+            out[str(stage)] = {
+                "transfer_ops": int(row.get("transfer_ops", 0)),
+                "host_callbacks": int(row.get("host_callbacks", 0)),
+            }
+    return out
+
+
+def ratchet_ack(ratchet_entry: Dict[str, Any]) -> str:
+    """12-hex digest of one dataset's ``graph_ratchet`` pins — stamped
+    into ``extra.graph_ratchet_ack`` on bench records so committed
+    evidence names exactly which debt snapshot it was gated against."""
+    return hashlib.sha256(
+        json.dumps(ratchet_entry, sort_keys=True).encode()
+    ).hexdigest()[:12]
+
+
+# --------------------------------------------------------------------------
+# validation (export.validate_run_record dispatches here)
+# --------------------------------------------------------------------------
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(f"graphs section: {msg}")
+
+
+def _validate_sites(name: str, block: Any, site_key: str) -> int:
+    _require(isinstance(block, dict), f"{name} must be an object")
+    n = block.get("count")
+    _require(isinstance(n, int) and n >= 0, f"{name}.count must be >= 0")
+    sites = block.get("sites")
+    _require(isinstance(sites, list), f"{name}.sites must be a list")
+    _require(len(sites) == n, f"{name}.sites does not match its count")
+    for s in sites:
+        _require(isinstance(s, dict) and isinstance(s.get(site_key), str),
+                 f"{name} site missing {site_key!r}")
+        w = s.get("where")
+        _require(w is None or isinstance(w, str),
+                 f"{name} site where must be a string or null")
+    return n
+
+
+def validate_graphs(sec: Dict[str, Any]) -> None:
+    """Structural validation of a record's ``graphs`` section (additive
+    scc-run-record v1 extension): per-program passports internally
+    consistent, by_stage rows referencing real programs, totals summing
+    to the passports."""
+    _require(isinstance(sec, dict), "must be an object")
+    _require(sec.get("version") == GRAPHS_VERSION,
+             f"version must be {GRAPHS_VERSION}")
+    programs = sec.get("programs")
+    _require(isinstance(programs, dict), "programs must be an object")
+    sums = {"transfer_ops": 0, "host_callbacks": 0, "donation_misses": 0,
+            "fusions": 0}
+    for name, p in programs.items():
+        _require(isinstance(p, dict), f"programs[{name!r}] not an object")
+        ops = p.get("ops")
+        _require(isinstance(ops, int) and ops >= 0,
+                 f"programs[{name!r}].ops must be >= 0")
+        hist = p.get("op_histogram")
+        _require(isinstance(hist, dict),
+                 f"programs[{name!r}].op_histogram must be an object")
+        _require(sum(hist.values()) == ops,
+                 f"programs[{name!r}] histogram does not sum to ops")
+        fus = p.get("fusions")
+        _require(isinstance(fus, int) and fus >= 0,
+                 f"programs[{name!r}].fusions must be >= 0")
+        _require(fus == hist.get("fusion", 0),
+                 f"programs[{name!r}].fusions disagrees with histogram")
+        t = _validate_sites(f"programs[{name!r}].transfer_ops",
+                            p.get("transfer_ops"), "op")
+        c = _validate_sites(f"programs[{name!r}].host_callbacks",
+                            p.get("host_callbacks"), "target")
+        don = p.get("donation")
+        _require(isinstance(don, dict),
+                 f"programs[{name!r}].donation must be an object")
+        for k in ("declared", "hits", "misses"):
+            v = don.get(k)
+            _require(isinstance(v, int) and v >= 0,
+                     f"programs[{name!r}].donation.{k} must be >= 0")
+        _require(don["hits"] + don["misses"] <= max(don["declared"],
+                                                    don["hits"]),
+                 f"programs[{name!r}].donation counts inconsistent")
+        _require(isinstance(p.get("buffers"), dict),
+                 f"programs[{name!r}].buffers must be an object")
+        eo = p.get("entry_ordinal")
+        _require(isinstance(eo, int) and eo >= 1,
+                 f"programs[{name!r}].entry_ordinal must be >= 1")
+        sums["transfer_ops"] += t
+        sums["host_callbacks"] += c
+        sums["donation_misses"] += don["misses"]
+        sums["fusions"] += fus
+    by_stage = sec.get("by_stage")
+    _require(isinstance(by_stage, dict), "by_stage must be an object")
+    listed: List[str] = []
+    stage_sums = {"transfer_ops": 0, "host_callbacks": 0,
+                  "donation_misses": 0}
+    for stage, row in by_stage.items():
+        _require(isinstance(row, dict), f"by_stage[{stage!r}] not an object")
+        progs = row.get("programs")
+        _require(isinstance(progs, list) and progs,
+                 f"by_stage[{stage!r}].programs must be a non-empty list")
+        for nm in progs:
+            _require(nm in programs,
+                     f"by_stage[{stage!r}] references unknown program {nm!r}")
+            listed.append(nm)
+        for k in stage_sums:
+            v = row.get(k)
+            _require(isinstance(v, int) and v >= 0,
+                     f"by_stage[{stage!r}].{k} must be >= 0")
+            stage_sums[k] += v
+    _require(sorted(listed) == sorted(programs),
+             "by_stage programs do not partition the program set")
+    totals = sec.get("totals")
+    _require(isinstance(totals, dict), "totals must be an object")
+    _require(totals.get("programs") == len(programs),
+             "totals.programs disagrees with the program set")
+    for k, v in sums.items():
+        _require(totals.get(k) == v, f"totals.{k} disagrees with passports")
+    for k in stage_sums:
+        _require(stage_sums[k] == sums[k],
+                 f"by_stage {k} does not sum to totals")
+    fp = sec.get("fingerprint")
+    if fp is not None:
+        _require(isinstance(fp, dict), "fingerprint must be an object")
+        dig = fp.get("digest")
+        _require(isinstance(dig, str) and len(dig) == 12,
+                 "fingerprint.digest must be a 12-hex string")
+        _require(dig == fingerprint_digest(fp),
+                 "fingerprint.digest does not match its fields")
+    errs = sec.get("errors")
+    if errs is not None:
+        _require(isinstance(errs, list)
+                 and all(isinstance(e, str) for e in errs),
+                 "errors must be a list of strings")

@@ -569,6 +569,31 @@ class LoadgenVerdict:
 
 
 @dataclasses.dataclass
+class GraphsVerdict:
+    """Transfer-op ratchet verdict (round 24): one statically counted
+    host-crossing metric from the candidate's ``graphs`` section (or
+    its residency audit, for the TODO(item-2) boundary debt) judged
+    against the pinned starting debt in NUMERIC_PINS.json
+    ``graph_ratchet``. No noise band and no history — op counts are
+    deterministic properties of the compiled program, so the pin is a
+    ceiling: a count above it fails outright (``detail`` names the op
+    kind and source line), a count below it is ratchet progress (the
+    pin update is a reviewed edit, never automatic)."""
+
+    metric: str          # "transfer_ops@<stage>" | "host_callbacks@<stage>"
+    #                    # | "boundary_calls@<boundary>"
+    value: int
+    pinned: int
+    regressed: bool
+    excess: int = 0
+    detail: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None}
+
+
+@dataclasses.dataclass
 class GateVerdict:
     ok: bool
     key: Dict[str, str]
@@ -604,6 +629,12 @@ class GateVerdict:
     loadgen: List[LoadgenVerdict] = dataclasses.field(
         default_factory=list
     )
+    # transfer-op ratchet verdicts (round 24; empty when the candidate
+    # carried no graphs section or NUMERIC_PINS.json has no
+    # graph_ratchet entry for its dataset) — pins are ceilings, no band
+    graphs: List[GraphsVerdict] = dataclasses.field(
+        default_factory=list
+    )
 
     @property
     def regressions(self) -> List[StageVerdict]:
@@ -628,6 +659,10 @@ class GateVerdict:
     @property
     def loadgen_regressions(self) -> List[LoadgenVerdict]:
         return [v for v in self.loadgen if v.regressed]
+
+    @property
+    def graphs_regressions(self) -> List[GraphsVerdict]:
+        return [v for v in self.graphs if v.regressed]
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -658,6 +693,10 @@ class GateVerdict:
             "loadgen": [v.to_dict() for v in self.loadgen],
             "loadgen_regressions": [
                 v.to_dict() for v in self.loadgen_regressions
+            ],
+            "graphs": [v.to_dict() for v in self.graphs],
+            "graphs_regressions": [
+                v.to_dict() for v in self.graphs_regressions
             ],
         }
 
@@ -742,6 +781,101 @@ def loadgen_verdicts(candidate: Dict[str, Any],
                 lv.excess = round(floor - float(v), 4)
             out.append(lv)
     return out
+
+
+def _graph_sites(sec: Dict[str, Any], stage: str, kind: str) -> str:
+    """Human-readable site list for one stage's transfer ops or host
+    callbacks: ``op@file:line`` per site, drawn from the stage's
+    passports — the line the ratchet FAIL message names."""
+    parts: List[str] = []
+    programs = sec.get("programs") or {}
+    row = (sec.get("by_stage") or {}).get(stage) or {}
+    for name in row.get("programs") or []:
+        block = (programs.get(name) or {}).get(kind) or {}
+        for site in block.get("sites") or []:
+            op = site.get("op") or site.get("target") or "?"
+            where = site.get("where") or "unknown source"
+            parts.append(f"{op}@{where} [{name}]")
+    return "; ".join(parts)
+
+
+def graphs_verdicts(
+    candidate: Dict[str, Any], ratchet: Optional[Dict[str, Any]]
+) -> Tuple[List[GraphsVerdict], Optional[str]]:
+    """Transfer-op ratchet verdicts (round 24) for one candidate against
+    one dataset's ``graph_ratchet`` pins entry.
+
+    Three metric families, all ceilings with no noise band (op counts
+    are deterministic properties of the compiled program):
+
+    * ``transfer_ops@<stage>`` / ``host_callbacks@<stage>`` — the
+      candidate's per-stage static counts from its ``graphs`` section;
+      a regressed verdict's detail names each op kind and source line.
+    * ``boundary_calls@<boundary>`` — runtime call counts at the
+      ``TODO(item-2)`` residency boundaries (the declared host
+      crossings item 1 is burning down), from the residency audit.
+
+    Returns ``(verdicts, note)``. The lane refuses to gate — empty
+    verdicts, explanatory note — when the candidate has no graphs
+    section, the ratchet entry is absent, or the candidate's
+    environment-fingerprint digest differs from the pinned one
+    (op censuses from different toolchains are different programs)."""
+    if not isinstance(ratchet, dict) or not ratchet:
+        return [], None
+    sec = candidate.get("graphs")
+    if not isinstance(sec, dict):
+        return [], "graph ratchet pinned but candidate has no graphs section"
+    pinned_fp = ratchet.get("fingerprint_digest")
+    cand_fp = (sec.get("fingerprint") or {}).get("digest")
+    if pinned_fp and cand_fp and pinned_fp != cand_fp:
+        return [], (
+            f"graph ratchet not applied: candidate fingerprint {cand_fp} "
+            f"!= pinned {pinned_fp} (different toolchain compiles a "
+            "different program; re-pin on the new toolchain)"
+        )
+    out: List[GraphsVerdict] = []
+    by_stage = sec.get("by_stage") or {}
+    for stage in sorted(ratchet.get("stages") or {}):
+        pins = ratchet["stages"][stage] or {}
+        row = by_stage.get(stage) or {}
+        for field, kind in (("transfer_ops", "transfer_ops"),
+                            ("host_callbacks", "host_callbacks")):
+            pin = pins.get(field)
+            if pin is None:
+                continue
+            value = int(row.get(field, 0))
+            v = GraphsVerdict(
+                metric=f"{field}@{stage}", value=value, pinned=int(pin),
+                regressed=value > int(pin),
+            )
+            if v.regressed:
+                v.excess = value - int(pin)
+                v.detail = (_graph_sites(sec, stage, kind)
+                            or "sites unavailable in passports")
+            out.append(v)
+    boundaries = ratchet.get("boundaries") or {}
+    if boundaries:
+        by_boundary = ((candidate.get("residency") or {})
+                       .get("by_boundary") or {})
+        for bname in sorted(boundaries):
+            pin = (boundaries[bname] or {}).get("calls")
+            if pin is None:
+                continue
+            row = by_boundary.get(bname) or {}
+            value = int(row.get("calls", 0))
+            v = GraphsVerdict(
+                metric=f"boundary_calls@{bname}", value=value,
+                pinned=int(pin), regressed=value > int(pin),
+            )
+            if v.regressed:
+                v.excess = value - int(pin)
+                v.detail = (
+                    f"declared TODO(item-2) crossing {bname!r} ran "
+                    f"{value}x vs pinned {int(pin)}x "
+                    "(obs.residency BOUNDARIES names the call site)"
+                )
+            out.append(v)
+    return out, None
 
 
 def _efficiency(cand_cost: Optional[Dict[str, Any]],

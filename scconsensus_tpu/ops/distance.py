@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from scconsensus_tpu.obs.graphs import instrument as _passport
+
 __all__ = [
     "euclidean_distance_matrix",
     "pearson_distance_matrix",
@@ -36,7 +38,8 @@ def _sq_dists_raw(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(a2 + b2.T - 2.0 * (a @ b.T), 0.0)
 
 
-_sq_dists = jax.jit(_sq_dists_raw)
+# graph passport (obs.graphs, SCC_GRAPHS): the distance-stream tile kernel
+_sq_dists = _passport("distance.sq_dists", jax.jit(_sq_dists_raw))
 
 
 def euclidean_distance_matrix(x: jnp.ndarray) -> jnp.ndarray:
@@ -56,6 +59,11 @@ def pearson_distance_matrix(cols: jnp.ndarray) -> jnp.ndarray:
     norm = jnp.sqrt(jnp.sum(x * x, axis=0, keepdims=True))
     xn = x / jnp.maximum(norm, 1e-12)
     return 1.0 - xn.T @ xn
+
+
+pearson_distance_matrix = _passport(
+    "distance.pearson_distance_matrix", pearson_distance_matrix
+)
 
 
 def distance_row_blocks(

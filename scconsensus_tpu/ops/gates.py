@@ -22,6 +22,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from scconsensus_tpu.obs.graphs import instrument as _passport
+
 __all__ = [
     "ClusterAggregates", "compute_aggregates", "compute_aggregates_cid",
     "pair_gates_fast", "pair_gates_slow",
@@ -192,3 +194,12 @@ def pair_gates_slow(
         me = agg.mean_expm1
         gate = (me[:, pair_i].T > mean_exprs_thrs) | (me[:, pair_j].T > mean_exprs_thrs)
     return gate, log_fc
+
+
+# graph passports (obs.graphs, SCC_GRAPHS): the gate-funnel stage programs
+compute_aggregates = _passport("gates.compute_aggregates", compute_aggregates)
+compute_aggregates_cid = _passport(
+    "gates.compute_aggregates_cid", compute_aggregates_cid
+)
+pair_gates_fast = _passport("gates.pair_gates_fast", pair_gates_fast)
+pair_gates_slow = _passport("gates.pair_gates_slow", pair_gates_slow)

@@ -17,6 +17,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from scconsensus_tpu.obs.graphs import instrument as _passport
+
 __all__ = ["pca_scores", "pca_scores_audited", "pca_basis"]
 
 
@@ -115,3 +117,9 @@ def pca_basis(
     mean, vt, _ = _subspace_basis(x, n_components, n_oversample, n_iter,
                                   seed)
     return mean, vt
+
+
+# graph passports (obs.graphs, SCC_GRAPHS): the rSVD embed stage programs
+pca_scores = _passport("embed.pca_scores", pca_scores)
+pca_scores_audited = _passport("embed.pca_scores_audited", pca_scores_audited)
+pca_basis = _passport("embed.pca_basis", pca_basis)

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from scconsensus_tpu.obs.graphs import instrument as _passport
 from scconsensus_tpu.ops.distance import _sq_dists_raw
 from scconsensus_tpu.ops.linkage import HClustTree, ward_linkage
 
@@ -243,6 +244,13 @@ def _assign_blocks(pb, cent):
 
     _, a = jax.lax.scan(fold, None, pb)
     return a
+
+
+# graph passports (obs.graphs, SCC_GRAPHS): the landmark-assign stage
+# programs (sketch fit, legacy full-data Lloyd, cut-propagation 1-NN)
+_lloyd = _passport("landmark.lloyd", _lloyd)
+_lloyd_sketch = _passport("landmark.lloyd_sketch", _lloyd_sketch)
+_assign_blocks = _passport("landmark.assign_blocks", _assign_blocks)
 
 
 def landmark_pool(

@@ -80,6 +80,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from scconsensus_tpu.obs.graphs import instrument as _passport
 from scconsensus_tpu.ops.wilcoxon import wilcoxon_from_ranks
 
 __all__ = [
@@ -468,9 +469,15 @@ def ranksum_body_runspace(
 
 # Single-device jitted entries; the sharded form lives in
 # parallel.sharded_de.sharded_allpairs_ranksum and shard_maps the scan body.
-allpairs_ranksum_chunk = jax.jit(
+# Wrapped for graph passports (obs.graphs, SCC_GRAPHS): the wilcox-ladder
+# stage programs, incl. the CSR-window runspace form.
+allpairs_ranksum_chunk = _passport("wilcox.allpairs_ranksum_chunk", jax.jit(
     ranksum_body, static_argnames=("n_clusters", "window", "cpu_forms")
+))
+allpairs_ranksum_runspace_chunk = _passport(
+    "wilcox.allpairs_ranksum_runspace_chunk", jax.jit(
+        ranksum_body_runspace,
+        static_argnames=("n_clusters", "window", "run_cap"),
+    )
 )
-allpairs_ranksum_runspace_chunk = jax.jit(
-    ranksum_body_runspace, static_argnames=("n_clusters", "window", "run_cap")
-)
+sort_probe = _passport("wilcox.sort_probe", sort_probe)

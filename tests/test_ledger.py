@@ -224,3 +224,42 @@ class TestCommittedEvidence:
             "the committed hostprofdemo trio (all three sections) "
             "went missing"
         )
+
+    # bench records created after this stamp ran with the round-24
+    # compiled-program observatory armed; earlier history is exempt
+    R24_GRAPHS_CUTOFF = 1786060000
+
+    def test_graph_passport_sections_lint(self):
+        """Round-24 schema lint (ISSUE 24 satellite): new committed bench
+        evidence must carry a validated ``graphs`` section and the
+        ``graph_ratchet_ack`` stamp naming the debt snapshot it was gated
+        against; any record carrying a graphs section (whatever its
+        source) must survive section validation."""
+        from scconsensus_tpu.obs.graphs import validate_graphs
+
+        led = Ledger(str(REPO / "evidence"))
+        new_bench = 0
+        for e in led.entries():
+            rec = led.load(e["file"])
+            if "graphs" in rec:
+                assert isinstance(rec["graphs"], dict) and rec["graphs"], (
+                    f"{e['file']}: graphs present but not a truthy dict"
+                )
+                validate_graphs(rec["graphs"])
+            created = (rec.get("run") or {}).get("created_unix") or 0
+            if e["source"] == "bench" and created >= self.R24_GRAPHS_CUTOFF:
+                new_bench += 1
+                assert "graphs" in rec, (
+                    f"{e['file']}: post-r24 bench record without a graphs "
+                    "section — the worker must arm SCC_GRAPHS"
+                )
+                ack = (rec.get("extra") or {}).get("graph_ratchet_ack")
+                assert isinstance(ack, str) and len(ack) == 12, (
+                    f"{e['file']}: post-r24 bench record without a "
+                    "graph_ratchet_ack — bench must stamp the pinned "
+                    "debt snapshot it was gated against"
+                )
+        assert new_bench >= 1, (
+            "the committed r24 quick anchor (graphs + ratchet ack) "
+            "went missing"
+        )

@@ -1,0 +1,524 @@
+"""Compiled-program observatory (ISSUE 24 tentpole): graph passports
+from AOT artifacts — HLO op census, transfer-op/host-callback sites with
+source locations, donation hits vs misses, XLA buffer estimates — built
+into a schema-validated ``graphs`` run-record section, diffed by
+tools/graph_diff.py (cross-fingerprint comparisons refused), and gated
+by the perf gate's transfer-op ratchet against the starting debt pinned
+in evidence/NUMERIC_PINS.json ``graph_ratchet``."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import pytest
+
+from scconsensus_tpu.obs import graphs, regress
+from scconsensus_tpu.obs.graphs import (
+    GRAPHS_VERSION,
+    build_graphs_section,
+    environment_fingerprint,
+    fingerprint_digest,
+    instrument,
+    passport_from_hlo,
+    ratchet_ack,
+    stage_graph_counts,
+    validate_graphs,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+EVIDENCE = REPO / "evidence"
+DEMO_CLEAN = "RUN_graphsdemo_cpu_8db473d0a7d2_1786100001.json"
+DEMO_LEAKY = "RUN_graphsdemo_cpu_8db473d0a7d2_1786100002.json"
+QUICK_R24 = "RUN_quick_cpu_dc28fb1eb588_1786061341.json"
+
+# a hand-written optimized-HLO module exercising every parser branch:
+# fusion + histogram, a host callback custom-call, an outfeed, a
+# host-memory-space copy (S(5)) vs a plain device copy, source-location
+# metadata, and an input_output_alias donation header
+_HLO = """\
+HloModule synth, input_output_alias={ {}: (0, {}, may-alias) }, entry_computation_layout={(f32[4,4]{1,0})->f32[4,4]{1,0}}
+
+%fcomp (p: f32[4,4]) -> f32[4,4] {
+  %p = f32[4,4]{1,0} parameter(0)
+  ROOT %m = f32[4,4]{1,0} multiply(%p, %p)
+}
+
+ENTRY %main (p0: f32[4,4]) -> f32[4,4] {
+  %p0 = f32[4,4]{1,0} parameter(0)
+  %c = f32[] constant(1)
+  %fused = f32[4,4]{1,0} fusion(%p0), kind=kLoop, calls=%fcomp, metadata={op_name="mul" source_file="/work/repo/scconsensus_tpu/ops/demo.py" source_line=12}
+  %cb = f32[4,4]{1,0} custom-call(%fused), custom_call_target="xla_python_cpu_callback", metadata={source_file="/work/repo/tools/demo_tool.py" source_line=9}
+  %solve = f32[4,4]{1,0} custom-call(%cb), custom_call_target="lapack_sgetrf"
+  %of = token[] outfeed(%cb), outfeed_shape=f32[4,4]{1,0}
+  %hostcopy = f32[4,4]{1,0:S(5)} copy(%cb), metadata={source_file="/work/repo/scconsensus_tpu/ops/demo.py" source_line=30}
+  ROOT %r = f32[4,4]{1,0} copy(%hostcopy)
+}
+"""
+
+
+# --------------------------------------------------------------------------
+# HLO parsing
+# --------------------------------------------------------------------------
+
+class TestPassportFromHlo:
+    def test_op_census_and_fusions(self):
+        p = passport_from_hlo("synth", _HLO)
+        h = p["op_histogram"]
+        assert h["fusion"] == 1 and p["fusions"] == 1
+        assert h["parameter"] == 2  # entry + fusion computation
+        assert h["copy"] == 2 and h["custom-call"] == 2
+        assert p["ops"] == sum(h.values())
+
+    def test_host_callback_named_with_source_line(self):
+        p = passport_from_hlo("synth", _HLO)
+        cb = p["host_callbacks"]
+        assert cb["count"] == 1
+        site = cb["sites"][0]
+        assert site["target"] == "xla_python_cpu_callback"
+        # repo path trimmed at the /tools/ marker
+        assert site["where"] == "tools/demo_tool.py:9"
+
+    def test_non_callback_custom_call_not_counted(self):
+        p = passport_from_hlo("synth", _HLO)
+        targets = [s["target"] for s in p["host_callbacks"]["sites"]]
+        assert "lapack_sgetrf" not in targets
+
+    def test_transfer_ops_outfeed_and_host_space_copy_only(self):
+        p = passport_from_hlo("synth", _HLO)
+        t = p["transfer_ops"]
+        # the outfeed and the S(5) copy — NOT the plain device copy
+        assert t["count"] == 2
+        kinds = sorted(s["op"] for s in t["sites"])
+        assert kinds == ["copy", "outfeed"]
+        cop = [s for s in t["sites"] if s["op"] == "copy"][0]
+        assert cop["where"] == "scconsensus_tpu/ops/demo.py:30"
+
+    def test_donation_hits_and_misses_from_alias_header(self):
+        hit = passport_from_hlo("synth", _HLO, donated=1)
+        assert hit["donation"] == {"declared": 1, "hits": 1, "misses": 0}
+        # two declared donatable buffers, one alias entry → one miss
+        miss = passport_from_hlo("synth", _HLO, donated=2)
+        assert miss["donation"] == {"declared": 2, "hits": 1, "misses": 1}
+
+    def test_buffer_estimates_and_peak(self):
+        p = passport_from_hlo("synth", _HLO, memory={
+            "argument_bytes": 100, "output_bytes": 50, "temp_bytes": 30,
+            "alias_bytes": 40, "generated_code_bytes": 7,
+        })
+        assert p["buffers"]["peak_bytes"] == 100 + 50 + 30 - 40
+
+    def test_validates_as_section(self):
+        sec = build_graphs_section([passport_from_hlo("synth", _HLO)])
+        validate_graphs(sec)
+        assert sec["version"] == GRAPHS_VERSION
+        assert sec["totals"] == {"programs": 1, "transfer_ops": 2,
+                                 "host_callbacks": 1, "donation_misses": 0,
+                                 "fusions": 1}
+
+
+class TestSectionBuild:
+    def test_same_program_new_signature_gets_primed_name(self):
+        a = passport_from_hlo("wilcox.chunk", _HLO, stage="wilcox")
+        b = passport_from_hlo("wilcox.chunk", _HLO, stage="wilcox")
+        sec = build_graphs_section([a, b])
+        validate_graphs(sec)
+        assert sorted(sec["programs"]) == ["wilcox.chunk", "wilcox.chunk'"]
+        assert sec["by_stage"]["wilcox"]["transfer_ops"] == 4
+
+    def test_validate_rejects_totals_drift(self):
+        sec = build_graphs_section([passport_from_hlo("p", _HLO)])
+        sec["totals"]["transfer_ops"] += 1
+        with pytest.raises(ValueError, match="totals.transfer_ops"):
+            validate_graphs(sec)
+
+    def test_validate_rejects_unknown_stage_program(self):
+        sec = build_graphs_section([passport_from_hlo("p", _HLO,
+                                                      stage="s")])
+        sec["by_stage"]["s"]["programs"] = ["ghost"]
+        with pytest.raises(ValueError, match="unknown program"):
+            validate_graphs(sec)
+
+    def test_validate_rejects_sites_count_mismatch(self):
+        sec = build_graphs_section([passport_from_hlo("p", _HLO)])
+        sec["programs"]["p"]["host_callbacks"]["count"] += 1
+        with pytest.raises(ValueError, match="does not match its count"):
+            validate_graphs(sec)
+
+    def test_errors_carried_through(self):
+        sec = build_graphs_section([], errors=["wilcox.chunk: boom"])
+        validate_graphs(sec)
+        assert sec["errors"] == ["wilcox.chunk: boom"]
+
+
+# --------------------------------------------------------------------------
+# environment fingerprint (satellite 1: passports are toolchain-keyed)
+# --------------------------------------------------------------------------
+
+class TestFingerprint:
+    def test_digest_matches_fields_and_ignores_additive_keys(self):
+        import jax  # noqa: F401  (ensure fingerprint is available)
+
+        fp = environment_fingerprint()
+        assert fp is not None and len(fp["digest"]) == 12
+        assert fp["digest"] == fingerprint_digest(fp)
+        extended = dict(fp, future_key="whatever")
+        assert fingerprint_digest(extended) == fp["digest"]
+
+    def test_digest_changes_with_xla_flags(self):
+        import jax  # noqa: F401
+
+        fp = environment_fingerprint()
+        bent = dict(fp, xla_flags="--xla_force_host_platform_device_count=2")
+        assert fingerprint_digest(bent) != fp["digest"]
+
+    def test_stamped_on_run_records(self):
+        import jax  # noqa: F401
+        from scconsensus_tpu.obs.export import build_run_record
+
+        rec = build_run_record(metric="m", value=1.0, unit="s")
+        fp = rec["run"].get("env_fingerprint")
+        assert fp is not None and fp["digest"] == fingerprint_digest(fp)
+
+
+# --------------------------------------------------------------------------
+# live capture: arming, memoization, donation (satellite 3), overhead
+# --------------------------------------------------------------------------
+
+@pytest.fixture
+def armed_registry():
+    graphs.install_and_mark(force=True)
+    yield
+    graphs.reset()
+
+
+class TestLiveCapture:
+    def test_disarmed_wrapper_is_transparent(self):
+        import jax
+        import jax.numpy as jnp
+
+        graphs.reset()
+        f = instrument("t.disarmed", jax.jit(lambda x: x + 1))
+        out = f(jnp.ones((3,)))
+        assert float(out[0]) == 2.0
+        assert graphs.snapshot() is None  # never armed → no section
+
+    def test_first_call_captures_then_memoizes(self, armed_registry):
+        import jax
+        import jax.numpy as jnp
+
+        f = instrument("t.memo", jax.jit(lambda x: x * 2))
+        f(jnp.ones((4,)))
+        f(jnp.ones((4,)))          # same abstract signature: no recapture
+        f(jnp.ones((8,)))          # new shape: second passport
+        sec = graphs.snapshot()
+        validate_graphs(sec)
+        assert sorted(sec["programs"]) == ["t.memo", "t.memo'"]
+
+    def test_donation_miss_surfaces_and_clean_donation_does_not(
+            self, armed_registry):
+        """Satellite 3: a donated buffer XLA cannot reuse (shape grows
+        through the program) is a miss; a same-shape elementwise program
+        donates cleanly."""
+        import jax
+        import jax.numpy as jnp
+
+        clean = instrument(
+            "t.donate_ok",
+            jax.jit(lambda x: x + 1.0, donate_argnums=(0,)),
+            donate_argnums=(0,))
+        clean(jnp.ones((128,)))
+        grown = instrument(
+            "t.donate_miss",
+            jax.jit(lambda x: jnp.concatenate([x, x]),
+                    donate_argnums=(0,)),
+            donate_argnums=(0,))
+        grown(jnp.ones((128,)))
+        sec = graphs.snapshot()
+        ok = sec["programs"]["t.donate_ok"]["donation"]
+        miss = sec["programs"]["t.donate_miss"]["donation"]
+        assert ok["declared"] == 1 and ok["misses"] == 0 and ok["hits"] == 1
+        assert miss["declared"] == 1 and miss["misses"] == 1
+
+    def test_pure_callback_detected_with_this_files_location(
+            self, armed_registry):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def leaky(x):
+            y = x * 2
+            y = jax.pure_callback(
+                lambda a: np.asarray(a) + 1.0,
+                jax.ShapeDtypeStruct(y.shape, y.dtype), y)
+            return y
+
+        f = instrument("t.leaky", jax.jit(leaky))
+        f(jnp.ones((4,)))
+        sec = graphs.snapshot()
+        cb = sec["programs"]["t.leaky"]["host_callbacks"]
+        assert cb["count"] == 1
+        assert "callback" in cb["sites"][0]["target"]
+        assert "tests/test_obs_graphs.py" in (cb["sites"][0]["where"] or "")
+
+    def test_capture_failure_lands_in_errors_not_raised(
+            self, armed_registry):
+        class Boom:
+            def lower(self, *a, **k):
+                raise RuntimeError("no lowering for you")
+
+            def __call__(self, *a, **k):
+                return None
+
+        f = instrument("t.boom", Boom())
+        f()
+        sec = graphs.snapshot()
+        assert any("t.boom" in e for e in sec.get("errors", []))
+        assert "t.boom" not in sec["programs"]
+
+    def test_steady_state_overhead_under_50ms(self, armed_registry):
+        """Satellite 5 pin: once a program's passport is captured, the
+        wrapper's per-call cost is one memo lookup — 2000 calls must add
+        well under the 50 ms budget (measured against the bare fn)."""
+        import jax
+        import jax.numpy as jnp
+
+        jitted = jax.jit(lambda x: x + 1)
+        f = instrument("t.overhead", jitted)
+        x = jnp.ones((4,))
+        f(x)  # capture once
+        n = 2000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            jitted(x)
+        bare = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            f(x)
+        wrapped = time.perf_counter() - t0
+        assert wrapped - bare < 0.050, (
+            f"steady-state passport overhead {wrapped - bare:.4f}s "
+            f"over {n} calls (bare {bare:.4f}s)")
+
+    def test_aot_attribute_access_forwards(self, armed_registry):
+        import jax
+        import jax.numpy as jnp
+
+        f = instrument("t.aot", jax.jit(lambda x: x + 1))
+        lowered = f.lower(jnp.ones((4,)))  # bench's AOT path
+        assert hasattr(lowered, "compile")
+        assert f.__wrapped__ is not None
+
+
+# --------------------------------------------------------------------------
+# committed demo pair + graph_diff (tentpole acceptance)
+# --------------------------------------------------------------------------
+
+def _load(name):
+    with open(EVIDENCE / name) as f:
+        return json.load(f)
+
+
+class TestCommittedDemoPairAndDiff:
+    def test_pair_committed_valid_and_fingerprint_matched(self):
+        from scconsensus_tpu.obs.export import validate_run_record
+
+        clean, leaky = _load(DEMO_CLEAN), _load(DEMO_LEAKY)
+        for rec in (clean, leaky):
+            validate_run_record(rec)
+            validate_graphs(rec["graphs"])
+        cfp = clean["graphs"]["fingerprint"]["digest"]
+        lfp = leaky["graphs"]["fingerprint"]["digest"]
+        assert cfp == lfp, "demo pair must stay diffable"
+
+    def test_diff_names_injected_callback_with_source_line(self):
+        sys.path.insert(0, str(REPO / "tools"))
+        try:
+            from graph_diff import diff_sections
+        finally:
+            sys.path.pop(0)
+        d = diff_sections(_load(DEMO_LEAKY)["graphs"],
+                          _load(DEMO_CLEAN)["graphs"])
+        assert d["totals_delta"]["host_callbacks"] == 1
+        sites = [s for r in d["regressions"]
+                 for s in r.get("added_crossings", [])]
+        assert len(sites) == 1
+        assert sites[0]["kind"] == "host callback"
+        assert "callback" in sites[0]["op"]
+        assert sites[0]["where"].startswith("tools/make_graphs_demo.py:")
+
+    def test_cli_exits_nonzero_and_names_the_op(self):
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "graph_diff.py"),
+             str(EVIDENCE / DEMO_LEAKY), str(EVIDENCE / DEMO_CLEAN)],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 1, r.stderr
+        assert "REGRESSED demo.tile" in r.stdout
+        assert "tools/make_graphs_demo.py:" in r.stdout
+
+    def test_cli_clean_direction_exits_zero(self):
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "graph_diff.py"),
+             str(EVIDENCE / DEMO_CLEAN), str(EVIDENCE / DEMO_LEAKY)],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "clean" in r.stdout
+
+    def test_cli_refuses_cross_fingerprint(self, tmp_path):
+        """Satellite 1: diffing op censuses from different toolchains
+        would report noise as regressions — refused with exit 2."""
+        rec = _load(DEMO_CLEAN)
+        fp = rec["graphs"]["fingerprint"]
+        fp["jax"] = "99.0.0"
+        fp["digest"] = fingerprint_digest(fp)
+        other = tmp_path / "other_toolchain.json"
+        other.write_text(json.dumps(rec))
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "graph_diff.py"),
+             str(other), str(EVIDENCE / DEMO_LEAKY)],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 2
+        assert "cross-fingerprint" in r.stderr
+
+    def test_cli_sectionless_record_exits_two_with_hint(self, tmp_path):
+        rec = _load(DEMO_CLEAN)
+        rec.pop("graphs")
+        old = tmp_path / "pre_r24.json"
+        old.write_text(json.dumps(rec))
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "graph_diff.py"),
+             str(old), str(EVIDENCE / DEMO_CLEAN)],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 2
+        assert "SCC_GRAPHS=1" in r.stdout + r.stderr
+
+
+# --------------------------------------------------------------------------
+# the transfer-op ratchet (perf-gate lane + committed pins, satellite 6)
+# --------------------------------------------------------------------------
+
+def _ratchet():
+    with open(EVIDENCE / "NUMERIC_PINS.json") as f:
+        return json.load(f)["graph_ratchet"]["quick"]
+
+
+class TestRatchet:
+    def test_committed_pins_match_committed_quick_record(self):
+        """The armed starting debt: the pinned per-stage counts and
+        TODO(item-2) boundary calls are exactly what the committed r24
+        quick record measured, and the record's ack names this entry."""
+        entry = _ratchet()
+        rec = _load(QUICK_R24)
+        assert entry["fingerprint_digest"] == \
+            rec["graphs"]["fingerprint"]["digest"]
+        assert entry["stages"] == stage_graph_counts(rec)
+        bb = rec["residency"]["by_boundary"]
+        for b, pin in entry["boundaries"].items():
+            assert pin["calls"] == (bb.get(b) or {}).get("calls", 0)
+        assert rec["extra"]["graph_ratchet_ack"] == ratchet_ack(entry)
+
+    def test_pinned_boundaries_are_the_item2_allowlist(self):
+        from scconsensus_tpu.obs.profile import ITEM2_BOUNDARIES
+
+        assert sorted(_ratchet()["boundaries"]) == sorted(ITEM2_BOUNDARIES)
+
+    def test_clean_candidate_passes_lane(self):
+        verdicts, note = regress.graphs_verdicts(_load(QUICK_R24),
+                                                 _ratchet())
+        assert note is None and verdicts
+        assert not any(v.regressed for v in verdicts)
+
+    def test_new_callback_regresses_with_site_detail(self):
+        rec = _load(QUICK_R24)
+        p = rec["graphs"]["programs"]["gates.pair_gates_fast"]
+        p["host_callbacks"] = {"count": 1, "sites": [
+            {"target": "xla_python_cpu_callback",
+             "where": "scconsensus_tpu/ops/gates.py:123"}]}
+        rec["graphs"]["by_stage"]["gates"]["host_callbacks"] = 1
+        rec["graphs"]["totals"]["host_callbacks"] = 1
+        verdicts, note = regress.graphs_verdicts(rec, _ratchet())
+        bad = [v for v in verdicts if v.regressed]
+        assert len(bad) == 1
+        assert bad[0].metric == "host_callbacks@gates"
+        assert "scconsensus_tpu/ops/gates.py:123" in bad[0].detail
+
+    def test_boundary_call_growth_regresses(self):
+        rec = _load(QUICK_R24)
+        rec["residency"]["by_boundary"]["embed_scores_fetch"]["calls"] += 1
+        verdicts, _ = regress.graphs_verdicts(rec, _ratchet())
+        bad = [v for v in verdicts if v.regressed]
+        assert [v.metric for v in bad] == \
+            ["boundary_calls@embed_scores_fetch"]
+
+    def test_fingerprint_mismatch_refuses_to_gate(self):
+        rec = _load(QUICK_R24)
+        fp = rec["graphs"]["fingerprint"]
+        fp["jaxlib"] = "0.0.1"
+        fp["digest"] = fingerprint_digest(fp)
+        verdicts, note = regress.graphs_verdicts(rec, _ratchet())
+        assert verdicts == []
+        assert note is not None and "different toolchain" in note
+
+    def test_sectionless_candidate_notes_not_gates(self):
+        rec = _load(QUICK_R24)
+        rec.pop("graphs")
+        verdicts, note = regress.graphs_verdicts(rec, _ratchet())
+        assert verdicts == [] and "no graphs section" in note
+
+    def test_absent_ratchet_is_silent(self):
+        assert regress.graphs_verdicts(_load(QUICK_R24), None) == ([], None)
+
+
+# --------------------------------------------------------------------------
+# renderers: tail_run panel + graceful degradation (satellite 2)
+# --------------------------------------------------------------------------
+
+def _render(partial):
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from tail_run import render
+    finally:
+        sys.path.pop(0)
+    header = {"schema": "scc-heartbeat", "metric": "t", "pid": 1,
+              "started_unix": 100.0}
+    tick = {"ts": 101.0, "uptime_s": 1.0, "rss_bytes": 1 << 20,
+            "open_spans": []}
+    return render([header, tick], partial=partial, now=102.0)
+
+class TestRenderers:
+    def test_graphs_panel_renders_per_stage_counts(self):
+        txt = _render(_load(QUICK_R24))
+        assert "graph passports: 7 programs" in txt
+        assert "transfer ops 0" in txt
+        assert "[fp " in txt
+
+    def test_malformed_section_degrades_to_one_line(self):
+        rec = _load(QUICK_R24)
+        rec["graphs"] = {"totals": "not-a-dict"}
+        txt = _render(rec)
+        assert "section unreadable" in txt
+
+    def test_pre_r24_record_notes_absent_sections(self):
+        rec = _load("BENCH_r05.json")
+        txt = _render(rec)
+        assert "sections absent" in txt and "graphs" in txt
+
+    def test_postmortem_surfaces_graphs_totals(self, tmp_path):
+        sys.path.insert(0, str(REPO / "tools"))
+        try:
+            import postmortem
+        finally:
+            sys.path.pop(0)
+        p = tmp_path / "X_partial.json"
+        p.write_text(json.dumps(_load(QUICK_R24)))
+        events = postmortem._partial_events(str(p), "X")
+        g = [e for e in events if e["kind"] == "graphs"]
+        assert g and g[0]["programs"] == 7 and g[0]["transfer_ops"] == 0
+        line = postmortem._fmt_ev(g[0], 0.0)
+        assert "graphs" in line and "programs=7" in line

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Diff the graph passports of two run records (obs.graphs sections).
+
+Usage:
+    graph_diff.py CANDIDATE.json BASELINE.json [--json]
+
+Compares the compiled-program observatory sections of two evidence
+records program by program and prints, for every program present in
+both: op-kind histogram deltas, fusion-count delta, donation-miss
+delta, buffer-byte deltas, and — the part the ratchet cares about —
+every transfer op or host callback present in the candidate but not
+the baseline, named by op kind, count delta, and the source location
+XLA recorded for it.
+
+Exit codes:
+    0  no host-crossing regression (op mix may still differ — reported)
+    1  the candidate added transfer ops, host callbacks, or donation
+       misses relative to the baseline (each named with its source line)
+    2  usage/IO error — including a cross-fingerprint comparison: when
+       the two records carry different environment-fingerprint digests
+       (jax/jaxlib/backend/device/XLA flags), their op censuses are
+       different programs by construction and diffing them would report
+       toolchain noise as regressions. Re-record one side on the other's
+       toolchain instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from scconsensus_tpu.obs.graphs import validate_graphs  # noqa: E402
+
+
+def _load_section(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """(record, graphs section) from an evidence-record path."""
+    with open(path) as f:
+        rec = json.load(f)
+    sec = rec.get("graphs")
+    if not isinstance(sec, dict):
+        raise ValueError(
+            f"error: {path} has no graphs section — re-run its bench "
+            "with SCC_GRAPHS=1 (section absent on pre-r24 records)"
+        )
+    validate_graphs(sec)
+    return rec, sec
+
+
+def _sites(p: Dict[str, Any], kind: str) -> Dict[Tuple[str, str], int]:
+    """{(op-or-target, where): count} for one passport's transfer ops or
+    host callbacks — the unit of 'new host crossing'."""
+    out: Dict[Tuple[str, str], int] = {}
+    for s in (p.get(kind) or {}).get("sites") or []:
+        key = (s.get("op") or s.get("target") or "?",
+               s.get("where") or "unknown source")
+        out[key] = out.get(key, 0) + 1
+    return out
+
+
+def diff_sections(cand: Dict[str, Any], base: Dict[str, Any]
+                  ) -> Dict[str, Any]:
+    """Structured passport diff (pure; the CLI renders it). Regressions
+    are per-program lists of added transfer/callback sites plus donation
+    misses introduced; ``changed`` holds the informational op-mix
+    deltas."""
+    cp, bp = cand.get("programs") or {}, base.get("programs") or {}
+    regressions: List[Dict[str, Any]] = []
+    changed: List[Dict[str, Any]] = []
+    for name in sorted(set(cp) & set(bp)):
+        c, b = cp[name], bp[name]
+        entry: Dict[str, Any] = {"program": name}
+        for kind, site_label in (("transfer_ops", "transfer op"),
+                                 ("host_callbacks", "host callback")):
+            cs, bs = _sites(c, kind), _sites(b, kind)
+            added = []
+            for (op, where), n in sorted(cs.items()):
+                delta = n - bs.get((op, where), 0)
+                if delta > 0:
+                    added.append({"op": op, "where": where,
+                                  "count_delta": delta,
+                                  "kind": site_label})
+            if added:
+                entry.setdefault("added_crossings", []).extend(added)
+        dmiss = ((c.get("donation") or {}).get("misses", 0)
+                 - (b.get("donation") or {}).get("misses", 0))
+        if dmiss > 0:
+            entry["donation_misses_added"] = dmiss
+        if "added_crossings" in entry or "donation_misses_added" in entry:
+            regressions.append(entry)
+        hist_delta = {}
+        ch, bh = c.get("op_histogram") or {}, b.get("op_histogram") or {}
+        for op in sorted(set(ch) | set(bh)):
+            d = ch.get(op, 0) - bh.get(op, 0)
+            if d:
+                hist_delta[op] = d
+        info: Dict[str, Any] = {}
+        if hist_delta:
+            info["op_histogram_delta"] = hist_delta
+        fus = c.get("fusions", 0) - b.get("fusions", 0)
+        if fus:
+            info["fusions_delta"] = fus
+        buf = {}
+        cb_, bb_ = c.get("buffers") or {}, b.get("buffers") or {}
+        for k in sorted(set(cb_) | set(bb_)):
+            d = cb_.get(k, 0) - bb_.get(k, 0)
+            if d:
+                buf[k] = d
+        if buf:
+            info["buffers_delta"] = buf
+        if info:
+            info["program"] = name
+            changed.append(info)
+    return {
+        "regressions": regressions,
+        "changed": changed,
+        "only_in_candidate": sorted(set(cp) - set(bp)),
+        "only_in_baseline": sorted(set(bp) - set(cp)),
+        "totals_delta": {
+            k: (cand.get("totals") or {}).get(k, 0)
+            - (base.get("totals") or {}).get(k, 0)
+            for k in ("programs", "transfer_ops", "host_callbacks",
+                      "donation_misses", "fusions")
+        },
+    }
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff the graph passports of two run records."
+    )
+    ap.add_argument("candidate", help="candidate evidence record (JSON)")
+    ap.add_argument("baseline", help="baseline evidence record (JSON)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable diff on stdout")
+    args = ap.parse_args(argv)
+
+    try:
+        _, cand = _load_section(args.candidate)
+        _, base = _load_section(args.baseline)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    cfp = (cand.get("fingerprint") or {}).get("digest")
+    bfp = (base.get("fingerprint") or {}).get("digest")
+    if cfp and bfp and cfp != bfp:
+        print(
+            "error: cross-fingerprint comparison refused — candidate "
+            f"toolchain digest {cfp} != baseline {bfp}.\n"
+            "The two records were compiled by different toolchains "
+            "(jax/jaxlib/backend/device/XLA flags), so their op censuses "
+            "are different programs by construction; an op delta here "
+            "would be toolchain noise, not a regression. Re-record one "
+            "side on the other's toolchain and diff again.",
+            file=sys.stderr,
+        )
+        return 2
+
+    diff = diff_sections(cand, base)
+    regressed = bool(diff["regressions"]) \
+        or diff["totals_delta"]["transfer_ops"] > 0 \
+        or diff["totals_delta"]["host_callbacks"] > 0
+    if args.as_json:
+        diff["regressed"] = regressed
+        print(json.dumps(diff, indent=1))
+        return 1 if regressed else 0
+
+    td = diff["totals_delta"]
+    print(f"programs: {len(cand.get('programs') or {})} candidate / "
+          f"{len(base.get('programs') or {})} baseline "
+          f"(+{len(diff['only_in_candidate'])} new, "
+          f"-{len(diff['only_in_baseline'])} gone)")
+    print(f"totals delta: transfer_ops {td['transfer_ops']:+d}  "
+          f"host_callbacks {td['host_callbacks']:+d}  "
+          f"donation_misses {td['donation_misses']:+d}  "
+          f"fusions {td['fusions']:+d}")
+    for r in diff["regressions"]:
+        for site in r.get("added_crossings") or []:
+            print(f"  REGRESSED {r['program']}: new {site['kind']} "
+                  f"{site['op']} (+{site['count_delta']}) at "
+                  f"{site['where']}")
+        if r.get("donation_misses_added"):
+            print(f"  REGRESSED {r['program']}: "
+                  f"+{r['donation_misses_added']} donation miss(es) — "
+                  "a declared donated buffer XLA no longer reuses")
+    for info in diff["changed"]:
+        bits = []
+        if "fusions_delta" in info:
+            bits.append(f"fusions {info['fusions_delta']:+d}")
+        if "op_histogram_delta" in info:
+            hd = info["op_histogram_delta"]
+            bits.append("ops " + ", ".join(
+                f"{op} {d:+d}" for op, d in sorted(hd.items())[:6]
+            ))
+        if "buffers_delta" in info and "peak_bytes" in info["buffers_delta"]:
+            bits.append(f"peak {info['buffers_delta']['peak_bytes']:+,}B")
+        if bits:
+            print(f"  changed   {info['program']}: " + "  ".join(bits))
+    for name in diff["only_in_candidate"]:
+        print(f"  new program {name} (no baseline passport)")
+    print("REGRESSED" if regressed else "clean")
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Generate the committed graph-passport demo evidence pair.
+
+Two schema-valid run records over the same tiny jitted stage program,
+differing in exactly one injected host crossing, so the round-24
+acceptance demo is reproducible on committed evidence:
+
+* ``clean`` — ``demo.tile`` is a pure device program (matmul + sin);
+* ``leaky`` — the same program with a ``jax.pure_callback`` host hop
+  spliced into the middle — the compiled HLO gains a
+  ``custom-call(xla_python_cpu_callback)`` whose recorded source
+  location is THIS file's ``_leaky_tile`` body.
+
+``tools/graph_diff.py <leaky> <clean>`` must name the injected callback
+with its source line and exit nonzero; that is the tentpole acceptance
+check, asserted by tests/test_obs_graphs.py against the ledger-ingested
+copies of these records.
+
+Unlike the synthetic hostprof demo trio, the passports here are REAL:
+captured by obs.graphs from actually-lowered-and-compiled programs on
+the generating toolchain, through the same ``instrument`` →
+``snapshot`` → ``build_run_record`` → ``Ledger.ingest`` path as live
+bench output. Both records therefore share one environment
+fingerprint — the pair stays diffable — and regenerating on a
+different toolchain refreshes both sides together.
+
+Usage:  python tools/make_graphs_demo.py [--evidence DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from scconsensus_tpu.obs import graphs  # noqa: E402
+from scconsensus_tpu.obs.export import build_run_record  # noqa: E402
+from scconsensus_tpu.obs.trace import Tracer  # noqa: E402
+
+# fixed identity: distinct created stamps make distinct ledger filenames
+# under one shared run key (dataset=graphsdemo backend=cpu)
+CREATED = {"clean": 1786100001, "leaky": 1786100002}
+
+_SHAPE = (64, 32)
+
+
+def _clean_tile(x):
+    return (x @ x.T) + 1.0
+
+
+def _double_on_host(a):
+    import numpy as np
+
+    return np.asarray(a) * 2.0
+
+
+def _leaky_tile(x):
+    import jax
+
+    y = x @ x.T
+    # the injected host crossing: graph_diff must name this line
+    y = jax.pure_callback(
+        _double_on_host, jax.ShapeDtypeStruct(y.shape, y.dtype), y
+    )
+    return y + 1.0
+
+
+def _record(kind: str) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    fn = _leaky_tile if kind == "leaky" else _clean_tile
+    graphs.install_and_mark(force=True)
+    tile = graphs.instrument("demo.tile", jax.jit(fn))
+    tr = Tracer(sync="off")
+    x = jnp.ones(_SHAPE, jnp.float32)
+    with tr.span("demo_tile"):
+        tile(x).block_until_ready()
+    sec = graphs.snapshot()
+    graphs.reset()
+    rec = build_run_record(
+        metric="graph-passport demo tile wall (round 24)",
+        value=0.001,
+        unit="seconds",
+        extra={"config": "graphsdemo", "platform": "cpu",
+               "demo_kind": kind, "synthetic": True},
+        spans=tr.span_records(),
+        graphs=sec,
+    )
+    rec["run"]["created_unix"] = CREATED[kind]  # deterministic identity
+    return rec
+
+
+def build_demo_records() -> Dict[str, Dict[str, Any]]:
+    """kind → record, the importable surface tests pin against."""
+    return {kind: _record(kind) for kind in CREATED}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="generate + ingest the graph-passport demo pair")
+    ap.add_argument("--evidence", default=None,
+                    help="ledger dir (default: SCC_EVIDENCE_DIR or "
+                         "<repo>/evidence)")
+    args = ap.parse_args(argv)
+
+    from scconsensus_tpu.obs.ledger import Ledger, default_evidence_dir
+
+    led = Ledger(args.evidence or default_evidence_dir(_REPO))
+    for kind, rec in build_demo_records().items():
+        entry = led.ingest(rec, source="graphs-demo")
+        print(f"{kind:>6}: {entry['file']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,7 +17,14 @@ Modes:
       (stage, wall delta, and the driving signal: transfer bytes at a
       declared boundary, device time, FLOPs, or host-side). Run
       ``tools/perf_diff.py`` on the same pair for the full ranked
-      report.
+      report. When ``NUMERIC_PINS.json`` carries a ``graph_ratchet``
+      entry for the candidate's dataset, the graph lane additionally
+      gates the candidate's static per-stage transfer-op/host-callback
+      counts (from its ``graphs`` section) and its TODO(item-2)
+      residency-boundary call counts against the pinned ceilings — no
+      noise band, counts may only decrease; a FAIL names the op kind
+      and source line, and a candidate from a different environment
+      fingerprint is reported, not gated (see tools/graph_diff.py).
 
   perf_gate.py --smoke
       Self-test against the committed fixture ledger
@@ -119,6 +126,24 @@ def run_gate(candidate_path: str, evidence_dir: str
     verdict = regress.gate_record(candidate, history,
                                   baseline_spans=base_spans,
                                   baseline_cost=base_cost)
+    # transfer-op ratchet (round 24): the candidate's static per-stage
+    # transfer/callback counts and TODO(item-2) boundary calls may only
+    # decrease relative to the pinned starting debt (NUMERIC_PINS.json
+    # "graph_ratchet", keyed by dataset + environment fingerprint)
+    try:
+        pins_doc = _load_json(os.path.join(evidence_dir, PINS_NAME))
+    except (OSError, json.JSONDecodeError):
+        pins_doc = {}
+    ratchet = (pins_doc.get("graph_ratchet") or {}).get(
+        run_key(candidate)["dataset"]
+    )
+    gverdicts, gnote = regress.graphs_verdicts(candidate, ratchet)
+    if gverdicts:
+        verdict.graphs = gverdicts
+        if verdict.graphs_regressions:
+            verdict.ok = False
+    if gnote:
+        verdict.note = f"{verdict.note}; {gnote}" if verdict.note else gnote
     drifts: List[Dict[str, Any]] = []
     fp = (candidate.get("extra") or {}).get("numeric_fingerprint")
     if fp:
@@ -271,6 +296,13 @@ def _report(verdict: regress.GateVerdict, drifts: List[Dict[str, Any]],
                         f"± {lv.band:.3f}rps  {mark}")
                 if lv.regressed:
                     line += f"  (-{lv.excess:.3f}rps below floor)"
+            print(line)
+        for gv in verdict.graphs:
+            mark = "REGRESSED" if gv.regressed else "ok"
+            line = (f"  graph {gv.metric:<32} {gv.value:>4d}  "
+                    f"pinned <= {gv.pinned}  {mark}")
+            if gv.regressed and gv.detail:
+                line += f"  <- {gv.detail}"
             print(line)
         for d in drifts:
             state = "acknowledged" if d["acknowledged"] else "UNACKNOWLEDGED"

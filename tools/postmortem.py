@@ -240,6 +240,19 @@ def _partial_events(path: str, src: str) -> List[Dict[str, Any]]:
             "cache_hits": comp.get("cache_hits"),
             "compile_wall_s": comp.get("compile_wall_s"),
         })
+    # round-24 graph-passport facts: did the dying run's COMPILED
+    # programs carry host crossings (transfer ops / callbacks) or
+    # donation misses — the static complement to the runtime burndown
+    gr = rec.get("graphs")
+    if isinstance(gr, dict):
+        tot = gr.get("totals") or {}
+        events.append({
+            "ts": None, "src": src, "kind": "graphs",
+            "programs": tot.get("programs"),
+            "transfer_ops": tot.get("transfer_ops"),
+            "host_callbacks": tot.get("host_callbacks"),
+            "donation_misses": tot.get("donation_misses"),
+        })
     for sp in rec.get("spans") or []:
         if not isinstance(sp, dict):
             continue
@@ -410,7 +423,8 @@ def _fmt_ev(e: Dict[str, Any], t0: float) -> str:
               "todo_item2_bytes", "n_boundaries", "state", "age_s",
               "last_outcome", "n_samples", "gc_pause_s",
               "gc_collections", "compiles", "retraces", "cache_hits",
-              "compile_wall_s"):
+              "compile_wall_s", "programs", "transfer_ops",
+              "host_callbacks", "donation_misses"):
         if e.get(k) is not None:
             bits.append(f"{k}={e[k]}")
     if e.get("kind") == "slo_burn":

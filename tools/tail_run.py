@@ -14,7 +14,10 @@ degraded/quarantined/rejected counters fed from serve.metrics via
 obs.live — an online driver's vitals tick by tick), an integrity panel
 (invariant checks passed/run, ghost-replay progress + lag, mismatches
 and silent-corruption recomputes from robust.integrity — a run
-fighting corruption shows it live), and — when
+fighting corruption shows it live), a graph-passport panel (per-stage
+static transfer-op / host-callback / donation-miss counts from the
+compiled programs, obs.graphs — the transfer-op ratchet's candidate
+side), and — when
 the evidence ledger holds baseline history for the run's key — a
 per-stage ETA from the noise-banded baselines
 (``obs.regress.stage_baselines``). The sibling ``*_partial.json`` record
@@ -494,30 +497,47 @@ def render(lines: List[Dict[str, Any]],
                    + (f"; capture → {sl['capture']}" if sl.get("capture")
                       else ""))
     if partial:
-        walls: List[Tuple[str, float]] = []
-        for s in partial.get("spans") or []:
-            if (isinstance(s, dict) and s.get("kind") == "stage"
-                    and not (s.get("attrs") or {}).get("open")):
-                w = s.get("wall_synced_s")
-                walls.append((s["name"], float(
-                    w if w is not None else s.get("wall_submitted_s", 0.0))))
-        if walls:
-            out.append("  completed stages: " + " | ".join(
-                f"{n} {_fmt_dur(w)}" for n, w in walls[-12:]))
+        def _guard(title: str, body) -> None:
+            # satellite (round 24): a record written before a section
+            # existed — or carrying a malformed one — must degrade to a
+            # one-line note, never raise out of the whole view (pre-r22
+            # records used to need hand-editing to render)
+            try:
+                body()
+            except Exception as e:
+                out.append(f"  {title}: section unreadable "
+                           f"({type(e).__name__}) — skipped")
+
+        def _walls_panel() -> None:
+            walls: List[Tuple[str, float]] = []
+            for s in partial.get("spans") or []:
+                if (isinstance(s, dict) and s.get("kind") == "stage"
+                        and not (s.get("attrs") or {}).get("open")):
+                    w = s.get("wall_synced_s")
+                    walls.append((s.get("name", "?"), float(
+                        w if w is not None
+                        else s.get("wall_submitted_s", 0.0))))
+            if walls:
+                out.append("  completed stages: " + " | ".join(
+                    f"{n} {_fmt_dur(w)}" for n, w in walls[-12:]))
+
+        _guard("completed stages", _walls_panel)
         # residency burn-down table (round 22): bytes crossed per
         # declared boundary, TODO(item-2) rows flagged — the ratchet the
         # device-residency refactor is measured by, rendered live from
         # the partial record's own section (or derived on the fly from
         # its residency audit for pre-round-22 checkpoints)
-        bd = partial.get("residency_burndown")
-        if not isinstance(bd, dict):
-            try:
-                from scconsensus_tpu.obs.profile import build_burndown
+        def _burndown_panel() -> None:
+            bd = partial.get("residency_burndown")
+            if not isinstance(bd, dict):
+                try:
+                    from scconsensus_tpu.obs.profile import build_burndown
 
-                bd = build_burndown(partial.get("residency"))
-            except Exception:
-                bd = None
-        if isinstance(bd, dict) and bd.get("boundaries"):
+                    bd = build_burndown(partial.get("residency"))
+                except Exception:
+                    bd = None
+            if not (isinstance(bd, dict) and bd.get("boundaries")):
+                return
             out.append(
                 "  residency burn-down: total "
                 f"{_fmt_bytes(bd.get('total_bytes'))} across "
@@ -537,12 +557,16 @@ def render(lines: List[Dict[str, Any]],
                 )
             if len(rows) > 8:
                 out.append(f"    ... {len(rows) - 8} more boundaries")
+
+        _guard("residency burn-down", _burndown_panel)
         # host-observatory panels (round 19): sampled host causes,
         # compile/retrace counters, and the RSS timeline — rendered only
         # when the record carries the sections (pre-19 partials degrade
         # to the panels above)
-        hp = partial.get("host_profile")
-        if isinstance(hp, dict):
+        def _hostprof_panel() -> None:
+            hp = partial.get("host_profile")
+            if not isinstance(hp, dict):
+                return
             period = float(hp.get("period_s") or 0.0)
             hz = f"{1.0 / period:.0f}Hz" if period > 0 else "?"
             g = hp.get("gc") or {}
@@ -567,8 +591,11 @@ def render(lines: List[Dict[str, Any]],
                 if srow.get("top_frame"):
                     line += f"  top {srow['top_frame']}"
                 out.append(line)
-        comp_sec = partial.get("compile")
-        if isinstance(comp_sec, dict):
+
+        def _compile_panel() -> None:
+            comp_sec = partial.get("compile")
+            if not isinstance(comp_sec, dict):
+                return
             rt = int(comp_sec.get("retraces") or 0)
             out.append(
                 f"  compile: {comp_sec.get('compiles', 0)} compiles   "
@@ -576,8 +603,11 @@ def render(lines: List[Dict[str, Any]],
                 f"{comp_sec.get('cache_hits', 0)} cache hits   "
                 f"wall {_fmt_dur(comp_sec.get('compile_wall_s', 0.0))}"
             )
-        mt = partial.get("memory_timeline")
-        if isinstance(mt, dict):
+
+        def _memory_panel() -> None:
+            mt = partial.get("memory_timeline")
+            if not isinstance(mt, dict):
+                return
             vals = [s.get("rss_bytes")
                     for s in (mt.get("samples") or [])
                     if isinstance(s, dict)]
@@ -587,12 +617,78 @@ def render(lines: List[Dict[str, Any]],
                 + (f"  hbm peak {_fmt_bytes(mt['hbm_peak_bytes'])}"
                    if mt.get("hbm_peak_bytes") else "")
             )
-        term = partial.get("termination")
-        if isinstance(term, dict):
+
+        def _graphs_panel() -> None:
+            # graph-passport panel (round 24, obs.graphs): per-stage
+            # static transfer-op / host-callback / donation-miss counts
+            # from the compiled programs — the ratchet's candidate side,
+            # visible wherever the record is
+            sec = partial.get("graphs")
+            if not isinstance(sec, dict):
+                return
+            totals = sec.get("totals") or {}
+            fp = (sec.get("fingerprint") or {}).get("digest")
+            out.append(
+                f"  graph passports: {totals.get('programs', 0)} programs"
+                f"   transfer ops {totals.get('transfer_ops', 0)}"
+                f"   host callbacks {totals.get('host_callbacks', 0)}"
+                f"   donation misses {totals.get('donation_misses', 0)}"
+                f"   fusions {totals.get('fusions', 0)}"
+                + (f"   [fp {fp}]" if fp else "")
+            )
+            rows = sorted(
+                (sec.get("by_stage") or {}).items(),
+                key=lambda kv: (
+                    -(int(kv[1].get("transfer_ops") or 0)
+                      + int(kv[1].get("host_callbacks") or 0)),
+                    kv[0],
+                ),
+            )
+            for sname, row in rows[:8]:
+                progs = row.get("programs") or []
+                flags = []
+                if row.get("transfer_ops"):
+                    flags.append(f"XFER OPS {row['transfer_ops']}")
+                if row.get("host_callbacks"):
+                    flags.append(f"CALLBACKS {row['host_callbacks']}")
+                if row.get("donation_misses"):
+                    flags.append(f"donation misses "
+                                 f"{row['donation_misses']}")
+                out.append(
+                    f"    {sname:<24} {len(progs)} program(s)"
+                    + ("   " + "   ".join(flags) if flags
+                       else "   device-clean")
+                )
+            if len(rows) > 8:
+                out.append(f"    ... {len(rows) - 8} more stages")
+            errs = sec.get("errors") or []
+            if errs:
+                out.append(f"    capture errors: {len(errs)} "
+                           f"(first: {errs[0]})")
+
+        def _termination_panel() -> None:
+            term = partial.get("termination")
+            if not isinstance(term, dict):
+                return
             out.append(f"  partial record: cause={term.get('cause')}"
                        + (f" last_span={term.get('last_span')}"
                           if term.get("last_span") else "")
                        + f" (flushed {_fmt_dur(now - float(term.get('flushed_unix') or now))} ago)")
+
+        _guard("host profile", _hostprof_panel)
+        _guard("compile", _compile_panel)
+        _guard("memory", _memory_panel)
+        _guard("graph passports", _graphs_panel)
+        _guard("termination", _termination_panel)
+        absent = [k for k in ("host_profile", "compile",
+                              "memory_timeline", "graphs")
+                  if k not in partial]
+        if absent:
+            # one-line absence note (satellite, round 24): an older
+            # record simply predates these sections — say so instead of
+            # rendering nothing or raising
+            out.append("  sections absent (record predates them?): "
+                       + ", ".join(absent))
     if st["end"]:
         out.append(f"  ended: cause={st['end'].get('cause')} after "
                    f"{st['end'].get('ticks')} ticks, "
